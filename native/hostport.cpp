@@ -1,0 +1,88 @@
+// Native host-port block allocator.
+//
+// TPU-native successor of the reference's standalone hostport-allocator
+// (third_party/hostport-allocator/pkg/core/hostportmanager.go — a Go
+// informer/workqueue controller around k8s portallocator) and the
+// in-controller HostPortMap (main.go:86-108).  The control-plane policy
+// (annotations, re-adoption) lives in Python (controller/hostport.py);
+// this library owns the allocation data structure: blocks of `block`
+// contiguous ports over [start, end), wrap-around cursor, O(1)
+// allocate/release/adopt, thread-safe.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this toolchain).
+
+#include <cstdint>
+#include <mutex>
+#include <unordered_set>
+
+namespace {
+
+struct Allocator {
+  int start;
+  int end;
+  int block;
+  int cur;
+  std::unordered_set<int> used;
+  std::mutex mu;
+
+  Allocator(int s, int e, int b) : start(s), end(e), block(b), cur(s) {}
+
+  int allocate() {
+    std::lock_guard<std::mutex> g(mu);
+    const int n_blocks = (end - start) / block;
+    for (int i = 0; i < n_blocks; ++i) {
+      int base = cur;
+      cur += block;
+      if (cur + block > end) cur = start;
+      if (used.find(base) == used.end()) {
+        used.insert(base);
+        return base;
+      }
+    }
+    return -1;  // exhausted
+  }
+
+  void release(int base) {
+    std::lock_guard<std::mutex> g(mu);
+    used.erase(base);
+  }
+
+  int adopt(int base) {
+    std::lock_guard<std::mutex> g(mu);
+    if (used.count(base)) return 0;
+    used.insert(base);
+    return 1;
+  }
+
+  int in_use(int base) {
+    std::lock_guard<std::mutex> g(mu);
+    return used.count(base) ? 1 : 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hp_new(int start, int end, int block) {
+  if (block <= 0 || end - start < block) return nullptr;
+  return new Allocator(start, end, block);
+}
+
+void hp_free(void* h) { delete static_cast<Allocator*>(h); }
+
+int hp_allocate(void* h) { return static_cast<Allocator*>(h)->allocate(); }
+
+void hp_release(void* h, int base) {
+  static_cast<Allocator*>(h)->release(base);
+}
+
+int hp_adopt(void* h, int base) {
+  return static_cast<Allocator*>(h)->adopt(base);
+}
+
+int hp_in_use(void* h, int base) {
+  return static_cast<Allocator*>(h)->in_use(base);
+}
+
+}  // extern "C"

@@ -1,0 +1,36 @@
+"""paddle_operator_tpu — a TPU-native distributed training job framework.
+
+A ground-up rebuild of the capability set of ``renhuanyu/paddle-operator``
+(a Kubernetes operator that schedules PaddlePaddle jobs onto GPU nodes),
+re-designed for TPU pod slices:
+
+- ``api``        — the ``TPUJob`` custom-resource types and CRD schema
+                   (capability parity: reference ``api/v1/paddlejob_types.go``).
+- ``controller`` — the reconciler state machine and the pure pod/service/
+                   configmap builders (reference ``controllers/``), plus the
+                   native host-port allocator (reference
+                   ``third_party/hostport-allocator``).
+- ``launch``     — the in-pod launcher: reads the injected rendezvous env
+                   contract and brings up ``jax.distributed`` over ICI/DCN
+                   (the reference delegates this to
+                   ``paddle.distributed.launch`` inside user containers).
+- ``parallel``   — device-mesh construction, sharding rules, ring attention
+                   (context parallel), pipeline parallel, PS embedding tier.
+- ``models``     — flagship workloads matching the reference's benchmark
+                   configs: LLaMA, ERNIE-style encoder, ResNet, Wide&Deep.
+- ``ops``        — TPU pallas kernels (flash attention) with XLA fallbacks.
+- ``train``      — sharded train step, optimizer, checkpoint/resume.
+- ``utils``      — logging, registry, misc helpers.
+
+The control plane is pure Python (kubernetes-client gated behind an API
+interface so it is fully testable in-process); the hot allocator is C++
+(``native/``); the compute path is JAX/XLA/pallas.
+"""
+
+__version__ = "0.1.0"
+
+GROUP = "batch.tpujob.dev"
+VERSION = "v1"
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+SHORT_NAME = "tpj"

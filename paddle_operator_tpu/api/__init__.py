@@ -1,0 +1,21 @@
+"""TPUJob CRD types and schema (reference capability: api/v1/)."""
+
+from paddle_operator_tpu.api.types import (  # noqa: F401
+    CleanPodPolicy,
+    ElasticStatus,
+    Intranet,
+    JobMode,
+    MeshSpec,
+    Phase,
+    ResourceSpec,
+    ResourceStatus,
+    TPUJob,
+    TPUJobSpec,
+    TPUJobStatus,
+    TPUSpec,
+    RESOURCE_HETER,
+    RESOURCE_PS,
+    RESOURCE_WORKER,
+    TRAINING_ROLE,
+)
+from paddle_operator_tpu.api.crd import crd_yaml, generate_crd  # noqa: F401

@@ -1,0 +1,181 @@
+"""CRD schema generation for TPUJob.
+
+The reference ships an 8.7k-line controller-gen-generated CRD
+(``config/crd/bases/batch.paddlepaddle.org_paddlejobs.yaml``, rendered to
+``deploy/v1/crd.yaml``).  We generate ours programmatically from the types in
+:mod:`paddle_operator_tpu.api.types` — same role in the system (``kubectl
+apply``-able apiextensions.k8s.io/v1 manifest with structural schema, status
+subresource and printer columns; reference markers at
+``api/v1/paddlejob_types.go:198-205``), without vendoring a Go toolchain.
+
+The pod-template portion of the schema uses
+``x-kubernetes-preserve-unknown-fields`` rather than inlining the entire
+corev1.PodTemplateSpec schema (which is what accounts for ~8k of the
+reference's 8.7k lines); the apiserver validates pod templates at pod-creation
+time anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from paddle_operator_tpu import GROUP, KIND, PLURAL, SHORT_NAME, VERSION
+from paddle_operator_tpu.api.types import MeshSpec
+
+
+def _int(minimum: int | None = None) -> Dict[str, Any]:
+    s: Dict[str, Any] = {"type": "integer"}
+    if minimum is not None:
+        s["minimum"] = minimum
+    return s
+
+
+def _resource_spec_schema() -> Dict[str, Any]:
+    return {
+        "type": "object",
+        "required": ["replicas"],
+        "properties": {
+            "replicas": _int(0),
+            "requests": _int(0),
+            "limits": _int(0),
+            "template": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+        },
+    }
+
+
+def _spec_schema() -> Dict[str, Any]:
+    return {
+        "type": "object",
+        "properties": {
+            "cleanPodPolicy": {
+                "type": "string",
+                "enum": ["", "Always", "Never", "OnFailure", "OnCompletion"],
+            },
+            "intranet": {
+                "type": "string",
+                "enum": ["", "PodIP", "Service", "Host"],
+            },
+            "ps": _resource_spec_schema(),
+            "worker": _resource_spec_schema(),
+            "heter": _resource_spec_schema(),
+            "tpu": {
+                "type": "object",
+                "properties": {
+                    "accelerator": {"type": "string"},
+                    "topology": {
+                        "type": "string",
+                        "pattern": r"^\d+x\d+(x\d+)?$",
+                    },
+                    "sliceCount": _int(1),
+                    "chipsPerWorker": _int(1),
+                },
+            },
+            "mesh": {
+                "type": "object",
+                "properties": {a: _int(1) for a in MeshSpec.AXES},
+            },
+            "maxRestarts": _int(0),
+            "checkpointPath": {"type": "string"},
+            "schedulerName": {"type": "string"},
+        },
+    }
+
+
+def _resource_status_schema() -> Dict[str, Any]:
+    return {
+        "type": "object",
+        "properties": {
+            "pending": _int(), "starting": _int(), "running": _int(),
+            "failed": _int(), "succeeded": _int(), "unknown": _int(),
+            "ready": {"type": "string"},
+            "refs": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True,
+                },
+            },
+        },
+    }
+
+
+def _status_schema() -> Dict[str, Any]:
+    return {
+        "type": "object",
+        "properties": {
+            "phase": {"type": "string"},
+            "mode": {"type": "string"},
+            "ps": _resource_status_schema(),
+            "worker": _resource_status_schema(),
+            "elastic": {"type": "string"},
+            "startTime": {"type": "string", "format": "date-time"},
+            "completionTime": {"type": "string", "format": "date-time"},
+            "observedGeneration": _int(),
+            "restartCount": _int(),
+        },
+    }
+
+
+def generate_crd() -> Dict[str, Any]:
+    """Build the apiextensions.k8s.io/v1 CustomResourceDefinition object."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": f"{KIND}List",
+                "plural": PLURAL,
+                "singular": KIND.lower(),
+                "shortNames": [SHORT_NAME],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    # Reference printcolumns: Status/Mode/PS/Worker/Age
+                    # (api/v1/paddlejob_types.go:200-204).
+                    "additionalPrinterColumns": [
+                        {"name": "Status", "type": "string",
+                         "jsonPath": ".status.phase"},
+                        {"name": "Mode", "type": "string",
+                         "jsonPath": ".status.mode"},
+                        {"name": "PS", "type": "string",
+                         "jsonPath": ".status.ps.ready"},
+                        {"name": "Worker", "type": "string",
+                         "jsonPath": ".status.worker.ready"},
+                        {"name": "TPU", "type": "string",
+                         "jsonPath": ".spec.tpu.topology"},
+                        {"name": "Age", "type": "date",
+                         "jsonPath": ".metadata.creationTimestamp"},
+                    ],
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": _spec_schema(),
+                                "status": _status_schema(),
+                            },
+                        }
+                    },
+                }
+            ],
+        },
+    }
+
+
+def crd_yaml() -> str:
+    import yaml
+
+    return yaml.safe_dump(generate_crd(), sort_keys=False)

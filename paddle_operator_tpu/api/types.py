@@ -1,0 +1,523 @@
+"""TPUJob custom-resource types.
+
+Capability parity with the reference CRD (``api/v1/paddlejob_types.go:47-227``):
+job modes, 14 lifecycle phases, clean-pod policies, elastic status, the three
+intranet (pod networking) modes, per-role ResourceSpec with a full pod
+template, and an observed Status with per-role counters and object refs.
+
+TPU-native additions (none of these exist in the reference, which is
+GPU/NCCL-oriented): a ``TPUSpec`` carrying accelerator type, physical slice
+topology and slice count, and a ``MeshSpec`` carrying the logical parallelism
+axes (dp/fsdp/tp/pp/cp/ep) so that rank→chip placement and the ICI/DCN layout
+are part of the declarative job contract rather than buried in user code.
+
+Types are plain dataclasses with k8s-style camelCase (de)serialization so the
+same objects round-trip through the real apiserver, the fake in-process API
+used by the test-suite, and YAML manifests.
+"""
+
+from __future__ import annotations
+
+import copy
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Constants (reference: api/v1/paddlejob_types.go:27-45, controllers/*.go)
+# ---------------------------------------------------------------------------
+
+# Resource (role) types.  The reference has ps/worker/heter
+# (api/v1/paddlejob_types.go:33-38).
+RESOURCE_PS = "ps"
+RESOURCE_WORKER = "worker"
+RESOURCE_HETER = "heter"
+
+# Label / annotation keys stamped on child resources
+# (reference: api/v1/paddlejob_types.go:27-31 -> "paddle-res-name" etc.)
+RESOURCE_NAME_LABEL = "tpujob-res-name"
+RESOURCE_TYPE_LABEL = "tpujob-res-type"
+RESOURCE_ANNOTATION = "tpujob-res-type"
+HOSTPORT_ANNOTATION = "tpujob-hostport"
+
+# Role env values (reference TrainingRole map api/v1/paddlejob_types.go:42-45).
+TRAINING_ROLE = {
+    RESOURCE_PS: "PSERVER",
+    RESOURCE_WORKER: "TRAINER",
+    RESOURCE_HETER: "TRAINER",
+}
+
+# Rendezvous port contract.  The reference uses PADDLE_PORT=2379 with a block
+# of HOST_PORT_NUM=20 ports (controllers/paddlejob_controller.go:39-45); for
+# TPU the block collapses to the XLA coordinator port (ICI is not IP), but we
+# keep a small block for auxiliary services (profiler, heartbeat).
+COORDINATOR_PORT = 8476
+PORT_NUM = 8
+HOST_PORT_RANGE = (35000, 65000)
+
+
+class JobMode:
+    """Reference: PaddleJobMode (api/v1/paddlejob_types.go:47-56)."""
+
+    PS = "PS"
+    COLLECTIVE = "Collective"
+    SINGLE = "Single"
+
+
+class Phase:
+    """Job lifecycle phases (reference: api/v1/paddlejob_types.go:58-76)."""
+
+    STARTING = "Starting"
+    PENDING = "Pending"
+    SCALING = "Scaling"
+    ABORTING = "Aborting"
+    ABORTED = "Aborted"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    COMPLETING = "Completing"
+    COMPLETED = "Completed"
+    TERMINATING = "Terminating"
+    TERMINATED = "Terminated"
+    FAILED = "Failed"
+    SUCCEED = "Succeed"
+    UNKNOWN = "Unknown"
+
+
+class CleanPodPolicy:
+    """Reference: api/v1/paddlejob_types.go:78-89."""
+
+    ALWAYS = "Always"
+    NEVER = "Never"
+    ON_FAILURE = "OnFailure"
+    ON_COMPLETION = "OnCompletion"
+
+
+class ElasticStatus:
+    """Reference: api/v1/paddlejob_types.go:91-99 (scaffolding there; real
+    behavior here — see controller/reconciler.py elastic path)."""
+
+    NONE = "NONE"
+    DOING = "DOING"
+    DONE = "DONE"
+    ERROR = "ERROR"
+
+
+class Intranet:
+    """Pod networking mode (reference: api/v1/paddlejob_types.go:101-107 and
+    the trade-off table docs/design.md:216-222)."""
+
+    POD_IP = "PodIP"
+    SERVICE = "Service"
+    HOST = "Host"
+
+
+# ---------------------------------------------------------------------------
+# Spec
+# ---------------------------------------------------------------------------
+
+_TOPOLOGY_RE = re.compile(r"^\d+x\d+(x\d+)?$")
+
+
+@dataclass
+class TPUSpec:
+    """TPU-native placement contract (no reference analogue; replaces the
+    reference's implicit `nvidia.com/gpu` + nodeSelector pattern from
+    docs/user-guide.md:222-258 with first-class fields)."""
+
+    # GKE accelerator name, e.g. "tpu-v5-lite-podslice" / "tpu-v5p-slice".
+    accelerator: str = "tpu-v5-lite-podslice"
+    # Physical ICI topology of one slice, e.g. "2x4", "4x8", "2x2x2".
+    topology: str = "2x4"
+    # Number of slices (>1 => multislice over DCN with MEGASCALE_* env).
+    slice_count: int = 1
+    # Chips handled by one worker pod (GKE default: 4 chips/host for v5e).
+    chips_per_worker: int = 4
+
+    def chips_per_slice(self) -> int:
+        if not _TOPOLOGY_RE.match(self.topology):
+            raise ValueError(f"bad topology {self.topology!r}; want NxM[xK]")
+        n = 1
+        for d in self.topology.split("x"):
+            n *= int(d)
+        return n
+
+    def workers_per_slice(self) -> int:
+        chips = self.chips_per_slice()
+        if chips % self.chips_per_worker and chips > self.chips_per_worker:
+            raise ValueError(
+                f"topology {self.topology} ({chips} chips) not divisible by "
+                f"chips_per_worker={self.chips_per_worker}"
+            )
+        return max(1, chips // self.chips_per_worker)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "accelerator": self.accelerator,
+            "topology": self.topology,
+            "sliceCount": self.slice_count,
+            "chipsPerWorker": self.chips_per_worker,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["TPUSpec"]:
+        if d is None:
+            return None
+        return cls(
+            accelerator=d.get("accelerator", "tpu-v5-lite-podslice"),
+            topology=d.get("topology", "2x4"),
+            slice_count=d.get("sliceCount", 1),
+            chips_per_worker=d.get("chipsPerWorker", 4),
+        )
+
+
+@dataclass
+class MeshSpec:
+    """Logical parallelism axes carried in the CRD so the controller can
+    validate axis product == chip count and the launcher can build the
+    `jax.sharding.Mesh` deterministically (SURVEY.md §2: 'the CRD must carry
+    mesh/topology fields')."""
+
+    dp: int = 1      # data parallel (across slices / DCN-friendly)
+    fsdp: int = 1    # fully-sharded data parallel (params over ICI)
+    tp: int = 1      # tensor parallel
+    pp: int = 1      # pipeline parallel
+    cp: int = 1      # context/sequence parallel (ring attention)
+    ep: int = 1      # expert parallel
+
+    AXES = ("dp", "fsdp", "tp", "pp", "cp", "ep")
+
+    def size(self) -> int:
+        n = 1
+        for a in self.AXES:
+            n *= getattr(self, a)
+        return n
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {a: getattr(self, a) for a in self.AXES if getattr(self, a) != 1}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["MeshSpec"]:
+        if d is None:
+            return None
+        return cls(**{a: int(d.get(a, 1)) for a in cls.AXES})
+
+
+@dataclass
+class ResourceSpec:
+    """Per-role pod group (reference: api/v1/paddlejob_types.go:133-145).
+
+    ``requests``/``limits`` are the elastic bounds (min/max replicas).  The
+    reference defines but never reads them (SURVEY.md §3.4); here the
+    reconciler enforces them on scale.
+    """
+
+    replicas: int = 0
+    requests: Optional[int] = None
+    limits: Optional[int] = None
+    # corev1.PodTemplateSpec as a plain dict {"metadata": ..., "spec": ...}.
+    template: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"replicas": self.replicas}
+        if self.requests is not None:
+            d["requests"] = self.requests
+        if self.limits is not None:
+            d["limits"] = self.limits
+        if self.template:
+            d["template"] = self.template
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["ResourceSpec"]:
+        if d is None:
+            return None
+        return cls(
+            replicas=int(d.get("replicas", 0)),
+            requests=d.get("requests"),
+            limits=d.get("limits"),
+            template=d.get("template", {}) or {},
+        )
+
+
+@dataclass
+class TPUJobSpec:
+    """Desired state (reference: PaddleJobSpec api/v1/paddlejob_types.go:110-131).
+
+    ``with_gloo`` is gone — the TPU rendezvous is the XLA coordinator, wired
+    unconditionally (see controller/builders.py).  New fields: ``tpu``,
+    ``mesh``, ``max_restarts``, ``checkpoint_path`` (restart/resume contract
+    the reference only sketches in docs/design-fault-tolerant.md).
+    """
+
+    clean_pod_policy: str = ""                 # CleanPodPolicy.*
+    intranet: str = ""                         # Intranet.*
+    ps: Optional[ResourceSpec] = None
+    worker: Optional[ResourceSpec] = None
+    heter: Optional[ResourceSpec] = None
+    tpu: Optional[TPUSpec] = None
+    mesh: Optional[MeshSpec] = None
+    # Fault tolerance: how many whole-job restarts are allowed before Failed.
+    max_restarts: int = 0
+    # Convention path for checkpoint/resume (orbax); injected as env.
+    checkpoint_path: str = ""
+    # Gang-schedule via an external scheduler (e.g. "volcano", "kueue").
+    scheduler_name: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.clean_pod_policy:
+            d["cleanPodPolicy"] = self.clean_pod_policy
+        if self.intranet:
+            d["intranet"] = self.intranet
+        for k, v in (("ps", self.ps), ("worker", self.worker), ("heter", self.heter)):
+            if v is not None:
+                d[k] = v.to_dict()
+        if self.tpu is not None:
+            d["tpu"] = self.tpu.to_dict()
+        if self.mesh is not None:
+            d["mesh"] = self.mesh.to_dict()
+        if self.max_restarts:
+            d["maxRestarts"] = self.max_restarts
+        if self.checkpoint_path:
+            d["checkpointPath"] = self.checkpoint_path
+        if self.scheduler_name:
+            d["schedulerName"] = self.scheduler_name
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TPUJobSpec":
+        d = d or {}
+        return cls(
+            clean_pod_policy=d.get("cleanPodPolicy", ""),
+            intranet=d.get("intranet", ""),
+            ps=ResourceSpec.from_dict(d.get("ps")),
+            worker=ResourceSpec.from_dict(d.get("worker")),
+            heter=ResourceSpec.from_dict(d.get("heter")),
+            tpu=TPUSpec.from_dict(d.get("tpu")),
+            mesh=MeshSpec.from_dict(d.get("mesh")),
+            max_restarts=int(d.get("maxRestarts", 0)),
+            checkpoint_path=d.get("checkpointPath", ""),
+            scheduler_name=d.get("schedulerName", ""),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Status
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ResourceStatus:
+    """Per-role counters (reference: api/v1/paddlejob_types.go:179-196)."""
+
+    pending: int = 0
+    starting: int = 0
+    running: int = 0
+    failed: int = 0
+    succeeded: int = 0
+    unknown: int = 0
+    ready: str = ""
+    # Object references to child pods: [{"kind": "Pod", "name": ..., ...}].
+    refs: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        for k, attr in (
+            ("pending", "pending"), ("starting", "starting"),
+            ("running", "running"), ("failed", "failed"),
+            ("succeeded", "succeeded"), ("unknown", "unknown"),
+        ):
+            if getattr(self, attr):
+                d[k] = getattr(self, attr)
+        if self.ready:
+            d["ready"] = self.ready
+        if self.refs:
+            d["refs"] = self.refs
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ResourceStatus":
+        d = d or {}
+        return cls(
+            pending=d.get("pending", 0),
+            starting=d.get("starting", 0),
+            running=d.get("running", 0),
+            failed=d.get("failed", 0),
+            succeeded=d.get("succeeded", 0),
+            unknown=d.get("unknown", 0),
+            ready=d.get("ready", ""),
+            refs=d.get("refs", []) or [],
+        )
+
+
+@dataclass
+class TPUJobStatus:
+    """Observed state (reference: PaddleJobStatus api/v1/paddlejob_types.go:147-177)."""
+
+    phase: str = ""
+    mode: str = ""
+    ps: ResourceStatus = field(default_factory=ResourceStatus)
+    worker: ResourceStatus = field(default_factory=ResourceStatus)
+    # The reference defines heter in the spec but never reconciles it (dead
+    # scaffolding, SURVEY.md §2 C2); here heter is a first-class role.
+    heter: ResourceStatus = field(default_factory=ResourceStatus)
+    elastic: str = ""
+    start_time: Optional[str] = None          # RFC3339
+    completion_time: Optional[str] = None
+    observed_generation: int = 0
+    # Fault tolerance (new): completed whole-job restarts.
+    restart_count: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.phase:
+            d["phase"] = self.phase
+        if self.mode:
+            d["mode"] = self.mode
+        ps = self.ps.to_dict()
+        if ps:
+            d["ps"] = ps
+        worker = self.worker.to_dict()
+        if worker:
+            d["worker"] = worker
+        heter = self.heter.to_dict()
+        if heter:
+            d["heter"] = heter
+        if self.elastic:
+            d["elastic"] = self.elastic
+        if self.start_time:
+            d["startTime"] = self.start_time
+        if self.completion_time:
+            d["completionTime"] = self.completion_time
+        if self.observed_generation:
+            d["observedGeneration"] = self.observed_generation
+        if self.restart_count:
+            d["restartCount"] = self.restart_count
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TPUJobStatus":
+        d = d or {}
+        return cls(
+            phase=d.get("phase", ""),
+            mode=d.get("mode", ""),
+            ps=ResourceStatus.from_dict(d.get("ps")),
+            worker=ResourceStatus.from_dict(d.get("worker")),
+            heter=ResourceStatus.from_dict(d.get("heter")),
+            elastic=d.get("elastic", ""),
+            start_time=d.get("startTime"),
+            completion_time=d.get("completionTime"),
+            observed_generation=d.get("observedGeneration", 0),
+            restart_count=d.get("restartCount", 0),
+        )
+
+
+# ---------------------------------------------------------------------------
+# The TPUJob object
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TPUJob:
+    """The TPUJob custom resource (reference: PaddleJob
+    api/v1/paddlejob_types.go:198-218; shortName pdj -> tpj here)."""
+
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    creation_timestamp: str = ""
+    deletion_timestamp: Optional[str] = None
+    resource_version: int = 0
+    generation: int = 1
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+
+    # -- validation --------------------------------------------------------
+
+    def validate(self) -> List[str]:
+        """Spec validation the reference leaves to the CRD schema."""
+        errs: List[str] = []
+        for role_name, role in (("ps", self.spec.ps), ("worker", self.spec.worker),
+                                ("heter", self.spec.heter)):
+            if role is not None:
+                if role.replicas < 0:
+                    errs.append(f"{role_name}.replicas must be >= 0")
+                if role.requests is not None and role.limits is not None \
+                        and role.requests > role.limits:
+                    errs.append(f"{role_name}: requests > limits")
+        if self.spec.tpu is not None:
+            try:
+                self.spec.tpu.chips_per_slice()
+            except ValueError as e:
+                errs.append(str(e))
+            else:
+                if self.spec.worker is not None and self.spec.tpu.slice_count >= 1:
+                    want = self.spec.tpu.workers_per_slice() * self.spec.tpu.slice_count
+                    if self.spec.worker.replicas != want:
+                        errs.append(
+                            f"worker.replicas={self.spec.worker.replicas} does not "
+                            f"match topology {self.spec.tpu.topology} x "
+                            f"{self.spec.tpu.slice_count} slice(s) => {want} workers"
+                        )
+                if self.spec.mesh is not None:
+                    chips = self.spec.tpu.chips_per_slice() * self.spec.tpu.slice_count
+                    if self.spec.mesh.size() != chips:
+                        errs.append(
+                            f"mesh axes product {self.spec.mesh.size()} != "
+                            f"total chips {chips}"
+                        )
+        return errs
+
+    # -- serde -------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        from paddle_operator_tpu import GROUP, KIND, VERSION
+
+        meta: Dict[str, Any] = {"name": self.name, "namespace": self.namespace}
+        if self.uid:
+            meta["uid"] = self.uid
+        if self.labels:
+            meta["labels"] = dict(self.labels)
+        if self.annotations:
+            meta["annotations"] = dict(self.annotations)
+        if self.finalizers:
+            meta["finalizers"] = list(self.finalizers)
+        if self.creation_timestamp:
+            meta["creationTimestamp"] = self.creation_timestamp
+        if self.deletion_timestamp:
+            meta["deletionTimestamp"] = self.deletion_timestamp
+        if self.resource_version:
+            meta["resourceVersion"] = str(self.resource_version)
+        if self.generation:
+            meta["generation"] = self.generation
+        return {
+            "apiVersion": f"{GROUP}/{VERSION}",
+            "kind": KIND,
+            "metadata": meta,
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUJob":
+        meta = d.get("metadata", {}) or {}
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            uid=meta.get("uid", ""),
+            labels=meta.get("labels", {}) or {},
+            annotations=meta.get("annotations", {}) or {},
+            finalizers=meta.get("finalizers", []) or [],
+            creation_timestamp=meta.get("creationTimestamp", ""),
+            deletion_timestamp=meta.get("deletionTimestamp"),
+            resource_version=int(meta.get("resourceVersion", 0) or 0),
+            generation=int(meta.get("generation", 1) or 1),
+            spec=TPUJobSpec.from_dict(d.get("spec")),
+            status=TPUJobStatus.from_dict(d.get("status")),
+        )
+
+    def deepcopy(self) -> "TPUJob":
+        return copy.deepcopy(self)

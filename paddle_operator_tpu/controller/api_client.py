@@ -1,0 +1,89 @@
+"""API-server client interface.
+
+The reference reconciler talks to the apiserver through controller-runtime's
+client (``r.Get/List/Create/Delete/Update/Status().Update`` +
+``record.EventRecorder``).  We define the same narrow surface as an abstract
+interface so the reconciler is a pure state machine over it:
+
+- :class:`FakeAPI` (fake_api.py) — in-process stand-in used by the test
+  suite, playing the role envtest plays for the reference
+  (controllers/suite_test.go:51-89).
+- :class:`KubeAPI` (kube_api.py) — the real thing, backed by the
+  ``kubernetes`` Python client (import-gated; not needed for tests).
+
+Objects are plain dicts in k8s JSON form; TPUJob crosses the boundary as a
+dict too and is (de)serialized by the reconciler.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency failure (resourceVersion mismatch)."""
+
+
+class APIClient(abc.ABC):
+    """Namespaced CRUD over the object kinds the controller owns."""
+
+    @abc.abstractmethod
+    def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        """Return the object or raise NotFound."""
+
+    @abc.abstractmethod
+    def list_owned(self, kind: str, namespace: str, owner_name: str) -> List[Dict[str, Any]]:
+        """List objects of `kind` controlled by the named TPUJob — the
+        analogue of the reference's `.metadata.controller` field index
+        (controllers/paddlejob_controller.go:407-419)."""
+
+    @abc.abstractmethod
+    def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Full-object update; raises Conflict on resourceVersion mismatch."""
+
+    @abc.abstractmethod
+    def update_status(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        """Status-subresource update (reference r.Status().Update)."""
+
+    @abc.abstractmethod
+    def record_event(self, obj: Dict[str, Any], event_type: str, reason: str,
+                    message: str) -> None:
+        """Reference: r.Recorder.Event on create/delete
+        (controllers/paddlejob_controller.go:302-316)."""
+
+    # -- helpers shared by implementations ---------------------------------
+
+    @staticmethod
+    def set_controller_reference(owner: Dict[str, Any], obj: Dict[str, Any]) -> None:
+        """Stamp an ownerReference with controller=true (the reference's
+        ctrl.SetControllerReference)."""
+        meta = obj.setdefault("metadata", {})
+        refs = meta.setdefault("ownerReferences", [])
+        refs.append({
+            "apiVersion": owner.get("apiVersion", ""),
+            "kind": owner.get("kind", ""),
+            "name": owner["metadata"]["name"],
+            "uid": owner["metadata"].get("uid", ""),
+            "controller": True,
+            "blockOwnerDeletion": True,
+        })
+
+    @staticmethod
+    def controller_of(obj: Dict[str, Any]) -> Optional[str]:
+        for ref in obj.get("metadata", {}).get("ownerReferences", []) or []:
+            if ref.get("controller"):
+                return ref.get("name")
+        return None

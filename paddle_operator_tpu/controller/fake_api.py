@@ -1,0 +1,179 @@
+"""In-process fake apiserver + fake TPU fleet.
+
+Plays the role envtest (a real headless kube-apiserver+etcd) plays in the
+reference test suite (controllers/suite_test.go:51-89): the controller only
+ever manipulates API objects, so an in-memory store with faithful
+resourceVersion / ownerReference / finalizer semantics exercises it fully.
+
+:class:`FakeFleet` additionally simulates the kubelet side the reference
+leaves uncovered ("pod status transitions are *not* simulated, so phase logic
+is untested" — SURVEY.md §4): it assigns pod IPs, flips phases
+Pending→Running→Succeeded/Failed, and fills containerStatuses, driving the
+ConfigMap barrier and the failure/restart paths.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_operator_tpu.controller.api_client import APIClient, Conflict, NotFound
+
+
+class FakeAPI(APIClient):
+    def __init__(self) -> None:
+        # store[(kind, namespace, name)] = obj
+        self.store: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        self.events: List[Dict[str, Any]] = []
+        self._rv = itertools.count(1)
+        self._uid = itertools.count(1)
+
+    # -- internal ----------------------------------------------------------
+
+    def _key(self, kind: str, obj: Dict[str, Any]) -> Tuple[str, str, str]:
+        m = obj["metadata"]
+        return (kind, m.get("namespace", "default"), m["name"])
+
+    def _bump(self, obj: Dict[str, Any]) -> None:
+        obj["metadata"]["resourceVersion"] = str(next(self._rv))
+
+    # -- APIClient ---------------------------------------------------------
+
+    def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
+        try:
+            return copy.deepcopy(self.store[(kind, namespace, name)])
+        except KeyError:
+            raise NotFound(f"{kind} {namespace}/{name}")
+
+    def list_owned(self, kind: str, namespace: str, owner_name: str) -> List[Dict[str, Any]]:
+        out = []
+        for (k, ns, _), obj in sorted(self.store.items()):
+            if k == kind and ns == namespace and self.controller_of(obj) == owner_name:
+                out.append(copy.deepcopy(obj))
+        return out
+
+    def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        key = self._key(kind, obj)
+        if key in self.store:
+            raise Conflict(f"{kind} {key[1]}/{key[2]} already exists")
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("uid", f"uid-{next(self._uid)}")
+        self._bump(obj)
+        self.store[key] = obj
+        return copy.deepcopy(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        key = (kind, namespace, name)
+        if key not in self.store:
+            raise NotFound(f"{kind} {namespace}/{name}")
+        obj = self.store[key]
+        finalizers = obj["metadata"].get("finalizers") or []
+        if finalizers:
+            # Mirror apiserver semantics: finalized objects linger with a
+            # deletionTimestamp until finalizers are stripped.
+            if not obj["metadata"].get("deletionTimestamp"):
+                obj["metadata"]["deletionTimestamp"] = "now"
+                self._bump(obj)
+            return
+        del self.store[key]
+        self._cascade(namespace, name)
+
+    def _cascade(self, namespace: str, owner_name: str) -> None:
+        """Garbage-collect owned objects (apiserver GC behavior the
+        reference relies on for Owns() cleanup)."""
+        for key in [k for k, o in list(self.store.items())
+                    if k[1] == namespace and self.controller_of(o) == owner_name]:
+            obj = self.store[key]
+            if not obj["metadata"].get("finalizers"):
+                del self.store[key]
+
+    def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        key = self._key(kind, obj)
+        if key not in self.store:
+            raise NotFound(f"{kind} {key[1]}/{key[2]}")
+        cur = self.store[key]
+        if obj["metadata"].get("resourceVersion") != cur["metadata"].get("resourceVersion"):
+            raise Conflict(f"{kind} {key[2]}: resourceVersion mismatch")
+        obj = copy.deepcopy(obj)
+        # Status is a subresource: full-object update cannot change it.
+        if "status" in cur:
+            obj["status"] = copy.deepcopy(cur["status"])
+        # Finalizer removal completes a pending delete.
+        if cur["metadata"].get("deletionTimestamp"):
+            obj["metadata"]["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
+            if not obj["metadata"].get("finalizers"):
+                del self.store[key]
+                self._cascade(key[1], key[2])
+                return obj
+        self._bump(obj)
+        self.store[key] = obj
+        return copy.deepcopy(obj)
+
+    def update_status(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
+        key = self._key(kind, obj)
+        if key not in self.store:
+            raise NotFound(f"{kind} {key[1]}/{key[2]}")
+        cur = self.store[key]
+        if obj["metadata"].get("resourceVersion") != cur["metadata"].get("resourceVersion"):
+            raise Conflict(f"{kind} {key[2]}: resourceVersion mismatch")
+        cur["status"] = copy.deepcopy(obj.get("status", {}))
+        self._bump(cur)
+        return copy.deepcopy(cur)
+
+    def record_event(self, obj: Dict[str, Any], event_type: str, reason: str,
+                    message: str) -> None:
+        self.events.append({
+            "object": f'{obj.get("kind","?")}/{obj["metadata"]["name"]}',
+            "type": event_type, "reason": reason, "message": message,
+        })
+
+
+class FakeFleet:
+    """Drives pod lifecycle the way kubelet would (status only — the fake
+    apiserver has no kubelet, same as envtest)."""
+
+    def __init__(self, api: FakeAPI, namespace: str = "default") -> None:
+        self.api = api
+        self.namespace = namespace
+        self._ip = itertools.count(1)
+
+    def _pods(self) -> List[Tuple[Tuple[str, str, str], Dict[str, Any]]]:
+        return [(k, o) for k, o in sorted(self.api.store.items())
+                if k[0] == "Pod" and k[1] == self.namespace]
+
+    def schedule_all(self) -> None:
+        """Assign IPs and move Pending pods to Pending-with-IP (scheduled)."""
+        for _, pod in self._pods():
+            st = pod.setdefault("status", {})
+            st.setdefault("phase", "Pending")
+            if not st.get("podIP"):
+                st["podIP"] = f"10.1.0.{next(self._ip)}"
+
+    def run_all(self) -> None:
+        """Flip every pod to a fully-ready Running state."""
+        self.schedule_all()
+        for _, pod in self._pods():
+            st = pod["status"]
+            st["phase"] = "Running"
+            st["containerStatuses"] = [
+                {"name": c.get("name", "main"), "ready": True,
+                 "state": {"running": {}}}
+                for c in pod.get("spec", {}).get("containers", [])
+            ]
+
+    def set_phase(self, pod_name: str, phase: str) -> None:
+        key = ("Pod", self.namespace, pod_name)
+        pod = self.api.store[key]
+        st = pod.setdefault("status", {})
+        st["phase"] = phase
+        if phase in ("Succeeded", "Failed"):
+            st["containerStatuses"] = []
+
+    def fail(self, pod_name: str) -> None:
+        self.set_phase(pod_name, "Failed")
+
+    def succeed_all(self) -> None:
+        for (_, _, name), _ in self._pods():
+            self.set_phase(name, "Succeeded")

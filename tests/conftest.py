@@ -1,0 +1,19 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) so that every multi-chip
+sharding path (dp/fsdp/tp/pp/cp) is exercised without TPU hardware — the same
+idea as the reference's envtest strategy (controllers/suite_test.go:51-89):
+a headless stand-in that fully exercises the control logic.
+
+Must run before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

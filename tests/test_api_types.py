@@ -1,0 +1,135 @@
+"""Unit tests for the TPUJob API types (reference has none for api/v1 —
+SURVEY.md §4 calls for table-driven unit tests on the pure layers)."""
+
+import pytest
+
+from paddle_operator_tpu.api import (
+    CleanPodPolicy,
+    Intranet,
+    MeshSpec,
+    ResourceSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+    crd_yaml,
+    generate_crd,
+)
+
+
+def make_job(**kw) -> TPUJob:
+    spec = TPUJobSpec(
+        worker=ResourceSpec(
+            replicas=2,
+            template={"spec": {"containers": [{"name": "t", "image": "img"}]}},
+        ),
+        **kw,
+    )
+    return TPUJob(name="j1", namespace="ns", spec=spec)
+
+
+class TestTPUSpec:
+    @pytest.mark.parametrize(
+        "topo,chips",
+        [("2x4", 8), ("4x8", 32), ("2x2x2", 8), ("1x1", 1), ("8x16", 128)],
+    )
+    def test_chips_per_slice(self, topo, chips):
+        assert TPUSpec(topology=topo).chips_per_slice() == chips
+
+    def test_bad_topology(self):
+        with pytest.raises(ValueError):
+            TPUSpec(topology="4by8").chips_per_slice()
+
+    @pytest.mark.parametrize(
+        "topo,cpw,workers",
+        [("2x4", 4, 2), ("4x8", 4, 8), ("1x1", 4, 1), ("2x2", 4, 1)],
+    )
+    def test_workers_per_slice(self, topo, cpw, workers):
+        assert TPUSpec(topology=topo, chips_per_worker=cpw).workers_per_slice() == workers
+
+
+class TestMeshSpec:
+    def test_size(self):
+        assert MeshSpec(dp=2, fsdp=4, tp=4).size() == 32
+
+    def test_roundtrip(self):
+        m = MeshSpec(dp=2, tp=4, cp=2)
+        assert MeshSpec.from_dict(m.to_dict()) == m
+
+    def test_default_axes_omitted(self):
+        assert MeshSpec(dp=2).to_dict() == {"dp": 2}
+
+
+class TestSerde:
+    def test_job_roundtrip(self):
+        job = make_job(
+            clean_pod_policy=CleanPodPolicy.ON_COMPLETION,
+            intranet=Intranet.SERVICE,
+            tpu=TPUSpec(topology="2x4", slice_count=1),
+            mesh=MeshSpec(dp=2, tp=4),
+            max_restarts=3,
+            checkpoint_path="gs://b/ckpt",
+        )
+        job.spec.ps = ResourceSpec(replicas=2, requests=1, limits=4)
+        d = job.to_dict()
+        back = TPUJob.from_dict(d)
+        assert back.to_dict() == d
+        assert back.spec.mesh.size() == 8
+        assert back.spec.ps.limits == 4
+
+    def test_api_version(self):
+        d = make_job().to_dict()
+        assert d["apiVersion"] == "batch.tpujob.dev/v1"
+        assert d["kind"] == "TPUJob"
+
+    def test_status_roundtrip(self):
+        job = make_job()
+        job.status.phase = "Running"
+        job.status.worker.running = 2
+        job.status.worker.refs = [{"kind": "Pod", "name": "j1-worker-0"}]
+        back = TPUJob.from_dict(job.to_dict())
+        assert back.status.worker.running == 2
+        assert back.status.worker.refs[0]["name"] == "j1-worker-0"
+
+
+class TestValidation:
+    def test_valid(self):
+        job = make_job(tpu=TPUSpec(topology="2x4"), mesh=MeshSpec(dp=2, tp=4))
+        assert job.validate() == []
+
+    def test_mesh_mismatch(self):
+        job = make_job(tpu=TPUSpec(topology="2x4"), mesh=MeshSpec(dp=2, tp=8))
+        assert any("mesh axes product" in e for e in job.validate())
+
+    def test_worker_count_mismatch(self):
+        job = make_job(tpu=TPUSpec(topology="4x8"))  # needs 8 workers, has 2
+        assert any("does not match topology" in e for e in job.validate())
+
+    def test_requests_over_limits(self):
+        job = make_job()
+        job.spec.worker.requests = 5
+        job.spec.worker.limits = 2
+        assert any("requests > limits" in e for e in job.validate())
+
+    def test_negative_replicas(self):
+        job = make_job()
+        job.spec.worker.replicas = -1
+        assert any("replicas" in e for e in job.validate())
+
+
+class TestCRD:
+    def test_generate(self):
+        crd = generate_crd()
+        assert crd["metadata"]["name"] == "tpujobs.batch.tpujob.dev"
+        v = crd["spec"]["versions"][0]
+        assert v["subresources"] == {"status": {}}
+        cols = [c["name"] for c in v["additionalPrinterColumns"]]
+        assert cols[:4] == ["Status", "Mode", "PS", "Worker"]
+        spec_props = v["schema"]["openAPIV3Schema"]["properties"]["spec"]["properties"]
+        for k in ("ps", "worker", "heter", "tpu", "mesh", "cleanPodPolicy",
+                  "intranet", "maxRestarts"):
+            assert k in spec_props
+
+    def test_yaml_parses(self):
+        import yaml
+
+        assert yaml.safe_load(crd_yaml())["kind"] == "CustomResourceDefinition"

@@ -1,0 +1,266 @@
+"""Unit tests for the pure builders — the layer the reference left untested
+(SURVEY.md §4: 'no unit tests for the pure helpers')."""
+
+import pytest
+
+from paddle_operator_tpu.api import (
+    Intranet,
+    JobMode,
+    MeshSpec,
+    Phase,
+    ResourceSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from paddle_operator_tpu.api.types import COORDINATOR_PORT, HOSTPORT_ANNOTATION
+from paddle_operator_tpu.controller import builders as B
+
+
+def worker_template():
+    return {"spec": {"containers": [{"name": "main", "image": "jax:latest",
+                                     "command": ["python", "train.py"]}]}}
+
+
+def make_job(ps=0, workers=2, intranet="", tpu=None, mesh=None, **kw):
+    spec = TPUJobSpec(intranet=intranet, tpu=tpu, mesh=mesh, **kw)
+    if workers:
+        spec.worker = ResourceSpec(replicas=workers, template=worker_template())
+    if ps:
+        spec.ps = ResourceSpec(replicas=ps, template=worker_template())
+    return TPUJob(name="job", namespace="ns", spec=spec)
+
+
+def fake_pod(name, ip="10.0.0.1", phase="Running"):
+    return {
+        "metadata": {"name": name, "namespace": "ns"},
+        "status": {"phase": phase, "podIP": ip},
+    }
+
+
+class TestNaming:
+    @pytest.mark.parametrize("t,i", [("worker", 0), ("ps", 3), ("heter", 12)])
+    def test_roundtrip(self, t, i):
+        assert B.extract_name_index(B.gen_res_name("my-job", t, i)) == (t, i)
+
+    def test_bad_name(self):
+        assert B.extract_name_index("nonsense") == ("", 0)
+
+
+class TestModePhase:
+    def test_modes(self):
+        assert B.get_job_mode(make_job(ps=2, workers=2)) == JobMode.PS
+        assert B.get_job_mode(make_job(workers=4)) == JobMode.COLLECTIVE
+        assert B.get_job_mode(make_job(workers=1)) == JobMode.SINGLE
+        multislice = make_job(workers=1, tpu=TPUSpec(topology="2x2", slice_count=2))
+        assert B.get_job_mode(multislice) == JobMode.COLLECTIVE
+
+    def test_phase_terminal_sticky(self):
+        job = make_job()
+        job.status.phase = Phase.COMPLETED
+        job.status.worker.failed = 1
+        assert B.get_job_phase(job) == Phase.COMPLETED
+
+    def test_phase_failed(self):
+        job = make_job()
+        job.status.worker.failed = 1
+        assert B.get_job_phase(job) == Phase.FAILED
+
+    def test_phase_restarting_under_max_restarts(self):
+        job = make_job(max_restarts=2)
+        job.status.worker.failed = 1
+        assert B.get_job_phase(job) == Phase.RESTARTING
+        job.status.restart_count = 2
+        assert B.get_job_phase(job) == Phase.FAILED
+
+    def test_phase_running(self):
+        job = make_job()
+        job.status.worker.running = 1
+        assert B.get_job_phase(job) == Phase.RUNNING
+
+    def test_phase_completed(self):
+        job = make_job(workers=2)
+        job.status.worker.succeeded = 2
+        assert B.get_job_phase(job) == Phase.COMPLETED
+
+    def test_phase_pending_then_starting(self):
+        job = make_job()
+        job.status.worker.pending = 1
+        assert B.get_job_phase(job) == Phase.PENDING
+        job.status.worker.pending = 0
+        assert B.get_job_phase(job) == Phase.STARTING
+
+    def test_times(self):
+        job = make_job()
+        job.status.phase = Phase.RUNNING
+        assert B.get_start_time(job, "T1") == "T1"
+        job.status.start_time = "T0"
+        assert B.get_start_time(job, "T1") == "T0"
+        job.status.phase = Phase.FAILED
+        assert B.get_completion_time(job, "T2") == "T2"
+
+
+class TestConfigMap:
+    def pods(self, job):
+        out = []
+        for i in range(job.spec.worker.replicas if job.spec.worker else 0):
+            out.append(fake_pod(f"job-worker-{i}", ip=f"10.0.0.{i+1}"))
+        for i in range(job.spec.ps.replicas if job.spec.ps else 0):
+            out.append(fake_pod(f"job-ps-{i}", ip=f"10.0.1.{i+1}"))
+        return out
+
+    def test_barrier_missing_ip(self):
+        job = make_job(workers=2)
+        pods = self.pods(job)
+        pods[1]["status"]["podIP"] = ""
+        assert B.construct_configmap(job, pods) is None
+
+    def test_barrier_missing_pod(self):
+        job = make_job(workers=3)
+        assert B.construct_configmap(job, self.pods(make_job(workers=2))) is None
+
+    def test_collective_env(self):
+        job = make_job(workers=2)
+        cm = B.construct_configmap(job, self.pods(job))
+        d = cm["data"]
+        assert d["TPUJOB_WORKER_HOSTS"] == "10.0.0.1,10.0.0.2"
+        assert d["TPUJOB_NUM_WORKERS"] == "2"
+        assert d["TPUJOB_COORDINATOR_ADDRESS"] == f"10.0.0.1:{COORDINATOR_PORT}"
+        assert "TPUJOB_PS_ENDPOINTS" not in d
+
+    def test_service_mode_uses_names(self):
+        job = make_job(workers=2, intranet=Intranet.SERVICE)
+        cm = B.construct_configmap(job, self.pods(job))
+        assert cm["data"]["TPUJOB_WORKER_HOSTS"] == "job-worker-0,job-worker-1"
+
+    def test_ps_endpoints(self):
+        job = make_job(ps=2, workers=2)
+        cm = B.construct_configmap(job, self.pods(job))
+        assert cm["data"]["TPUJOB_PS_ENDPOINTS"] == (
+            f"10.0.1.1:{COORDINATOR_PORT},10.0.1.2:{COORDINATOR_PORT}"
+        )
+
+    def test_multislice_megascale(self):
+        tpu = TPUSpec(topology="2x2", slice_count=2, chips_per_worker=4)
+        job = make_job(workers=2, tpu=tpu)
+        cm = B.construct_configmap(job, self.pods(job))
+        d = cm["data"]
+        assert d["MEGASCALE_NUM_SLICES"] == "2"
+        assert d["MEGASCALE_COORDINATOR_ADDRESS"].startswith("10.0.0.1:")
+        assert d["TPUJOB_WORKERS_PER_SLICE"] == "1"
+
+    def test_single_slice_no_megascale(self):
+        job = make_job(workers=2, tpu=TPUSpec(topology="2x4"))
+        cm = B.construct_configmap(job, self.pods(job))
+        assert "MEGASCALE_NUM_SLICES" not in cm["data"]
+
+    def test_mesh_and_ckpt_env(self):
+        job = make_job(workers=2, mesh=MeshSpec(dp=2, tp=4),
+                       checkpoint_path="gs://b/ck", max_restarts=2)
+        cm = B.construct_configmap(job, self.pods(job))
+        assert '"dp": 2' in cm["data"]["TPUJOB_MESH"]
+        assert cm["data"]["TPUJOB_CHECKPOINT_PATH"] == "gs://b/ck"
+        assert cm["data"]["TPUJOB_MAX_RESTARTS"] == "2"
+
+    def test_hostport_annotation_port(self):
+        job = make_job(workers=2, intranet=Intranet.HOST)
+        job.annotations[HOSTPORT_ANNOTATION] = "35020"
+        cm = B.construct_configmap(job, self.pods(job))
+        assert cm["data"]["TPUJOB_PORT"] == "35020"
+        assert cm["data"]["TPUJOB_COORDINATOR_ADDRESS"].endswith(":35020")
+
+
+class TestPod:
+    def env_map(self, pod):
+        return {e["name"]: e.get("value") for e in pod["spec"]["containers"][0]["env"]}
+
+    def test_basic_worker(self):
+        job = make_job(workers=2)
+        pod = B.construct_pod(job, "worker", 1)
+        assert pod["metadata"]["name"] == "job-worker-1"
+        assert pod["metadata"]["labels"]["tpujob-res-type"] == "worker"
+        env = self.env_map(pod)
+        assert env["TPUJOB_RANK"] == "1"
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TRAINING_ROLE"] == "TRAINER"
+        ef = pod["spec"]["containers"][0]["envFrom"]
+        assert ef[0]["configMapRef"]["name"] == "job"
+
+    def test_ps_role(self):
+        job = make_job(ps=1, workers=1)
+        pod = B.construct_pod(job, "ps", 0)
+        assert self.env_map(pod)["TPUJOB_ROLE"] == "PSERVER"
+        assert "resources" not in pod["spec"]["containers"][0] or \
+            "google.com/tpu" not in pod["spec"]["containers"][0].get(
+                "resources", {}).get("limits", {})
+
+    def test_tpu_placement(self):
+        tpu = TPUSpec(accelerator="tpu-v5p-slice", topology="4x8",
+                      chips_per_worker=4)
+        job = make_job(workers=8, tpu=tpu)
+        pod = B.construct_pod(job, "worker", 5)
+        res = pod["spec"]["containers"][0]["resources"]
+        assert res["limits"]["google.com/tpu"] == 4
+        sel = pod["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5p-slice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "4x8"
+
+    def test_slice_local_worker_id(self):
+        tpu = TPUSpec(topology="2x4", slice_count=2, chips_per_worker=4)  # 2 workers/slice
+        job = make_job(workers=4, tpu=tpu)
+        env = self.env_map(B.construct_pod(job, "worker", 3))
+        assert env["TPUJOB_RANK"] == "3"
+        assert env["TPU_WORKER_ID"] == "1"       # worker 1 within slice 1
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+
+    def test_service_mode(self):
+        job = make_job(workers=2, intranet=Intranet.SERVICE)
+        pod = B.construct_pod(job, "worker", 0)
+        env = self.env_map(pod)
+        assert env["POD_IP"] == "job-worker-0"
+        assert pod["spec"]["restartPolicy"] == "OnFailure"
+        assert pod["spec"]["containers"][0]["ports"][0]["containerPort"] == COORDINATOR_PORT
+
+    def test_podip_mode_downward_api(self):
+        pod = B.construct_pod(make_job(workers=2), "worker", 0)
+        ip_env = [e for e in pod["spec"]["containers"][0]["env"]
+                  if e["name"] == "POD_IP"][0]
+        assert ip_env["valueFrom"]["fieldRef"]["fieldPath"] == "status.podIP"
+        assert pod["spec"]["restartPolicy"] == "Never"
+
+    def test_host_network(self):
+        job = make_job(workers=2, intranet=Intranet.HOST)
+        pod = B.construct_pod(job, "worker", 0)
+        assert pod["spec"]["hostNetwork"] is True
+
+    def test_scheduler_name(self):
+        job = make_job(workers=2, scheduler_name="volcano")
+        pod = B.construct_pod(job, "worker", 0)
+        assert pod["spec"]["schedulerName"] == "volcano"
+        assert pod["metadata"]["labels"]["tpujob-gang"] == "job"
+
+    def test_template_not_mutated(self):
+        job = make_job(workers=2)
+        before = repr(job.spec.worker.template)
+        B.construct_pod(job, "worker", 0)
+        assert repr(job.spec.worker.template) == before
+
+    def test_user_env_preserved(self):
+        job = make_job(workers=1)
+        job.spec.worker.template["spec"]["containers"][0]["env"] = [
+            {"name": "MY_VAR", "value": "x"}]
+        env = self.env_map(B.construct_pod(job, "worker", 0))
+        assert env["MY_VAR"] == "x"
+
+
+class TestService:
+    def test_headless(self):
+        pod = fake_pod("job-worker-0")
+        svc = B.construct_service_for_pod(pod)
+        assert svc["spec"]["clusterIP"] == "None"
+        assert svc["spec"]["selector"]["tpujob-res-name"] == "job-worker-0"
+        ports = [p["port"] for p in svc["spec"]["ports"]]
+        assert ports[0] == COORDINATOR_PORT and len(ports) == 8
+
+    def test_gen_endpoints(self):
+        assert B.gen_endpoints("j", "worker", 2, 1234) == "j-worker-0:1234,j-worker-1:1234"

@@ -1,0 +1,337 @@
+"""Integration tests: reconciler against the fake apiserver + fake fleet.
+
+Mirrors and extends the reference's single behavioral spec
+(controllers/paddlejob_controller_test.go:32-113 — PS-mode job with Service
+intranet, scale up and down), plus the paths the reference leaves untested:
+pod phase transitions, the ConfigMap barrier, clean-pod policies, host-port
+lifecycle, and the restart path.
+"""
+
+import pytest
+
+from paddle_operator_tpu.api import (
+    CleanPodPolicy,
+    Intranet,
+    JobMode,
+    Phase,
+    ResourceSpec,
+    TPUJob,
+    TPUJobSpec,
+    TPUSpec,
+)
+from paddle_operator_tpu.api.types import HOSTPORT_ANNOTATION
+from paddle_operator_tpu.controller.fake_api import FakeAPI, FakeFleet
+from paddle_operator_tpu.controller.hostport import PyHostPortAllocator
+from paddle_operator_tpu.controller.reconciler import (
+    KIND_CM,
+    KIND_JOB,
+    KIND_POD,
+    KIND_SVC,
+    TPUJobReconciler,
+    run_to_settled,
+)
+
+NS = "default"
+
+
+def template():
+    return {"spec": {"containers": [{"name": "main", "image": "jax:latest"}]}}
+
+
+def submit(api, name="tj", ps=0, workers=2, intranet="", **kw) -> TPUJob:
+    spec = TPUJobSpec(intranet=intranet, **kw)
+    if workers:
+        spec.worker = ResourceSpec(replicas=workers, template=template())
+    if ps:
+        spec.ps = ResourceSpec(replicas=ps, template=template())
+    job = TPUJob(name=name, namespace=NS, spec=spec)
+    api.create(KIND_JOB, job.to_dict())
+    return job
+
+
+@pytest.fixture()
+def env():
+    api = FakeAPI()
+    rec = TPUJobReconciler(api, allocator=PyHostPortAllocator())
+    fleet = FakeFleet(api, NS)
+    return api, rec, fleet
+
+
+def drive(api, rec, fleet, name="tj"):
+    """Reconcile → let the fleet run pods → reconcile to settled."""
+    run_to_settled(rec, NS, name)
+    fleet.run_all()
+    run_to_settled(rec, NS, name)
+
+
+def job_status(api, name="tj"):
+    return TPUJob.from_dict(api.get(KIND_JOB, NS, name)).status
+
+
+class TestCollectiveLifecycle:
+    def test_pods_then_configmap(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2)
+        run_to_settled(rec, NS, "tj")
+        pods = api.list_owned(KIND_POD, NS, "tj")
+        assert sorted(p["metadata"]["name"] for p in pods) == [
+            "tj-worker-0", "tj-worker-1"]
+        # barrier: no configmap until pods have IPs
+        assert (KIND_CM, NS, "tj") not in api.store
+        fleet.run_all()
+        run_to_settled(rec, NS, "tj")
+        cm = api.get(KIND_CM, NS, "tj")
+        assert cm["data"]["TPUJOB_NUM_WORKERS"] == "2"
+        assert job_status(api).phase == Phase.RUNNING
+        assert job_status(api).mode == JobMode.COLLECTIVE
+        assert job_status(api).worker.ready == "2/2"
+
+    def test_gang_creation_single_pass(self, env):
+        api, rec, fleet = env
+        submit(api, workers=4)
+        rec.reconcile(NS, "tj")   # adds finalizer
+        rec.reconcile(NS, "tj")   # creates the whole gang at once
+        assert len(api.list_owned(KIND_POD, NS, "tj")) == 4
+
+    def test_completion_default_policy_cleans(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2)
+        drive(api, rec, fleet)
+        fleet.succeed_all()
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.COMPLETED
+        assert api.list_owned(KIND_POD, NS, "tj") == []
+        assert job_status(api).completion_time
+
+    def test_completion_never_policy_keeps_pods(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, clean_pod_policy=CleanPodPolicy.NEVER)
+        drive(api, rec, fleet)
+        fleet.succeed_all()
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.COMPLETED
+        assert len(api.list_owned(KIND_POD, NS, "tj")) == 2
+
+    def test_failure_marks_job_failed(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, clean_pod_policy=CleanPodPolicy.NEVER)
+        drive(api, rec, fleet)
+        fleet.fail("tj-worker-1")
+        run_to_settled(rec, NS, "tj")
+        st = job_status(api)
+        assert st.phase == Phase.FAILED
+        assert st.worker.failed == 1
+
+    def test_failure_with_cleanup(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, clean_pod_policy=CleanPodPolicy.ON_FAILURE)
+        drive(api, rec, fleet)
+        fleet.fail("tj-worker-0")
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.FAILED
+        assert api.list_owned(KIND_POD, NS, "tj") == []
+
+
+class TestPSMode:
+    """The reference's behavioral spec: 3 PS + 2 workers, Service intranet,
+    then scale to 1 PS / 4 workers (paddlejob_controller_test.go:58-109)."""
+
+    def test_ps_service_lifecycle_and_scale(self, env):
+        api, rec, fleet = env
+        submit(api, ps=3, workers=2, intranet=Intranet.SERVICE)
+        drive(api, rec, fleet)
+
+        st = job_status(api)
+        assert st.mode == JobMode.PS
+        assert len(st.ps.refs) == 3 and len(st.worker.refs) == 2
+        assert len(api.list_owned(KIND_SVC, NS, "tj")) == 5
+        cm = api.get(KIND_CM, NS, "tj")
+        # Service mode rendezvous uses stable pod/service names
+        assert cm["data"]["TPUJOB_WORKER_HOSTS"] == "tj-worker-0,tj-worker-1"
+        assert cm["data"]["TPUJOB_PS_ENDPOINTS"].startswith("tj-ps-0:")
+
+        # scale: 3->1 PS, 2->4 workers
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["ps"]["replicas"] = 1
+        raw["spec"]["worker"]["replicas"] = 4
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+
+        pods = sorted(p["metadata"]["name"]
+                      for p in api.list_owned(KIND_POD, NS, "tj"))
+        assert pods == ["tj-ps-0", "tj-worker-0", "tj-worker-1",
+                        "tj-worker-2", "tj-worker-3"]
+        st = job_status(api)
+        assert len(st.ps.refs) == 1 and len(st.worker.refs) == 4
+
+        # improvement over the reference: the ConfigMap is regenerated
+        cm = api.get(KIND_CM, NS, "tj")
+        assert cm["data"]["TPUJOB_NUM_WORKERS"] == "4"
+        assert "tj-worker-3" in cm["data"]["TPUJOB_WORKER_HOSTS"]
+
+
+class TestHostNetwork:
+    def test_hostport_alloc_and_release(self, env):
+        api, rec, fleet = env
+        alloc = rec.allocator
+        submit(api, workers=2, intranet=Intranet.HOST)
+        drive(api, rec, fleet)
+
+        raw = api.get(KIND_JOB, NS, "tj")
+        base = int(raw["metadata"]["annotations"][HOSTPORT_ANNOTATION])
+        assert alloc.in_use(base)
+        cm = api.get(KIND_CM, NS, "tj")
+        assert cm["data"]["TPUJOB_PORT"] == str(base)
+        pod = api.get(KIND_POD, NS, "tj-worker-0")
+        assert pod["spec"]["hostNetwork"] is True
+
+        # delete → finalizer releases the block
+        api.delete(KIND_JOB, NS, "tj")
+        run_to_settled(rec, NS, "tj")
+        assert not alloc.in_use(base)
+        assert (KIND_JOB, NS, "tj") not in api.store
+
+    def test_adopt_after_controller_restart(self, env):
+        api, rec, fleet = env
+        submit(api, workers=1, intranet=Intranet.HOST)
+        drive(api, rec, fleet)
+        base = int(api.get(KIND_JOB, NS, "tj")["metadata"]["annotations"][
+            HOSTPORT_ANNOTATION])
+
+        # new reconciler == controller restart with empty port map
+        rec2 = TPUJobReconciler(api, allocator=PyHostPortAllocator())
+        run_to_settled(rec2, NS, "tj")
+        assert rec2.allocator.in_use(base)
+
+
+class TestRestart:
+    def test_restart_recreates_gang_and_counts(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, max_restarts=2,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4))
+        drive(api, rec, fleet)
+        assert job_status(api).phase == Phase.RUNNING
+
+        fleet.fail("tj-worker-0")
+        run_to_settled(rec, NS, "tj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "tj")
+
+        st = job_status(api)
+        assert st.restart_count == 1
+        assert st.phase == Phase.RUNNING
+        pods = sorted(p["metadata"]["name"]
+                      for p in api.list_owned(KIND_POD, NS, "tj"))
+        assert pods == ["tj-worker-0", "tj-worker-1"]   # same ranks
+
+    def test_restart_budget_exhausted(self, env):
+        api, rec, fleet = env
+        submit(api, workers=1, max_restarts=1,
+               clean_pod_policy=CleanPodPolicy.NEVER)
+        drive(api, rec, fleet)
+        for _ in range(2):
+            fleet.fail("tj-worker-0")
+            run_to_settled(rec, NS, "tj")
+            fleet.run_all()
+            run_to_settled(rec, NS, "tj")
+        st = job_status(api)
+        assert st.restart_count == 1
+        assert st.phase == Phase.FAILED
+
+
+class TestElastic:
+    def test_replicas_clamped_to_limits(self, env):
+        api, rec, fleet = env
+        job = submit(api, workers=2)
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 10
+        raw["spec"]["worker"]["limits"] = 3
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        assert len(api.list_owned(KIND_POD, NS, "tj")) == 3
+
+
+class TestEvents:
+    def test_create_events_recorded(self, env):
+        api, rec, fleet = env
+        submit(api, workers=1)
+        drive(api, rec, fleet)
+        reasons = {e["reason"] for e in api.events}
+        assert "Created" in reasons
+
+
+class TestHeter:
+    """The reference defines heter but never reconciles it (dead
+    scaffolding, SURVEY.md §2 C2); here it is a live role."""
+
+    def test_heter_pods_created_and_counted(self, env):
+        api, rec, fleet = env
+        job = submit(api, workers=2)
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["heter"] = {"replicas": 2, "template": template()}
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        pods = sorted(p["metadata"]["name"]
+                      for p in api.list_owned(KIND_POD, NS, "tj"))
+        assert pods == ["tj-heter-0", "tj-heter-1", "tj-worker-0", "tj-worker-1"]
+        st = job_status(api)
+        assert st.heter.ready == "2/2"
+        cm = api.get(KIND_CM, NS, "tj")
+        assert cm["data"]["TPUJOB_HETER_ENDPOINTS"].count(",") == 1
+
+    def test_heter_failure_fails_job(self, env):
+        api, rec, fleet = env
+        submit(api, workers=1, clean_pod_policy=CleanPodPolicy.NEVER)
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["heter"] = {"replicas": 1, "template": template()}
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        fleet.fail("tj-heter-0")
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.FAILED
+
+
+class TestElasticCompletion:
+    def test_clamped_job_completes(self, env):
+        """Regression: with replicas=10 clamped to limits=3, the job must
+        reach COMPLETED when the 3 effective pods succeed (ready 3/3)."""
+        api, rec, fleet = env
+        submit(api, workers=2)
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 10
+        raw["spec"]["worker"]["limits"] = 3
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        st = job_status(api)
+        assert st.worker.ready == "3/3"
+        assert st.elastic == "DOING"
+        fleet.succeed_all()
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.COMPLETED
+
+
+class TestScaleDownServices:
+    def test_services_pruned_with_pods(self, env):
+        api, rec, fleet = env
+        submit(api, workers=3, intranet=Intranet.SERVICE)
+        drive(api, rec, fleet)
+        assert len(api.list_owned(KIND_SVC, NS, "tj")) == 3
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 1
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        svcs = [s["metadata"]["name"] for s in api.list_owned(KIND_SVC, NS, "tj")]
+        assert svcs == ["tj-worker-0"]
+
+
+class TestPortExhaustion:
+    def test_exhaustion_emits_event_not_crash(self, env):
+        api, _, fleet = env
+        rec = TPUJobReconciler(api, allocator=PyHostPortAllocator(35000, 35008, 8))
+        submit(api, name="a", workers=1, intranet=Intranet.HOST)
+        submit(api, name="b", workers=1, intranet=Intranet.HOST)
+        run_to_settled(rec, NS, "a")
+        rec.reconcile(NS, "b")
+        rec.reconcile(NS, "b")  # allocator empty -> event, no crash
+        reasons = {e["reason"] for e in api.events}
+        assert "PortExhausted" in reasons
