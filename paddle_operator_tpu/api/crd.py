@@ -110,6 +110,7 @@ def _status_schema() -> Dict[str, Any]:
             "mode": {"type": "string"},
             "ps": _resource_status_schema(),
             "worker": _resource_status_schema(),
+            "heter": _resource_status_schema(),
             "elastic": {"type": "string"},
             "startTime": {"type": "string", "format": "date-time"},
             "completionTime": {"type": "string", "format": "date-time"},

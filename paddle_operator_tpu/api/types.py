@@ -141,14 +141,21 @@ class TPUSpec:
             n *= int(d)
         return n
 
+    def effective_chips_per_worker(self) -> int:
+        """chips_per_worker clamped to the slice size, so a 1-chip slice
+        with the default 4-chip hosts yields a 1-chip pod request rather
+        than an unschedulable one."""
+        return min(self.chips_per_worker, self.chips_per_slice())
+
     def workers_per_slice(self) -> int:
         chips = self.chips_per_slice()
-        if chips % self.chips_per_worker and chips > self.chips_per_worker:
+        cpw = self.effective_chips_per_worker()
+        if chips % cpw:
             raise ValueError(
                 f"topology {self.topology} ({chips} chips) not divisible by "
-                f"chips_per_worker={self.chips_per_worker}"
+                f"chips_per_worker={cpw}"
             )
-        return max(1, chips // self.chips_per_worker)
+        return chips // cpw
 
     def to_dict(self) -> Dict[str, Any]:
         return {

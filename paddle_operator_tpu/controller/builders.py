@@ -393,9 +393,10 @@ def construct_pod(job: TPUJob, res_type: str, idx: int) -> Dict[str, Any]:
     # --- TPU placement ----------------------------------------------------
     tpu = job.spec.tpu
     if tpu is not None and res_type == RESOURCE_WORKER:
+        chips = tpu.effective_chips_per_worker()
         resources = c0.setdefault("resources", {})
-        resources.setdefault("limits", {})["google.com/tpu"] = tpu.chips_per_worker
-        resources.setdefault("requests", {})["google.com/tpu"] = tpu.chips_per_worker
+        resources.setdefault("limits", {})["google.com/tpu"] = chips
+        resources.setdefault("requests", {})["google.com/tpu"] = chips
         sel = spec.setdefault("nodeSelector", {})
         sel.setdefault("cloud.google.com/gke-tpu-accelerator", tpu.accelerator)
         sel.setdefault("cloud.google.com/gke-tpu-topology", tpu.topology)

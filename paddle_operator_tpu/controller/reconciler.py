@@ -100,10 +100,10 @@ class TPUJobReconciler:
         # -- elastic clamp (improvement 4) ---------------------------------
         # Runs before the status sync so ready ratios, completion checks and
         # gang sizing all use the effective (clamped) replica counts.
-        elastic = self._clamp_elastic(job)
+        bounded = self._clamp_elastic(job)
 
         # -- status sync (reference controller.go:103-112) ----------------
-        new_status = self._current_status(job, child_pods, elastic)
+        new_status = self._current_status(job, child_pods, bounded)
         if new_status.to_dict() != job.status.to_dict():
             job.status = new_status
             try:
@@ -248,10 +248,9 @@ class TPUJobReconciler:
         return True
 
     def _current_status(self, job: TPUJob, child_pods: List[Dict[str, Any]],
-                        elastic: str = "") -> TPUJobStatus:
+                        bounded: bool = False) -> TPUJobStatus:
         """Reference getCurrentStatus (controller.go:238-294)."""
         status = TPUJobStatus(
-            elastic=elastic or job.status.elastic,
             restart_count=job.status.restart_count,
             observed_generation=job.generation,
         )
@@ -304,6 +303,18 @@ class TPUJobReconciler:
                 f"{status.heter.running}/{job.spec.heter.replicas}"
             )
 
+        # Elastic status from *observed* state: DOING until the pod count
+        # matches the effective (clamped) replicas, DONE after; cleared
+        # when no bounds are set (the reference never implements this —
+        # ElasticStatus is dead scaffolding there, SURVEY.md §5).
+        if bounded:
+            want = sum(r.replicas for r in
+                       (job.spec.ps, job.spec.worker, job.spec.heter) if r)
+            status.elastic = (
+                ElasticStatus.DONE if len(child_pods) == want
+                else ElasticStatus.DOING
+            )
+
         # phase/mode/times derive from the *new* counters
         probe = job.deepcopy()
         probe.status = status
@@ -342,13 +353,14 @@ class TPUJobReconciler:
             pass
         return Result(requeue_after=1.0)
 
-    def _clamp_elastic(self, job: TPUJob) -> str:
+    def _clamp_elastic(self, job: TPUJob) -> bool:
         """Clamp each role's replicas into [requests, limits] on the
         in-memory job so every later computation (status, gang size,
         completion) uses the effective count; the stored spec keeps the
-        user's ask.  Returns the elastic status to report."""
+        user's ask.  Returns whether any role is elastically bounded (the
+        DOING/DONE distinction is made in _current_status from observed
+        pod counts, so it converges instead of sticking at DOING)."""
         bounded = False
-        clamped_any = False
         for role in (job.spec.ps, job.spec.worker, job.spec.heter):
             if role is None:
                 continue
@@ -357,13 +369,8 @@ class TPUJobReconciler:
             bounded = True
             lo = role.requests if role.requests is not None else 0
             hi = role.limits if role.limits is not None else role.replicas
-            clamped = min(max(role.replicas, lo), hi)
-            if clamped != role.replicas:
-                role.replicas = clamped
-                clamped_any = True
-        if clamped_any:
-            return ElasticStatus.DOING
-        return ElasticStatus.DONE if bounded else ""
+            role.replicas = min(max(role.replicas, lo), hi)
+        return bounded
 
     def _alloc_host_port(self, job: TPUJob) -> bool:
         """Annotate the job with a host-port block base (reference
@@ -392,9 +399,15 @@ class TPUJobReconciler:
             self.api.record_event(job.to_dict(), "Warning", "PortExhausted",
                                   str(e))
             return True  # requeue; blocks free up when jobs finish
-        job.annotations[HOSTPORT_ANNOTATION] = str(base)
+        # Persist ONLY the annotation, on a freshly-read object: job's
+        # in-memory spec may carry the elastic clamp, which must never be
+        # written back over the user's requested replicas.
         try:
-            self.api.update(KIND_JOB, job.to_dict())
+            raw = self.api.get(KIND_JOB, job.namespace, job.name)
+            raw["metadata"].setdefault("annotations", {})[
+                HOSTPORT_ANNOTATION] = str(base)
+            self.api.update(KIND_JOB, raw)
+            job.annotations[HOSTPORT_ANNOTATION] = str(base)
             self._adopted[key] = base
         except (Conflict, NotFound):
             self.allocator.release(base)

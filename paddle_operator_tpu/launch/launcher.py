@@ -1,0 +1,149 @@
+"""In-pod launcher: consume the injected rendezvous contract and bring up
+the distributed JAX runtime.
+
+The reference delegates this entirely to ``paddle.distributed.launch``
+inside user containers reading ``PADDLE_*`` env (SURVEY.md §3.3); our
+operator injects the TPU-native contract (controller/builders.py
+construct_configmap/construct_pod) and this module is the consumer:
+
+    env (TPUJOB_*, MEGASCALE_*, TPU_WORKER_ID)
+      → JobEnv.from_env()
+      → initialize()            # jax.distributed over the coordinator
+      → job_mesh()              # the Mesh every process agrees on
+
+Entry point inside a container::
+
+    python -m paddle_operator_tpu.launch.launcher -- python train.py ...
+    # or, programmatically:
+    from paddle_operator_tpu.launch import launcher
+    env = launcher.initialize()
+    mesh = launcher.job_mesh(env)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from paddle_operator_tpu.api.types import COORDINATOR_PORT, MeshSpec
+
+
+@dataclass
+class JobEnv:
+    """Parsed view of the env contract one pod sees."""
+
+    job_name: str = ""
+    rank: int = 0                    # global worker rank (TPUJOB_RANK)
+    worker_id: int = 0               # slice-local id (TPU_WORKER_ID)
+    slice_id: int = 0                # MEGASCALE_SLICE_ID
+    num_workers: int = 1
+    workers_per_slice: int = 1
+    num_slices: int = 1
+    coordinator_address: str = ""
+    worker_hosts: List[str] = field(default_factory=list)
+    ps_endpoints: List[str] = field(default_factory=list)
+    heter_endpoints: List[str] = field(default_factory=list)
+    role: str = "TRAINER"
+    port: int = COORDINATOR_PORT
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    topology: str = ""
+    accelerator: str = ""
+    checkpoint_path: str = ""
+    max_restarts: int = 0
+
+    @classmethod
+    def from_env(cls, environ=None) -> "JobEnv":
+        e = environ if environ is not None else os.environ
+        mesh_json = e.get("TPUJOB_MESH", "")
+        mesh = MeshSpec.from_dict(json.loads(mesh_json)) if mesh_json else MeshSpec()
+
+        def split(key: str) -> List[str]:
+            v = e.get(key, "")
+            return [s for s in v.split(",") if s]
+
+        return cls(
+            job_name=e.get("TPUJOB_NAME", ""),
+            rank=int(e.get("TPUJOB_RANK", 0)),
+            worker_id=int(e.get("TPU_WORKER_ID", 0)),
+            slice_id=int(e.get("MEGASCALE_SLICE_ID", 0)),
+            num_workers=int(e.get("TPUJOB_NUM_WORKERS", 1)),
+            workers_per_slice=int(e.get("TPUJOB_WORKERS_PER_SLICE", 1) or 1),
+            num_slices=int(e.get("TPUJOB_NUM_SLICES", 1) or 1),
+            coordinator_address=e.get("TPUJOB_COORDINATOR_ADDRESS", ""),
+            worker_hosts=split("TPUJOB_WORKER_HOSTS"),
+            ps_endpoints=split("TPUJOB_PS_ENDPOINTS"),
+            heter_endpoints=split("TPUJOB_HETER_ENDPOINTS"),
+            role=e.get("TPUJOB_ROLE", e.get("TRAINING_ROLE", "TRAINER")),
+            port=int(e.get("TPUJOB_PORT", COORDINATOR_PORT)),
+            mesh=mesh,
+            topology=e.get("TPUJOB_TOPOLOGY", ""),
+            accelerator=e.get("TPUJOB_ACCELERATOR", ""),
+            checkpoint_path=e.get("TPUJOB_CHECKPOINT_PATH", ""),
+            max_restarts=int(e.get("TPUJOB_MAX_RESTARTS", 0)),
+        )
+
+    def slice_local_hosts(self) -> List[str]:
+        """The hostnames of this pod's slice (what the TPU runtime wants as
+        TPU_WORKER_HOSTNAMES).  Derived rather than injected because the
+        job-wide ConfigMap cannot carry per-slice values."""
+        lo = self.slice_id * self.workers_per_slice
+        return self.worker_hosts[lo:lo + self.workers_per_slice]
+
+
+def initialize(env: Optional[JobEnv] = None, *, force: bool = False) -> JobEnv:
+    """``jax.distributed.initialize`` from the env contract.
+
+    No-ops for single-process jobs (the common local/dev case) unless
+    `force`.  Safe to call before any other jax API (required: distributed
+    init must precede backend init).
+    """
+    env = env or JobEnv.from_env()
+    if env.num_workers > 1 or force:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=env.coordinator_address,
+            num_processes=env.num_workers,
+            process_id=env.rank,
+        )
+        # Export the slice-local host list for the libtpu runtime.
+        hosts = env.slice_local_hosts()
+        if hosts:
+            os.environ.setdefault("TPU_WORKER_HOSTNAMES", ",".join(hosts))
+    return env
+
+
+def job_mesh(env: Optional[JobEnv] = None):
+    """Build the job-wide Mesh from the contract (all processes must agree,
+    which they do by construction: the MeshSpec comes from the ConfigMap)."""
+    from paddle_operator_tpu.parallel.mesh import make_mesh
+
+    env = env or JobEnv.from_env()
+    return make_mesh(env.mesh)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI shim: ``python -m paddle_operator_tpu.launch.launcher -- cmd...``
+    initializes distributed JAX then execs the user command with the
+    environment enriched (TPU_WORKER_HOSTNAMES etc.)."""
+    import subprocess
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    env = initialize()
+    if not argv:
+        print(json.dumps({
+            "rank": env.rank, "num_workers": env.num_workers,
+            "coordinator": env.coordinator_address,
+            "mesh": env.mesh.to_dict(), "topology": env.topology,
+        }))
+        return 0
+    return subprocess.call(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,279 @@
+"""LLaMA-family decoder — the flagship model (BASELINE.md config 4:
+LLaMA-7B/13B hybrid-parallel pretrain; the reference runs this as a
+PaddleNLP workload inside containers, out-of-repo).
+
+TPU-first design decisions:
+
+- **bfloat16 compute** with f32 parameters/optimizer (casts at use),
+  f32 softmax and f32 RMSNorm accumulation — the MXU-native recipe.
+- **`nn.scan` over layers** (`scan_layers=True`): one compiled layer body,
+  layer-stacked params with a leading `layers` axis — fast compiles at
+  depth, and the natural layout for pipeline parallelism (the `layers`
+  logical axis maps to the `pp` mesh axis).
+- **`jax.checkpoint`** (remat) around each layer (`remat=True`) trading
+  FLOPs for HBM.
+- **Attention via ops.attention** — pallas flash kernel on TPU.
+- No data-dependent Python control flow anywhere under jit; static shapes.
+
+Sharding is by parameter path (parallel/sharding.py): see
+:data:`PARTITION_PATTERNS`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 32
+    ffn_dim: int = 11008
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16        # compute dtype
+    param_dtype: Any = jnp.float32   # storage dtype
+    scan_layers: bool = True
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (fwd+bwd ≈ 6N + attention)."""
+        n_params = self.num_params()
+        attn = 12 * self.n_layers * self.dim * self.max_seq_len
+        return 6 * n_params + attn
+
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        per_layer = (
+            d * self.n_heads * self.head_dim           # wq
+            + 2 * d * self.n_kv_heads * self.head_dim  # wk, wv
+            + self.n_heads * self.head_dim * d         # wo
+            + 3 * d * f                                # w1, w2, w3
+            + 2 * d                                    # norms
+        )
+        return v * d + self.n_layers * per_layer + d + d * v
+
+
+# Presets.  tiny = test/dryrun config; 7b/13b match the public LLaMA shapes.
+CONFIGS = {
+    "tiny": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, ffn_dim=128, max_seq_len=128),
+    "1b": LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
+                      n_kv_heads=16, ffn_dim=5504),
+    "7b": LlamaConfig(),
+    "13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
+                       ffn_dim=13824),
+}
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+class RMSNorm(nn.Module):
+    eps: float
+    dtype: Any
+    param_dtype: Any
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        scale = self.param(
+            "scale", nn.initializers.ones, (x.shape[-1],), self.param_dtype
+        )
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps
+        )
+        return (norm * scale.astype(jnp.float32)).astype(self.dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int,
+                     theta: float) -> Tuple[jax.Array, jax.Array]:
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)          # [S, D/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+               offset: int = 0) -> jax.Array:
+    """[B, S, H, D] rotary embedding (interleaved-pairs formulation)."""
+    seq = x.shape[1]
+    cos = jax.lax.dynamic_slice_in_dim(cos, offset, seq)[None, :, None, :]
+    sin = jax.lax.dynamic_slice_in_dim(sin, offset, seq)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
+            feats, use_bias=False, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        b, s, _ = x.shape
+        q = dense("wq", cfg.n_heads * cfg.head_dim)(x)
+        k = dense("wk", cfg.n_kv_heads * cfg.head_dim)(x)
+        v = dense("wv", cfg.n_kv_heads * cfg.head_dim)(x)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        out = attention(q, k, v, causal=True, segment_ids=segment_ids)
+        # Named for the remat policy: saving the attention output avoids
+        # re-running the flash kernel in the backward pass while keeping
+        # the per-layer activation footprint at one [B,S,H,D] tensor.
+        from jax.ad_checkpoint import checkpoint_name
+
+        out = checkpoint_name(out, "attn_out")
+        out = out.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        return dense("wo", cfg.dim)(out)
+
+
+class MLP(nn.Module):
+    """SwiGLU feed-forward."""
+
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
+            feats, use_bias=False, name=name, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        gate = dense("w1", cfg.ffn_dim)(x)
+        up = dense("w3", cfg.ffn_dim)(x)
+        return dense("w2", cfg.dim)(nn.silu(gate) * up)
+
+
+class DecoderLayer(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 segment_ids: Optional[jax.Array] = None):
+        cfg = self.cfg
+        h = x + Attention(cfg, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name="attn_norm")(x), cos, sin, segment_ids)
+        out = h + MLP(cfg, name="mlp")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name="mlp_norm")(h))
+        # (carry, scan-output) pair — the scan axis carries only the
+        # hidden state; cos/sin/segment_ids are broadcast.
+        return out, None
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
+        """[B, S] int32 tokens -> [B, S, vocab] logits."""
+        cfg = self.cfg
+        embed = nn.Embed(
+            cfg.vocab_size, cfg.dim, name="tok_embed",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            embedding_init=nn.initializers.normal(0.02),
+        )
+        x = embed(tokens)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+
+        layer_cls = DecoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                layer_cls,
+                policy=jax.checkpoint_policies.nothing_saveable,
+            )
+
+        if cfg.scan_layers:
+            # One traced layer body; params stacked on a leading `layers`
+            # axis (pp-ready).  Carry is the hidden state.
+            ScanLayers = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = ScanLayers(cfg, name="layers")(x, cos, sin, segment_ids)
+        else:
+            for i in range(cfg.n_layers):
+                x, _ = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin,
+                                                         segment_ids)
+
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                    name="final_norm")(x)
+        logits = nn.DenseGeneral(
+            cfg.vocab_size, use_bias=False, name="lm_head",
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+# nn.scan stacks layer params with a leading dim; DecoderLayer body needs
+# the non-scanned specs below prefixed with the "layers" logical axis.
+_LAYER_PATTERNS = [
+    (r"attn/wq/kernel", ("embed", "heads")),
+    (r"attn/wk/kernel", ("embed", "heads")),
+    (r"attn/wv/kernel", ("embed", "heads")),
+    (r"attn/wo/kernel", ("heads", "embed")),
+    (r"mlp/w1/kernel", ("embed", "mlp")),
+    (r"mlp/w3/kernel", ("embed", "mlp")),
+    (r"mlp/w2/kernel", ("mlp", "embed")),
+    (r"attn_norm/scale", ("embed",)),
+    (r"mlp_norm/scale", ("embed",)),
+]
+
+
+def partition_patterns(cfg: LlamaConfig):
+    """(path-regex, logical spec) table for parallel.sharding.tree_shardings."""
+    pats = [
+        (r"tok_embed/embedding", ("vocab", "embed")),
+        (r"final_norm/scale", ("embed",)),
+        (r"lm_head/kernel", ("embed", "vocab")),
+    ]
+    for pat, spec in _LAYER_PATTERNS:
+        if cfg.scan_layers:
+            pats.append((pat, ("layers",) + spec))
+        else:
+            pats.append((pat, spec))
+    return pats
+
+
+def make_model(preset: str = "tiny", **overrides) -> Tuple[Llama, LlamaConfig]:
+    cfg = dataclasses.replace(CONFIGS[preset], **overrides)
+    return Llama(cfg), cfg

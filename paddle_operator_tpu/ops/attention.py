@@ -1,0 +1,71 @@
+"""Attention ops.
+
+The single entry point :func:`attention` dispatches to the fastest available
+implementation:
+
+- TPU: the pallas flash-attention kernel (ops/pallas_attention.py) — tiled
+  online-softmax, O(S) memory, MXU-shaped blocks.
+- elsewhere (CPU tests, dryrun): a reference XLA implementation with f32
+  softmax accumulation.
+
+Shapes follow the [batch, seq, heads, head_dim] convention throughout the
+framework.  GQA is handled here (kv heads repeated to query heads) so model
+code stays shape-oblivious.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, S, Hkv, D] -> [B, S, Hkv*n_rep, D] (GQA broadcast)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    return jnp.repeat(x, n_rep, axis=2)
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        *, causal: bool = True,
+                        segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """XLA reference implementation.  [B, S, H, D] x3 -> [B, S, H, D]."""
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    scale = q.shape[-1] ** -0.5
+
+    # [B, H, Sq, Sk] scores in f32 for numerical stability
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(seg_mask[:, None, :, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(q: jax.Array, k: jax.Array, v: jax.Array,
+              *, causal: bool = True,
+              segment_ids: Optional[jax.Array] = None,
+              use_pallas: Optional[bool] = None) -> jax.Array:
+    """Dispatching attention.  [B, S, H, D] inputs, head-count ratio = GQA."""
+    if use_pallas is None:
+        use_pallas = jax.devices()[0].platform == "tpu"
+    if use_pallas:
+        try:
+            from paddle_operator_tpu.ops.pallas_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids)
+        except (ImportError, NotImplementedError):
+            pass
+    return reference_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids)

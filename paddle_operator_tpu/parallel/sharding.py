@@ -1,0 +1,131 @@
+"""Logical-axis sharding rules.
+
+Parameters are sharded by *logical* axis names (``embed``, ``mlp``,
+``heads`` …) mapped to mesh axes through a rule table — the same idea as
+flax's ``logical_axis_rules``, implemented over parameter tree paths so any
+pytree model (flax or hand-rolled) gets the treatment.  This replaces
+nothing in the reference (which has no sharding layer at all); it is the
+TPU-first core the env contract exists to bootstrap.
+
+Default rule set (the standard LLM recipe from the scaling playbook):
+
+    batch      → (dp, fsdp)   activations' batch dim
+    seq        → cp           sequence dim under context parallelism
+    embed      → fsdp         params' model dim (FSDP shards here)
+    heads      → tp           attention heads (tensor parallel)
+    kv_heads   → tp
+    mlp        → tp           ffn hidden dim
+    vocab      → tp           output projection
+    expert     → ep
+    layers     → pp           stacked-layer dim under pipeline parallelism
+    (unlisted) → replicated
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LogicalSpec = Tuple[Optional[str], ...]
+
+# logical axis name -> mesh axis (or tuple of mesh axes)
+DEFAULT_RULES: Dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "cp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "layers": "pp",
+}
+
+
+def logical_to_mesh(spec: LogicalSpec,
+                    rules: Optional[Dict[str, Any]] = None,
+                    mesh: Optional[Mesh] = None) -> P:
+    """Translate a logical spec like ("embed", "mlp") into a PartitionSpec.
+
+    Axes whose mesh size is 1 are dropped (replicated) so the same rules
+    work on any mesh, including single-device.
+    """
+    rules = rules if rules is not None else DEFAULT_RULES
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) if mesh else None
+
+    def live(mesh_axis: Any):
+        if mesh_axis is None:
+            return None
+        if isinstance(mesh_axis, (tuple, list)):
+            kept = tuple(a for a in mesh_axis if sizes is None or sizes.get(a, 1) > 1)
+            return kept if kept else None
+        if sizes is not None and sizes.get(mesh_axis, 1) <= 1:
+            return None
+        return mesh_axis
+
+    out = []
+    for ax in spec:
+        out.append(live(rules.get(ax)) if ax is not None else None)
+    return P(*out)
+
+
+# ---------------------------------------------------------------------------
+# Path-pattern param sharding
+# ---------------------------------------------------------------------------
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def spec_for_path(path_patterns: Sequence[Tuple[str, LogicalSpec]],
+                  path: str) -> LogicalSpec:
+    """First-match lookup of a param path against (regex, logical spec)."""
+    for pat, spec in path_patterns:
+        if re.search(pat, path):
+            return spec
+    return ()
+
+
+def tree_shardings(tree: Any, mesh: Mesh,
+                   path_patterns: Sequence[Tuple[str, LogicalSpec]],
+                   rules: Optional[Dict[str, Any]] = None):
+    """NamedSharding pytree for `tree`: each leaf's path is matched against
+    `path_patterns`; unmatched leaves are replicated.  Works on both real
+    arrays and ShapeDtypeStructs (use with jax.eval_shape to pre-plan)."""
+
+    def leaf_sharding(path, leaf):
+        lspec = spec_for_path(path_patterns, _path_str(path))
+        pspec = logical_to_mesh(lspec, rules, mesh)
+        # drop trailing/overflow axes if the leaf has fewer dims
+        ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
+        parts = list(pspec)[:ndim]
+        parts += [None] * (ndim - len(parts))
+        return NamedSharding(mesh, P(*parts))
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def batch_sharding(mesh: Mesh, extra_dims: int = 1,
+                   seq_axis: bool = False) -> NamedSharding:
+    """Sharding for a [batch, seq, ...] input batch: batch over (dp, fsdp),
+    optionally seq over cp."""
+    spec: list = [logical_to_mesh(("batch",), None, mesh)[0]]
+    if seq_axis:
+        spec.append(logical_to_mesh(("seq",), None, mesh)[0])
+        extra_dims -= 1
+    spec += [None] * extra_dims
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,177 @@
+"""Sharded training loop core: state creation, optimizer, train step.
+
+This is the workload-side hot loop the reference never contains (it lives in
+Paddle Fleet inside user containers, SURVEY.md §3.3); here it is first-party
+and TPU-shaped:
+
+- the whole step is one ``jax.jit`` with ``NamedSharding`` in/out specs over
+  the job Mesh — XLA's SPMD partitioner inserts the collectives (gradient
+  reduction over ``dp``/``fsdp``, activation all-reduce over ``tp``) and
+  lays them on ICI/DCN;
+- parameters/optimizer state are sharded by path rules
+  (parallel/sharding.py), donated buffers, f32 master params with bf16
+  compute inside the model;
+- loss is next-token cross-entropy computed in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_operator_tpu.parallel.sharding import batch_sharding, tree_shardings
+
+
+class TrainState(struct.PyTreeNode):
+    step: jax.Array
+    params: Any
+    opt_state: Any
+
+
+def make_optimizer(learning_rate: float = 3e-4,
+                   warmup_steps: int = 100,
+                   decay_steps: int = 10000,
+                   weight_decay: float = 0.1,
+                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+    """AdamW + cosine schedule + global-norm clip (the LLaMA recipe)."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=learning_rate,
+        warmup_steps=warmup_steps, decay_steps=max(decay_steps, warmup_steps + 1),
+        end_value=learning_rate * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+    )
+
+
+def state_shardings(model: nn.Module, optimizer: optax.GradientTransformation,
+                    mesh: Mesh,
+                    partition_patterns: Sequence[Tuple[str, tuple]],
+                    example_inputs: Tuple[Any, ...]):
+    """Plan NamedShardings for the full TrainState without materializing it
+    (jax.eval_shape).  Optimizer-state leaves are matched by the same path
+    patterns (their tree paths embed the param paths); scalars replicate."""
+
+    def init_fn(rng):
+        params = model.init(rng, *example_inputs)["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=optimizer.init(params),
+        )
+
+    shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+    return tree_shardings(shapes, mesh, partition_patterns), init_fn
+
+
+def create_state(model: nn.Module, optimizer: optax.GradientTransformation,
+                 mesh: Mesh,
+                 partition_patterns: Sequence[Tuple[str, tuple]],
+                 example_inputs: Tuple[Any, ...],
+                 rng: Optional[jax.Array] = None) -> TrainState:
+    """Initialize a TrainState already sharded over `mesh` (no full-size
+    host-side materialization: init runs under jit with out_shardings)."""
+    shardings, init_fn = state_shardings(
+        model, optimizer, mesh, partition_patterns, example_inputs
+    )
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    with mesh:
+        return jax.jit(init_fn, out_shardings=shardings)(rng)
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       mask: Optional[jax.Array] = None
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Mean next-token xent over masked positions.  logits f32 [B,S,V]."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(targets, dtype=jnp.float32)
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return -(ll * mask).sum() / denom, denom
+
+
+def make_train_step(model: nn.Module,
+                    optimizer: optax.GradientTransformation,
+                    mesh: Mesh,
+                    state_sharding=None) -> Callable:
+    """Build the jitted train step.
+
+    batch: {"tokens": int32 [B, S]} (optionally "mask" [B, S]).  Computes
+    next-token loss on tokens[:, 1:], updates params, returns (state,
+    metrics).  Donates the input state.
+    """
+    data_sharding = batch_sharding(mesh, extra_dims=1)
+
+    def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+
+        def loss_fn(params):
+            logits = model.apply({"params": params}, inputs)
+            loss, denom = cross_entropy_loss(logits, targets, mask)
+            return loss, denom
+
+        (loss, denom), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(step=state.step + 1, params=new_params,
+                               opt_state=new_opt)
+        metrics = {
+            "loss": loss,
+            "tokens": denom,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    in_shardings = (
+        state_sharding,
+        {"tokens": data_sharding},
+    ) if state_sharding is not None else None
+    out_shardings = (state_sharding, None) if state_sharding is not None else None
+
+    with mesh:
+        return jax.jit(
+            step_fn,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=(0,),
+        )
+
+
+def make_eval_step(model: nn.Module, mesh: Mesh) -> Callable:
+    data_sharding = batch_sharding(mesh, extra_dims=1)
+
+    def eval_fn(params, batch):
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        loss, _ = cross_entropy_loss(logits, tokens[:, 1:],
+                                     batch.get("mask"))
+        return {"loss": loss}
+
+    with mesh:
+        return jax.jit(eval_fn)
+
+
+def synthetic_batch(batch_size: int, seq_len: int, vocab: int,
+                    seed: int = 0) -> Dict[str, jax.Array]:
+    """Deterministic synthetic LM batch (bench/dryrun data source)."""
+    rng = jax.random.PRNGKey(seed)
+    return {
+        "tokens": jax.random.randint(rng, (batch_size, seq_len), 0, vocab,
+                                     dtype=jnp.int32)
+    }

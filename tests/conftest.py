@@ -6,14 +6,24 @@ sharding path (dp/fsdp/tp/pp/cp) is exercised without TPU hardware — the same
 idea as the reference's envtest strategy (controllers/suite_test.go:51-89):
 a headless stand-in that fully exercises the control logic.
 
-Must run before the first ``import jax`` anywhere in the test process.
+Runs before the first backend init anywhere in the test process.  Note the
+environment may pin ``jax_platforms`` via its site hook (TPU tunnel), so the
+config must be updated post-import, not just via env vars.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: the sharded train-step compiles dominate suite
+# wall-time on CPU; cache them across runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,149 @@
+"""LLaMA model + sharded trainer tests on the 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.api.types import MeshSpec
+from paddle_operator_tpu.models import llama as L
+from paddle_operator_tpu.parallel.mesh import make_mesh, single_device_mesh
+from paddle_operator_tpu.train import trainer as T
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model, cfg = L.make_model("tiny")
+    return model, cfg
+
+
+class TestModel:
+    def test_forward_shapes(self, tiny):
+        model, cfg = tiny
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        logits = model.apply({"params": params}, tokens)
+        assert logits.shape == (2, 16, cfg.vocab_size)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self, tiny):
+        """Changing a future token must not affect earlier logits."""
+        model, cfg = tiny
+        rng = jax.random.PRNGKey(1)
+        t1 = jax.random.randint(rng, (1, 16), 0, cfg.vocab_size, dtype=jnp.int32)
+        t2 = t1.at[0, 10].set((t1[0, 10] + 1) % cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), t1)["params"]
+        l1 = model.apply({"params": params}, t1)
+        l2 = model.apply({"params": params}, t2)
+        np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=2e-2)
+        assert not np.allclose(l1[0, 10:], l2[0, 10:], atol=1e-4)
+
+    def test_scan_matches_loop(self):
+        """scan_layers=True and False compute the same function."""
+        import dataclasses
+
+        cfg_scan = L.CONFIGS["tiny"]
+        cfg_loop = dataclasses.replace(cfg_scan, scan_layers=False)
+        tokens = jnp.arange(32, dtype=jnp.int32).reshape(1, 32) % 256
+
+        m_scan = L.Llama(cfg_scan)
+        m_loop = L.Llama(cfg_loop)
+        p_scan = m_scan.init(jax.random.PRNGKey(0), tokens)["params"]
+        p_loop = m_loop.init(jax.random.PRNGKey(0), tokens)["params"]
+
+        # same seed -> different tree layouts but same per-layer init dists;
+        # copy scan params into the loop layout for an exact check
+        stacked = p_scan["layers"]
+        for i in range(cfg_scan.n_layers):
+            p_loop[f"layer_{i}"] = jax.tree.map(lambda x: x[i], stacked)
+        for k in ("tok_embed", "final_norm", "lm_head"):
+            p_loop[k] = p_scan[k]
+
+        np.testing.assert_allclose(
+            m_scan.apply({"params": p_scan}, tokens),
+            m_loop.apply({"params": p_loop}, tokens),
+            atol=2e-2, rtol=1e-2,
+        )
+
+    def test_num_params_matches(self, tiny):
+        model, cfg = tiny
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        actual = sum(x.size for x in jax.tree.leaves(params))
+        assert actual == cfg.num_params()
+
+    def test_7b_param_count(self):
+        # LLaMA-7B is ~6.74B params
+        assert abs(L.CONFIGS["7b"].num_params() - 6.74e9) < 0.05e9
+
+
+class TestShardedTraining:
+    def run_steps(self, mesh_spec, n_steps=3, batch=8):
+        model, cfg = L.make_model("tiny")
+        mesh = make_mesh(mesh_spec) if mesh_spec else single_device_mesh()
+        opt = T.make_optimizer(1e-3, warmup_steps=1, decay_steps=100)
+        pats = L.partition_patterns(cfg)
+        tokens = (jnp.zeros((batch, 33), jnp.int32),)
+        shardings, _ = T.state_shardings(model, opt, mesh, pats, tokens)
+        state = T.create_state(model, opt, mesh, pats, tokens)
+        step = T.make_train_step(model, opt, mesh, shardings)
+        losses = []
+        for i in range(n_steps):
+            b = T.synthetic_batch(batch, 33, cfg.vocab_size, seed=i)
+            state, metrics = step(state, b)
+            losses.append(float(metrics["loss"]))
+        return losses, state, mesh
+
+    def test_single_device(self):
+        losses, state, _ = self.run_steps(None)
+        assert int(state.step) == 3
+        assert all(np.isfinite(losses))
+
+    def test_dp_fsdp_tp_mesh(self):
+        losses, state, mesh = self.run_steps(MeshSpec(dp=2, fsdp=2, tp=2))
+        assert all(np.isfinite(losses))
+        # params actually sharded: a wq kernel must span tp devices
+        wq = state.params["layers"]["attn"]["wq"]["kernel"]
+        assert len(wq.sharding.device_set) > 1
+
+    def test_loss_decreases(self):
+        """Overfit one repeated batch — loss must drop."""
+        model, cfg = L.make_model("tiny")
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        opt = T.make_optimizer(3e-3, warmup_steps=1, decay_steps=1000)
+        pats = L.partition_patterns(cfg)
+        ex = (jnp.zeros((8, 33), jnp.int32),)
+        shardings, _ = T.state_shardings(model, opt, mesh, pats, ex)
+        state = T.create_state(model, opt, mesh, pats, ex)
+        step = T.make_train_step(model, opt, mesh, shardings)
+        b = T.synthetic_batch(8, 33, cfg.vocab_size, seed=7)
+        first = last = None
+        for _ in range(20):
+            state, m = step(state, b)
+            if first is None:
+                first = float(m["loss"])
+            last = float(m["loss"])
+        assert last < first * 0.7, (first, last)
+
+    def test_mesh_equivalence(self):
+        """Same seed, different meshes -> same loss trajectory (SPMD
+        correctness: sharding must not change the math)."""
+        l_single, _, _ = self.run_steps(None)
+        l_mesh, _, _ = self.run_steps(MeshSpec(dp=2, fsdp=2, tp=2))
+        np.testing.assert_allclose(l_single, l_mesh, rtol=2e-3, atol=2e-3)
+
+
+class TestLoss:
+    def test_perfect_prediction_zero_loss(self):
+        logits = jnp.full((1, 4, 8), -1e9).at[0, :, 3].set(1e9)
+        targets = jnp.full((1, 4), 3, jnp.int32)
+        loss, denom = T.cross_entropy_loss(logits, targets)
+        assert float(loss) < 1e-5 and denom == 4
+
+    def test_mask(self):
+        logits = jnp.zeros((1, 4, 8))
+        targets = jnp.zeros((1, 4), jnp.int32)
+        _, denom = T.cross_entropy_loss(
+            logits, targets, mask=jnp.array([[1, 1, 0, 0]]))
+        assert denom == 2

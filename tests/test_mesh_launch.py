@@ -1,0 +1,121 @@
+"""Mesh construction, sharding rules, and launcher env-contract tests
+(8 virtual CPU devices — see conftest.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from paddle_operator_tpu.api.types import MeshSpec
+from paddle_operator_tpu.launch.launcher import JobEnv
+from paddle_operator_tpu.parallel import mesh as M
+from paddle_operator_tpu.parallel import sharding as S
+
+
+class TestMesh:
+    def test_eight_device_mesh(self):
+        m = M.make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        assert m.devices.size == 8
+        assert m.axis_names == M.AXIS_ORDER
+        assert dict(zip(m.axis_names, m.devices.shape))["tp"] == 2
+
+    def test_wrong_size_raises(self):
+        with pytest.raises(ValueError, match="needs 4 devices"):
+            M.make_mesh(MeshSpec(dp=4))
+
+    def test_single_device_mesh(self):
+        m = M.single_device_mesh()
+        assert m.devices.size == 1
+
+    def test_axis_order_tp_innermost(self):
+        assert M.AXIS_ORDER[0] == "dp" and M.AXIS_ORDER[-1] == "tp"
+
+
+class TestShardingRules:
+    def setup_method(self):
+        self.mesh = M.make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+
+    def test_logical_to_mesh(self):
+        assert S.logical_to_mesh(("batch", None, "heads"), mesh=self.mesh) == \
+            P(("dp", "fsdp"), None, "tp")
+
+    def test_size_one_axes_dropped(self):
+        assert S.logical_to_mesh(("seq",), mesh=self.mesh) == P(None)  # cp=1
+
+    def test_tree_shardings_by_path(self):
+        tree = {
+            "wq": jax.ShapeDtypeStruct((16, 8), np.float32),
+            "norm": jax.ShapeDtypeStruct((16,), np.float32),
+        }
+        pats = [(r"wq", ("embed", "heads")), (r"norm", ("embed",))]
+        sh = S.tree_shardings(tree, self.mesh, pats)
+        assert sh["wq"].spec == P("fsdp", "tp")
+        assert sh["norm"].spec == P("fsdp")
+
+    def test_unmatched_replicated(self):
+        tree = {"other": jax.ShapeDtypeStruct((4, 4), np.float32)}
+        sh = S.tree_shardings(tree, self.mesh, [])
+        assert sh["other"].spec == P(None, None)
+
+    def test_batch_sharding(self):
+        bs = S.batch_sharding(self.mesh, extra_dims=1)
+        assert bs.spec == P(("dp", "fsdp"), None)
+
+
+class TestJobEnv:
+    CONTRACT = {
+        "TPUJOB_NAME": "llama",
+        "TPUJOB_RANK": "5",
+        "TPU_WORKER_ID": "1",
+        "MEGASCALE_SLICE_ID": "2",
+        "TPUJOB_NUM_WORKERS": "8",
+        "TPUJOB_WORKERS_PER_SLICE": "2",
+        "TPUJOB_NUM_SLICES": "4",
+        "TPUJOB_COORDINATOR_ADDRESS": "llama-worker-0:8476",
+        "TPUJOB_WORKER_HOSTS": ",".join(f"h{i}" for i in range(8)),
+        "TPUJOB_MESH": '{"dp": 4, "fsdp": 2}',
+        "TPUJOB_TOPOLOGY": "2x4",
+        "TPUJOB_CHECKPOINT_PATH": "gs://b/ck",
+    }
+
+    def test_parse(self):
+        env = JobEnv.from_env(self.CONTRACT)
+        assert env.rank == 5 and env.worker_id == 1 and env.slice_id == 2
+        assert env.num_workers == 8
+        assert env.coordinator_address == "llama-worker-0:8476"
+        assert env.mesh == MeshSpec(dp=4, fsdp=2)
+        assert env.checkpoint_path == "gs://b/ck"
+
+    def test_slice_local_hosts(self):
+        env = JobEnv.from_env(self.CONTRACT)
+        assert env.slice_local_hosts() == ["h4", "h5"]
+
+    def test_defaults(self):
+        env = JobEnv.from_env({})
+        assert env.num_workers == 1 and env.rank == 0
+        assert env.mesh == MeshSpec()
+
+    def test_roundtrip_through_configmap(self):
+        """The builder-side contract parses back identically."""
+        from paddle_operator_tpu.api import ResourceSpec, TPUJob, TPUJobSpec, TPUSpec
+        from paddle_operator_tpu.controller import builders as B
+
+        tmpl = {"spec": {"containers": [{"name": "m", "image": "i"}]}}
+        job = TPUJob(name="j", spec=TPUJobSpec(
+            tpu=TPUSpec(topology="2x4", slice_count=1, chips_per_worker=4),
+            mesh=MeshSpec(dp=2, tp=4),
+            worker=ResourceSpec(replicas=2, template=tmpl)))
+        pods = [{"metadata": {"name": f"j-worker-{i}", "namespace": "default"},
+                 "status": {"podIP": f"10.0.0.{i+1}"}} for i in range(2)]
+        cm = B.construct_configmap(job, pods)
+        pod = B.construct_pod(job, "worker", 1)
+        env_vars = dict(cm["data"])
+        for e in pod["spec"]["containers"][0]["env"]:
+            if "value" in e:
+                env_vars[e["name"]] = e["value"]
+        env = JobEnv.from_env(env_vars)
+        assert env.rank == 1
+        assert env.mesh == MeshSpec(dp=2, tp=4)
+        assert env.coordinator_address == "10.0.0.1:8476"
+        assert env.slice_local_hosts() == ["10.0.0.1", "10.0.0.2"]

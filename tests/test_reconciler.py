@@ -304,7 +304,9 @@ class TestElasticCompletion:
         drive(api, rec, fleet)
         st = job_status(api)
         assert st.worker.ready == "3/3"
-        assert st.elastic == "DOING"
+        assert st.elastic == "DONE"   # converged: pods match clamped replicas
+        # the user's ask must survive in the stored spec
+        assert api.get(KIND_JOB, NS, "tj")["spec"]["worker"]["replicas"] == 10
         fleet.succeed_all()
         run_to_settled(rec, NS, "tj")
         assert job_status(api).phase == Phase.COMPLETED
