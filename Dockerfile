@@ -1,0 +1,18 @@
+# Controller image (reference analogue: 2-stage golang->minideb Dockerfile,
+# CGO_ENABLED=0, nonroot 65532).  Stage 1 builds the native allocator;
+# stage 2 is a slim python runtime running as nonroot.
+FROM python:3.12-slim AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native/ native/
+RUN make -C native
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir pyyaml
+WORKDIR /app
+COPY paddle_operator_tpu/ paddle_operator_tpu/
+COPY --from=builder /src/native/build/libtpujob_native.so \
+        paddle_operator_tpu/_native/libtpujob_native.so
+USER 65532:65532
+ENTRYPOINT ["python", "-m", "paddle_operator_tpu.controller.manager"]

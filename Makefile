@@ -1,0 +1,46 @@
+# Build surface (reference analogue: Makefile with all/test/manager/run/
+# install/gen-deploy/deploy/helm/manifests/generate/docker-build targets).
+
+PY ?= python3
+IMG ?= tpujob/controller:latest
+
+all: native test
+
+# Native runtime library (C++ host-port allocator)
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+# Run the controller locally against the current kube context
+run:
+	$(PY) -m paddle_operator_tpu.controller.manager
+
+# Regenerate deploy/v1/*.yaml and the helm chart from api/crd.py
+gen-deploy:
+	$(PY) hack/gen_deploy.py
+
+# Install the CRD into the cluster
+install: gen-deploy
+	kubectl apply -f deploy/v1/crd.yaml
+
+# Deploy CRD + controller
+deploy: gen-deploy
+	kubectl apply -f deploy/v1/crd.yaml -f deploy/v1/operator.yaml
+
+helm: gen-deploy
+	@echo "chart at charts/tpu-operator; install with:"
+	@echo "  helm install tpu-operator ./charts/tpu-operator"
+
+bench:
+	$(PY) bench.py
+
+docker-build:
+	docker build -t $(IMG) .
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .pytest_cache
+
+.PHONY: all native test run gen-deploy install deploy helm bench docker-build clean

@@ -5,8 +5,8 @@ on LLaMA-class pretrain.  This benchmark runs the real sharded train step
 (same code path as dryrun/production: bf16 compute, remat, scanned layers,
 pallas flash attention on TPU) on whatever hardware is present:
 
-- TPU (the driver's environment): a ~350M-param LLaMA sized to one chip's
-  HBM, seq 2048, measured over 10 steps after warmup.
+- TPU (the driver's environment): a ~670M-param LLaMA (dim-2048 shapes)
+  sized to one chip's HBM, seq 2048, measured over 10 steps after warmup.
 - CPU (local smoke): the tiny config, numbers meaningless but the path runs.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where

@@ -1,0 +1,73 @@
+"""Sample programmatic client (capability parity: reference
+``client/client.go:41-93``, which demonstrates Create/Get/List/Delete of a
+PaddleJob from Go).
+
+Usage::
+
+    python client/client.py create examples/collective.yaml
+    python client/client.py get my-job
+    python client/client.py list
+    python client/client.py delete my-job
+
+Talks to the apiserver through the same stdlib KubeAPI the controller uses
+(in-cluster service account, or KUBE_HOST/KUBE_TOKEN env for dev).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_operator_tpu import GROUP, PLURAL, VERSION  # noqa: E402
+from paddle_operator_tpu.api import TPUJob  # noqa: E402
+from paddle_operator_tpu.controller.kube_api import KubeAPI  # noqa: E402
+
+
+def make_api() -> KubeAPI:
+    return KubeAPI(host=os.environ.get("KUBE_HOST"),
+                   token=os.environ.get("KUBE_TOKEN"))
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        return 2
+    cmd, args = argv[0], argv[1:]
+    api = make_api()
+    ns = os.environ.get("NAMESPACE", "default")
+
+    if cmd == "create":
+        import yaml
+
+        with open(args[0]) as f:
+            obj = yaml.safe_load(f)
+        job = TPUJob.from_dict(obj)
+        errs = job.validate()
+        if errs:
+            print("invalid spec:", "; ".join(errs), file=sys.stderr)
+            return 1
+        api.create("TPUJob", job.to_dict())
+        print(f"tpujob {job.name} created")
+    elif cmd == "get":
+        print(json.dumps(api.get("TPUJob", ns, args[0]), indent=2))
+    elif cmd == "list":
+        url = f"{api.host}/apis/{GROUP}/{VERSION}/namespaces/{ns}/{PLURAL}"
+        for item in api._request("GET", url).get("items", []):
+            st = item.get("status", {})
+            print(f'{item["metadata"]["name"]}\t{st.get("phase", "?")}\t'
+                  f'{st.get("mode", "?")}')
+    elif cmd == "delete":
+        api.delete("TPUJob", ns, args[0])
+        print(f"tpujob {args[0]} deleted")
+    else:
+        print(__doc__)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

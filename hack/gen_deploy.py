@@ -1,0 +1,149 @@
+"""Render deploy artifacts from the in-code CRD schema.
+
+Reference analogue: ``make gen-deploy`` / ``make helm`` (Makefile:40-67)
+rendering kustomize sources into ``deploy/v1/{crd,operator}.yaml`` and
+``charts/paddle-operator``.  Here the single source of truth is
+api/crd.py + this script.
+
+Usage: python hack/gen_deploy.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import yaml  # noqa: E402
+
+from paddle_operator_tpu import GROUP, PLURAL  # noqa: E402
+from paddle_operator_tpu.api.crd import generate_crd  # noqa: E402
+
+NAMESPACE = "tpujob-system"
+IMAGE = "tpujob/controller:latest"
+
+
+def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
+                       leader_elect: bool = True):
+    """Namespace + RBAC + controller Deployment (reference:
+    deploy/v1/operator.yaml — namespace paddle-system, RBAC, manager
+    Deployment with --leader-elect)."""
+    sa = "tpujob-controller"
+    rules = [
+        {"apiGroups": [GROUP], "resources": [PLURAL],
+         "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"]},
+        {"apiGroups": [GROUP], "resources": [f"{PLURAL}/status"],
+         "verbs": ["get", "patch", "update"]},
+        {"apiGroups": [""], "resources": ["pods", "services", "configmaps"],
+         "verbs": ["create", "delete", "get", "list", "patch", "update", "watch"]},
+        {"apiGroups": [""], "resources": ["events"],
+         "verbs": ["create", "patch"]},
+    ]
+    return [
+        {"apiVersion": "v1", "kind": "Namespace",
+         "metadata": {"name": namespace}},
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": sa, "namespace": namespace}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "tpujob-manager-role"}, "rules": rules},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": {"name": "tpujob-manager-rolebinding"},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole", "name": "tpujob-manager-role"},
+         "subjects": [{"kind": "ServiceAccount", "name": sa,
+                       "namespace": namespace}]},
+        {"apiVersion": "apps/v1", "kind": "Deployment",
+         "metadata": {"name": "tpujob-controller", "namespace": namespace,
+                      "labels": {"control-plane": "tpujob-controller"}},
+         "spec": {
+             "replicas": 1,
+             "selector": {"matchLabels":
+                          {"control-plane": "tpujob-controller"}},
+             "template": {
+                 "metadata": {"labels":
+                              {"control-plane": "tpujob-controller"}},
+                 "spec": {
+                     "serviceAccountName": sa,
+                     "securityContext": {"runAsNonRoot": True,
+                                         "runAsUser": 65532},
+                     "terminationGracePeriodSeconds": 10,
+                     "containers": [{
+                         "name": "manager",
+                         "image": image,
+                         "command": ["python", "-m",
+                                     "paddle_operator_tpu.controller.manager"],
+                         "args": (["--leader-elect"] if leader_elect else [])
+                         + ["--namespace=" + namespace,
+                            "--port-range=35000,65000"],
+                         "ports": [
+                             {"containerPort": 8080, "name": "metrics"},
+                             {"containerPort": 8081, "name": "probes"},
+                         ],
+                         "livenessProbe": {
+                             "httpGet": {"path": "/healthz", "port": 8081},
+                             "initialDelaySeconds": 15, "periodSeconds": 20},
+                         "readinessProbe": {
+                             "httpGet": {"path": "/readyz", "port": 8081},
+                             "initialDelaySeconds": 5, "periodSeconds": 10},
+                         # reference limits: 100m CPU / 30Mi
+                         # (config/manager/manager.yaml:54-59); python needs
+                         # a bit more headroom than a Go binary
+                         "resources": {
+                             "limits": {"cpu": "500m", "memory": "256Mi"},
+                             "requests": {"cpu": "100m", "memory": "128Mi"}},
+                     }],
+                 },
+             },
+         }},
+    ]
+
+
+def write_yaml(path: str, docs) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump_all(docs, f, sort_keys=False)
+    print(f"wrote {path}")
+
+
+def render_chart(root: str) -> None:
+    """Helm chart (reference: charts/paddle-operator, Makefile:59-67)."""
+    chart_dir = os.path.join(root, "charts", "tpu-operator")
+    os.makedirs(os.path.join(chart_dir, "templates"), exist_ok=True)
+    write_yaml(os.path.join(chart_dir, "Chart.yaml"), [{
+        "apiVersion": "v2", "name": "tpu-operator",
+        "description": "TPU-native distributed training job operator",
+        "type": "application", "version": "0.1.0", "appVersion": "0.1.0",
+    }])
+    write_yaml(os.path.join(chart_dir, "values.yaml"), [{
+        "image": IMAGE,
+        "controllernamespace": NAMESPACE,
+        "jobnamespace": "default",
+        "leaderElect": True,
+    }])
+    write_yaml(os.path.join(chart_dir, "templates", "crd.yaml"),
+               [generate_crd()])
+    # templated namespace/image via helm values
+    ops = operator_manifests("__NS__", "__IMG__")
+    text = yaml.safe_dump_all(ops, sort_keys=False)
+    text = text.replace("__NS__", "{{ .Values.controllernamespace }}")
+    text = text.replace("__IMG__", "{{ .Values.image }}")
+    path = os.path.join(chart_dir, "templates", "controller.yaml")
+    with open(path, "w") as f:
+        f.write(text)
+    print(f"wrote {path}")
+
+
+def main() -> int:
+    root = os.path.join(os.path.dirname(__file__), "..")
+    write_yaml(os.path.join(root, "deploy", "v1", "crd.yaml"),
+               [generate_crd()])
+    write_yaml(os.path.join(root, "deploy", "v1", "operator.yaml"),
+               operator_manifests())
+    render_chart(root)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,161 @@
+"""Minimal in-memory apiserver speaking the k8s REST dialect KubeAPI uses.
+
+Dev/e2e tool (reference analogue: envtest's headless kube-apiserver): backs
+the real controller manager + client CLI over real HTTP without a cluster.
+
+    python hack/mock_apiserver.py --port 8001 [--kubelet]
+
+--kubelet additionally fakes pod scheduling: pods get IPs and go Running
+shortly after creation, so jobs reach the ConfigMap barrier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_operator_tpu import GROUP, PLURAL, VERSION  # noqa: E402
+from paddle_operator_tpu.controller.api_client import Conflict, NotFound  # noqa: E402
+from paddle_operator_tpu.controller.fake_api import FakeAPI, FakeFleet  # noqa: E402
+
+KIND_BY_PATH = {"pods": "Pod", "services": "Service",
+                "configmaps": "ConfigMap", "events": "Event",
+                PLURAL: "TPUJob"}
+
+CORE_RE = re.compile(r"^/api/v1/namespaces/([^/]+)/([a-z]+)(?:/([^/]+))?(?:/(status))?$")
+CRD_RE = re.compile(
+    rf"^/apis/{GROUP}/{VERSION}/namespaces/([^/]+)/({PLURAL})(?:/([^/]+))?(?:/(status))?$")
+
+
+def make_handler(api: FakeAPI):
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def _match(self):
+            parsed = urlparse(self.path)
+            m = CORE_RE.match(parsed.path) or CRD_RE.match(parsed.path)
+            if not m:
+                return None
+            ns, res, name, sub = m.groups()
+            return ns, KIND_BY_PATH.get(res), name, sub, parse_qs(parsed.query)
+
+        def _send(self, code, obj=None):
+            body = json.dumps(obj or {}).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(n)) if n else {}
+
+        def do_GET(self):  # noqa: N802
+            m = self._match()
+            if not m:
+                return self._send(404, {"reason": "NotFound"})
+            ns, kind, name, _, query = m
+            with lock:
+                if name:
+                    try:
+                        return self._send(200, api.get(kind, ns, name))
+                    except NotFound:
+                        return self._send(404, {"reason": "NotFound"})
+                items = [o for (k, n2, _), o in sorted(api.store.items())
+                         if k == kind and n2 == ns]
+                sel = query.get("labelSelector", [None])[0]
+                if sel:
+                    key, _, val = sel.partition("=")
+                    items = [o for o in items
+                             if o.get("metadata", {}).get("labels", {}).get(key) == val]
+                return self._send(200, {"kind": f"{kind}List", "items": items})
+
+        def do_POST(self):  # noqa: N802
+            m = self._match()
+            if not m:
+                return self._send(404, {})
+            ns, kind, _, _, _ = m
+            obj = self._body()
+            obj.setdefault("metadata", {}).setdefault("namespace", ns)
+            with lock:
+                try:
+                    return self._send(201, api.create(kind, obj))
+                except Conflict:
+                    return self._send(409, {"reason": "AlreadyExists"})
+
+        def do_PUT(self):  # noqa: N802
+            m = self._match()
+            if not m:
+                return self._send(404, {})
+            ns, kind, name, sub, _ = m
+            obj = self._body()
+            with lock:
+                try:
+                    if sub == "status":
+                        return self._send(200, api.update_status(kind, obj))
+                    return self._send(200, api.update(kind, obj))
+                except NotFound:
+                    return self._send(404, {"reason": "NotFound"})
+                except Conflict:
+                    return self._send(409, {"reason": "Conflict"})
+
+        def do_DELETE(self):  # noqa: N802
+            m = self._match()
+            if not m:
+                return self._send(404, {})
+            ns, kind, name, _, _ = m
+            with lock:
+                try:
+                    api.delete(kind, ns, name)
+                    return self._send(200, {})
+                except NotFound:
+                    return self._send(404, {"reason": "NotFound"})
+
+        def log_message(self, *a):
+            pass
+
+    return Handler, lock
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=8001)
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--kubelet", action="store_true",
+                   help="fake kubelet: pods get IPs and go Running")
+    args = p.parse_args(argv)
+
+    api = FakeAPI()
+    # events are not a kind FakeAPI tracks specially; store them generically
+    handler, lock = make_handler(api)
+
+    if args.kubelet:
+        fleet = FakeFleet(api, args.namespace)
+
+        def kubelet():
+            while True:
+                time.sleep(0.5)
+                with lock:
+                    fleet.run_all()
+
+        threading.Thread(target=kubelet, daemon=True).start()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", args.port), handler)
+    print(f"mock apiserver on http://127.0.0.1:{args.port} "
+          f"(kubelet={'on' if args.kubelet else 'off'})", flush=True)
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

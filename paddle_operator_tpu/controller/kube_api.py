@@ -82,7 +82,10 @@ class KubeAPI(APIClient):
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            with urllib.request.urlopen(req, context=self._ctx) as resp:
+            # ssl context only applies to https (dev setups may point
+            # KUBE_HOST at plain http, e.g. a local proxy)
+            kwargs = {"context": self._ctx} if url.startswith("https") else {}
+            with urllib.request.urlopen(req, **kwargs) as resp:
                 payload = resp.read()
                 return json.loads(payload) if payload else {}
         except urllib.error.HTTPError as e:

@@ -1,0 +1,231 @@
+"""Controller manager entrypoint.
+
+Capability parity with the reference's ``main.go`` (C1, main.go:51-129):
+flag surface, health/readiness endpoints, metrics endpoint, leader election,
+host-port pool seeding, then the reconcile loop.
+
+Differences, by design:
+
+- **Poll-based reconcile** instead of informer watches: the loop lists
+  TPUJobs every ``--sync-period`` seconds and reconciles each.  Watches are
+  an optimization, not a semantic; the reconciler is level-triggered either
+  way (same property the reference relies on).  A real cluster deployment
+  can shrink the period; the apiserver load is O(jobs) per period.
+- **Leader election** via a Kubernetes Lease object (the reference uses
+  controller-runtime's leasing with ID ``b2a304f2.paddlepaddle.org``,
+  main.go:78); ours is a plain Lease CRUD loop with the same
+  fencing-by-resourceVersion property.
+- **Metrics** are Prometheus text format served from the process
+  (controller-runtime binds :8080, main.go:57,75).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import json
+import threading
+import time
+from typing import Dict, Optional
+
+from paddle_operator_tpu.api.types import HOST_PORT_RANGE, PORT_NUM
+from paddle_operator_tpu.controller.api_client import APIClient, NotFound
+from paddle_operator_tpu.controller.hostport import make_allocator
+from paddle_operator_tpu.controller.reconciler import KIND_JOB, TPUJobReconciler
+
+LEASE_NAME = "tpujob-controller-leader"
+
+
+class Metrics:
+    """Minimal prometheus-text counters (reference: controller-runtime
+    metrics at :8080)."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = {
+            "tpujob_reconcile_total": 0,
+            "tpujob_reconcile_errors_total": 0,
+            "tpujob_active_jobs": 0,
+        }
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def set(self, name: str, v: int) -> None:
+        with self._lock:
+            self.counters[name] = v
+
+    def render(self) -> str:
+        with self._lock:
+            return "".join(f"{k} {v}\n" for k, v in sorted(self.counters.items()))
+
+
+def _serve(port: int, metrics: Metrics, ready_fn) -> threading.Thread:
+    """healthz/readyz/metrics HTTP endpoints (reference main.go:115-122)."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                body, code = b"ok", 200
+            elif self.path == "/readyz":
+                ok = ready_fn()
+                body, code = (b"ok", 200) if ok else (b"not ready", 503)
+            elif self.path == "/metrics":
+                body, code = metrics.render().encode(), 200
+            else:
+                body, code = b"not found", 404
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # silence
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+class LeaderElector:
+    """Lease-based leader election (parity: manager leaderElection,
+    main.go:77-79)."""
+
+    def __init__(self, api, identity: str, namespace: str,
+                 lease_seconds: int = 15) -> None:
+        self.api = api
+        self.identity = identity
+        self.namespace = namespace
+        self.lease_seconds = lease_seconds
+
+    def try_acquire(self) -> bool:
+        now = time.time()
+        try:
+            lease = self.api.get("ConfigMap", self.namespace, LEASE_NAME)
+        except NotFound:
+            lease = {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": LEASE_NAME, "namespace": self.namespace},
+                "data": {},
+            }
+            try:
+                lease = self.api.create("ConfigMap", lease)
+            except Exception:
+                return False
+        data = lease.get("data") or {}
+        holder = data.get("holder")
+        renewed = float(data.get("renewed", 0) or 0)
+        if holder not in (None, "", self.identity) and \
+                now - renewed < self.lease_seconds:
+            return False
+        lease["data"] = {"holder": self.identity, "renewed": str(now)}
+        try:
+            self.api.update("ConfigMap", lease)
+            return True
+        except Exception:
+            return False
+
+
+class Manager:
+    def __init__(self, api: APIClient, *, namespace: str = "",
+                 sync_period: float = 2.0,
+                 port_range=HOST_PORT_RANGE,
+                 leader_elect: bool = False,
+                 identity: str = "tpujob-controller-0",
+                 metrics: Optional[Metrics] = None) -> None:
+        self.api = api
+        self.namespace = namespace or "default"
+        self.sync_period = sync_period
+        self.metrics = metrics or Metrics()
+        allocator = make_allocator(port_range[0], port_range[1], PORT_NUM)
+        self.reconciler = TPUJobReconciler(api, allocator=allocator)
+        self.leader = (LeaderElector(api, identity, self.namespace)
+                       if leader_elect else None)
+        self._stop = threading.Event()
+        self._ready = False
+
+    def ready(self) -> bool:
+        return self._ready
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run_once(self) -> int:
+        """One sync pass over all jobs; returns the number reconciled."""
+        jobs = self._list_jobs()
+        self.metrics.set("tpujob_active_jobs", len(jobs))
+        n = 0
+        for j in jobs:
+            name = j["metadata"]["name"]
+            try:
+                result = self.reconciler.reconcile(self.namespace, name)
+                self.metrics.inc("tpujob_reconcile_total")
+                n += 1
+                if result.wants_requeue:
+                    # immediate follow-up pass for converging jobs
+                    self.reconciler.reconcile(self.namespace, name)
+                    self.metrics.inc("tpujob_reconcile_total")
+            except Exception:
+                self.metrics.inc("tpujob_reconcile_errors_total")
+        return n
+
+    def _list_jobs(self):
+        if hasattr(self.api, "store"):  # FakeAPI
+            return [o for (k, ns, _), o in sorted(self.api.store.items())
+                    if k == KIND_JOB and ns == self.namespace]
+        # KubeAPI: list the collection
+        from paddle_operator_tpu import GROUP, PLURAL, VERSION
+
+        url = (f"{self.api.host}/apis/{GROUP}/{VERSION}/namespaces/"
+               f"{self.namespace}/{PLURAL}")
+        return self.api._request("GET", url).get("items", [])
+
+    def run(self) -> None:
+        self._ready = True
+        while not self._stop.is_set():
+            if self.leader is not None and not self.leader.try_acquire():
+                time.sleep(self.sync_period)
+                continue
+            self.run_once()
+            self._stop.wait(self.sync_period)
+
+
+def main(argv=None) -> int:
+    """CLI parity with reference main.go:57-63."""
+    p = argparse.ArgumentParser(prog="tpujob-controller")
+    p.add_argument("--metrics-bind-address", default=":8080")
+    p.add_argument("--health-probe-bind-address", default=":8081")
+    p.add_argument("--namespace", default="",
+                   help="restrict the controller to one namespace")
+    p.add_argument("--port-range", default="35000,65000",
+                   help="host-port allocation range 'start,end'")
+    p.add_argument("--leader-elect", action="store_true")
+    p.add_argument("--sync-period", type=float, default=2.0)
+    args = p.parse_args(argv)
+
+    lo, hi = (int(x) for x in args.port_range.split(","))
+
+    from paddle_operator_tpu.controller.kube_api import KubeAPI
+
+    api = KubeAPI()
+    metrics = Metrics()
+    mgr = Manager(api, namespace=args.namespace or "default",
+                  sync_period=args.sync_period, port_range=(lo, hi),
+                  leader_elect=args.leader_elect, metrics=metrics)
+
+    def port_of(addr: str, default: int) -> int:
+        try:
+            return int(addr.rsplit(":", 1)[-1])
+        except ValueError:
+            return default
+
+    _serve(port_of(args.health_probe_bind_address, 8081), metrics, mgr.ready)
+    _serve(port_of(args.metrics_bind_address, 8080), metrics, mgr.ready)
+    mgr.run()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

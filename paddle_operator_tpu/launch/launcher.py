@@ -126,15 +126,20 @@ def job_mesh(env: Optional[JobEnv] = None):
 
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI shim: ``python -m paddle_operator_tpu.launch.launcher -- cmd...``
-    initializes distributed JAX then execs the user command with the
-    environment enriched (TPU_WORKER_HOSTNAMES etc.)."""
-    import subprocess
+    enriches the environment (slice-local TPU_WORKER_HOSTNAMES etc.) and
+    **execs** the user command, replacing this process.  The child — not
+    the shim — calls :func:`initialize`, so exactly one process per rank
+    registers with the XLA coordinator (a parent that initialized and then
+    spawned a child would occupy the rank's coordinator slot)."""
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "--":
         argv = argv[1:]
-    env = initialize()
+    env = JobEnv.from_env()
+    hosts = env.slice_local_hosts()
+    if hosts:
+        os.environ.setdefault("TPU_WORKER_HOSTNAMES", ",".join(hosts))
     if not argv:
         print(json.dumps({
             "rank": env.rank, "num_workers": env.num_workers,
@@ -142,7 +147,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "mesh": env.mesh.to_dict(), "topology": env.topology,
         }))
         return 0
-    return subprocess.call(argv)
+    os.execvp(argv[0], argv)
 
 
 if __name__ == "__main__":

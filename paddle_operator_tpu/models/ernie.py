@@ -1,0 +1,155 @@
+"""ERNIE-style bidirectional transformer encoder (BASELINE config 3:
+ERNIE-3.0-base Fleet Collective — the reference runs it as a PaddleNLP
+container workload; here it is first-party).
+
+Architecturally a BERT-class encoder: learned position embeddings,
+post-layernorm blocks, GELU MLP, full (non-causal) attention via the shared
+ops.attention dispatch (pallas flash on TPU), with an MLM head for
+pretraining.  Same TPU conventions as LLaMA: bf16 compute, f32 params,
+scanned+rematted layers, path-pattern sharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ErnieConfig:
+    vocab_size: int = 40000
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq_len: int = 512
+    type_vocab: int = 4
+    norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+CONFIGS = {
+    "tiny": ErnieConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                        ffn_dim=128, max_seq_len=128, type_vocab=2),
+    "base": ErnieConfig(),                       # ERNIE-3.0-base shapes
+    "large": ErnieConfig(dim=1024, n_layers=24, n_heads=16, ffn_dim=4096),
+}
+
+
+class EncoderLayer(nn.Module):
+    cfg: ErnieConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, pad_mask: jax.Array):
+        cfg = self.cfg
+        dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
+            feats, name=name, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        b, s, _ = x.shape
+        q = dense("wq", cfg.dim)(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = dense("wk", cfg.dim)(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = dense("wv", cfg.dim)(x).reshape(b, s, cfg.n_heads, cfg.head_dim)
+        # padding mask via segment ids: pad tokens live in segment 0,
+        # real tokens in segment 1 -> attention stays within real tokens.
+        out = attention(q, k, v, causal=False, segment_ids=pad_mask)
+        out = dense("wo", cfg.dim)(out.reshape(b, s, cfg.dim))
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="attn_norm")(x + out)
+        h = dense("w1", cfg.ffn_dim)(x)
+        h = nn.gelu(h)
+        h = dense("w2", cfg.dim)(h)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="mlp_norm")(x + h)
+        return x, None
+
+
+class Ernie(nn.Module):
+    cfg: ErnieConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 token_types: Optional[jax.Array] = None,
+                 pad_mask: Optional[jax.Array] = None) -> jax.Array:
+        """[B, S] tokens (+types, +1/0 pad mask) -> [B, S, vocab] MLM logits."""
+        cfg = self.cfg
+        b, s = tokens.shape
+        if token_types is None:
+            token_types = jnp.zeros_like(tokens)
+        if pad_mask is None:
+            pad_mask = jnp.ones_like(tokens)
+
+        embed_kw = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        embedding_init=nn.initializers.normal(0.02))
+        x = nn.Embed(cfg.vocab_size, cfg.dim, name="tok_embed", **embed_kw)(tokens)
+        x = x + nn.Embed(cfg.max_seq_len, cfg.dim, name="pos_embed",
+                         **embed_kw)(jnp.arange(s)[None, :])
+        x = x + nn.Embed(cfg.type_vocab, cfg.dim, name="type_embed",
+                         **embed_kw)(token_types)
+        x = nn.LayerNorm(epsilon=cfg.norm_eps, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype, name="embed_norm")(x)
+
+        layer_cls = EncoderLayer
+        if cfg.remat:
+            layer_cls = nn.remat(
+                layer_cls, policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.scan_layers:
+            Scan = nn.scan(
+                layer_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                in_axes=(nn.broadcast,),
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )
+            x, _ = Scan(cfg, name="layers")(x, pad_mask)
+        else:
+            for i in range(cfg.n_layers):
+                x, _ = layer_cls(cfg, name=f"layer_{i}")(x, pad_mask)
+
+        logits = nn.DenseGeneral(
+            cfg.vocab_size, name="mlm_head", dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(0.02),
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+_LAYER_PATTERNS = [
+    (r"wq/kernel", ("embed", "heads")),
+    (r"wk/kernel", ("embed", "heads")),
+    (r"wv/kernel", ("embed", "heads")),
+    (r"wo/kernel", ("heads", "embed")),
+    (r"w1/kernel", ("embed", "mlp")),
+    (r"w2/kernel", ("mlp", "embed")),
+]
+
+
+def partition_patterns(cfg: ErnieConfig):
+    pats = [
+        (r"tok_embed/embedding", ("vocab", "embed")),
+        (r"pos_embed/embedding", (None, "embed")),
+        (r"type_embed/embedding", (None, "embed")),
+        (r"mlm_head/kernel", ("embed", "vocab")),
+    ]
+    for pat, spec in _LAYER_PATTERNS:
+        pats.append((pat, ("layers",) + spec if cfg.scan_layers else spec))
+    return pats
+
+
+def make_model(preset: str = "tiny", **overrides) -> Tuple[Ernie, ErnieConfig]:
+    cfg = dataclasses.replace(CONFIGS[preset], **overrides)
+    return Ernie(cfg), cfg

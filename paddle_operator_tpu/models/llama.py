@@ -46,6 +46,9 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32   # storage dtype
     scan_layers: bool = True
     remat: bool = True
+    # "full" (recompute everything — fastest measured on v5e),
+    # "save_attn" (keep flash-attention outputs), "dots" (save matmul outs)
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -147,9 +150,10 @@ class Attention(nn.Module):
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         out = attention(q, k, v, causal=True, segment_ids=segment_ids)
-        # Named for the remat policy: saving the attention output avoids
-        # re-running the flash kernel in the backward pass while keeping
-        # the per-layer activation footprint at one [B,S,H,D] tensor.
+        # Tag for remat_policy="save_attn": under that policy the flash
+        # kernel is not re-run in the backward pass.  Under the default
+        # full-remat policy the tag is a no-op and attention recomputes —
+        # measured FASTER on v5e (HBM-bound; see bench sweep).
         from jax.ad_checkpoint import checkpoint_name
 
         out = checkpoint_name(out, "attn_out")
@@ -212,10 +216,13 @@ class Llama(nn.Module):
 
         layer_cls = DecoderLayer
         if cfg.remat:
-            layer_cls = nn.remat(
-                layer_cls,
-                policy=jax.checkpoint_policies.nothing_saveable,
-            )
+            policy = {
+                "full": jax.checkpoint_policies.nothing_saveable,
+                "save_attn": jax.checkpoint_policies.save_only_these_names(
+                    "attn_out"),
+                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            }[cfg.remat_policy]
+            layer_cls = nn.remat(layer_cls, policy=policy)
 
         if cfg.scan_layers:
             # One traced layer body; params stacked on a leading `layers`

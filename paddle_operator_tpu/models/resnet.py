@@ -1,0 +1,97 @@
+"""ResNet (v1.5 bottleneck) image classifier (BASELINE config 2: the
+reference's Collective-mode example trains ResNet-50 via PaddleClas with
+``nvidia.com/gpu: 1`` — deploy/examples/resnet.yaml; here it is first-party
+and TPU-shaped).
+
+TPU notes: NHWC layout (XLA:TPU native), bf16 compute/f32 params, batch
+norm in f32.  Convolutions hit the MXU directly; data parallelism comes
+from the standard batch sharding — no model sharding needed at ResNet
+scale, which matches how the reference example deploys it (pure DP).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)    # ResNet-50
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+CONFIGS = {
+    "tiny": ResNetConfig(stage_sizes=(1, 1), num_classes=10, width=8),
+    "resnet18": ResNetConfig(stage_sizes=(2, 2, 2, 2)),
+    "resnet50": ResNetConfig(),
+    "resnet101": ResNetConfig(stage_sizes=(3, 4, 23, 3)),
+}
+
+
+class Bottleneck(nn.Module):
+    features: int
+    strides: int
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        conv = partial(nn.Conv, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=jnp.float32,
+                       param_dtype=cfg.param_dtype)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.features, (3, 3), (self.strides, self.strides),
+                 name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1),
+                            (self.strides, self.strides), name="proj")(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class ResNet(nn.Module):
+    cfg: ResNetConfig
+
+    @nn.compact
+    def __call__(self, images: jax.Array, train: bool = True) -> jax.Array:
+        """[B, H, W, 3] NHWC -> [B, num_classes] logits."""
+        cfg = self.cfg
+        x = images.astype(cfg.dtype)
+        x = nn.Conv(cfg.width, (7, 7), (2, 2), use_bias=False,
+                    dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                    name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32,
+                         param_dtype=cfg.param_dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(cfg.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(cfg.width * 2 ** i, strides, cfg,
+                               name=f"stage{i}_block{j}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(cfg.num_classes, dtype=cfg.dtype,
+                          param_dtype=cfg.param_dtype, name="head")(x)
+        return logits.astype(jnp.float32)
+
+
+def make_model(preset: str = "tiny", **overrides) -> Tuple[ResNet, ResNetConfig]:
+    cfg = dataclasses.replace(CONFIGS[preset], **overrides)
+    return ResNet(cfg), cfg

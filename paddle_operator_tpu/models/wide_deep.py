@@ -1,0 +1,103 @@
+"""Wide & Deep recommender (BASELINE config 1: the reference's PS-mode
+example — deploy/examples/wide_and_deep.yaml, CPU PS pods + trainer pods).
+
+The reference's PS tier stores the big sparse embedding tables on CPU
+parameter servers; trainers pull/push rows over the PADDLE_PSERVERS
+endpoints.  TPU-native equivalent (parallel/ps.py): the tables are
+range-sharded across the mesh and lookups/updates are psum collectives —
+same sparse-update semantics, no server process, ICI instead of TCP.
+
+Model: `wide` = linear over one-hot sparse fields (per-field scalar
+embeddings); `deep` = concatenated field embeddings + dense features
+through an MLP.  Output: binary CTR logit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class WideDeepConfig:
+    field_vocabs: Sequence[int] = (1000,) * 26     # criteo-like: 26 sparse
+    num_dense: int = 13
+    embed_dim: int = 16
+    mlp_dims: Sequence[int] = (400, 400, 400)
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+
+CONFIGS = {
+    "tiny": WideDeepConfig(field_vocabs=(32, 32, 32), num_dense=4,
+                           embed_dim=8, mlp_dims=(16, 16)),
+    "criteo": WideDeepConfig(field_vocabs=(100000,) * 26),
+}
+
+
+class WideDeep(nn.Module):
+    cfg: WideDeepConfig
+
+    @nn.compact
+    def __call__(self, sparse_ids: jax.Array,
+                 dense: jax.Array) -> jax.Array:
+        """sparse_ids [B, F] int32 (one id per field), dense [B, num_dense]
+        -> [B] CTR logit."""
+        cfg = self.cfg
+        embed_kw = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                        embedding_init=nn.initializers.normal(0.01))
+
+        wide_terms = []
+        deep_terms = []
+        for f, vocab in enumerate(cfg.field_vocabs):
+            ids = sparse_ids[:, f]
+            # wide: per-field scalar weight (the "cross/linear" part)
+            w = nn.Embed(vocab, 1, name=f"wide_{f}", **embed_kw)(ids)
+            wide_terms.append(w[:, 0])
+            # deep: per-field dense embedding (PS-sharded at scale —
+            # the train step shards these tables over fsdp via the
+            # partition patterns below)
+            e = nn.Embed(vocab, cfg.embed_dim, name=f"embed_{f}",
+                         **embed_kw)(ids)
+            deep_terms.append(e)
+
+        wide = sum(wide_terms) + nn.Dense(
+            1, name="wide_dense", dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype)(dense)[:, 0]
+
+        h = jnp.concatenate(deep_terms + [dense.astype(cfg.dtype)], axis=-1)
+        for i, d in enumerate(cfg.mlp_dims):
+            h = nn.relu(nn.Dense(d, name=f"mlp_{i}", dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype)(h))
+        deep = nn.Dense(1, name="deep_out", dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)(h)[:, 0]
+        return wide + deep
+
+
+def partition_patterns(cfg: WideDeepConfig):
+    """Embedding tables row-sharded over fsdp (the PS tier analogue);
+    MLP small enough to replicate."""
+    return [
+        (r"embed_\d+/embedding", ("embed_rows", None)),
+        (r"wide_\d+/embedding", ("embed_rows", None)),
+    ]
+
+
+# logical axis rule used by the patterns above (rows over fsdp)
+PS_RULES = {"embed_rows": "fsdp", "batch": ("dp", "fsdp")}
+
+
+def bce_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Binary cross-entropy with logits, mean over batch."""
+    logp = jax.nn.log_sigmoid(logits)
+    lognp = jax.nn.log_sigmoid(-logits)
+    return -(labels * logp + (1 - labels) * lognp).mean()
+
+
+def make_model(preset: str = "tiny", **overrides) -> Tuple[WideDeep, WideDeepConfig]:
+    cfg = dataclasses.replace(CONFIGS[preset], **overrides)
+    return WideDeep(cfg), cfg

@@ -33,6 +33,22 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 
 
+
+def _masked_scores(q, k, iq, ik, *, scale, causal, block_q, block_k):
+    """Block score tile [bq, bk] in f32 with the causal mask applied —
+    shared by the forward and both backward kernels."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return s
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -60,16 +76,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0, 0]                              # [bq, D]
         k = k_ref[0, 0]                              # [bk, D]
         v = v_ref[0, 0]                              # [bk, D]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                    # [bq, bk] f32
-        if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
 
         m_prev = m_ref[:, :1]                        # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)   # [bq, 1]
@@ -165,15 +173,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0]                        # [bq, 1]
         delta = delta_ref[0, 0]                    # [bq, 1]
 
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse)                       # [bq, bk]
         # dv += p^T @ dO
         dv_acc[:] += jax.lax.dot_general(
@@ -215,15 +216,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
 
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = iq * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ik * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),

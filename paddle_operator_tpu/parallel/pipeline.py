@@ -1,0 +1,104 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+The reference is topology-unaware beyond a rank id (SURVEY.md §2: TP/PP
+"absent — entirely inside PaddleNLP/Fleet"); here pipelining is a framework
+primitive.  Design:
+
+- The layer stack is already *stacked* on a leading ``layers`` axis (the
+  ``nn.scan`` layout of models/llama.py), logically sharded ``layers → pp``,
+  so each pp device holds a contiguous block of layers.
+- :func:`pipeline_apply` runs inside ``shard_map``: microbatches stream
+  through stages; activations hop stage→stage with ``ppermute``
+  (point-to-point, ICI neighbors); every device executes the same program
+  (SPMD) so the whole thing jits once and differentiates automatically
+  (``ppermute``'s transpose is the reverse permute, giving the backward
+  pipeline for free).
+- Schedule: GPipe with M microbatches over P stages: M + P - 1 ticks, each
+  tick runs every stage's local block once.  Bubble fraction
+  (P-1)/(M+P-1) — choose M >= 4·P.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(layer_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any,
+                   x: jax.Array,
+                   *, axis_name: str = "pp",
+                   num_microbatches: int) -> jax.Array:
+    """Run a stacked layer pipeline inside shard_map.
+
+    layer_fn(stage_params, h) applies THIS stage's local layer block.
+    x: [M, Bm, ...] microbatched input (every stage receives the same x;
+    only stage 0 actually consumes it).  Returns [M, Bm, ...] outputs
+    (valid on the LAST stage; other stages return zeros — callers keep
+    the loss computation on the last stage or psum it out).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    n_stage = jax.lax.psum(1, axis_name)
+    m = num_microbatches
+    ticks = m + n_stage - 1
+
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+    zero = jnp.zeros_like(x[0])
+
+    def tick(carry, t):
+        prev_out = carry                       # activation arriving from left
+        # stage 0 feeds microbatch t (clamped); others feed the received act
+        mb_idx = jnp.clip(t, 0, m - 1)
+        my_in = jnp.where(stage == 0,
+                          jax.lax.dynamic_index_in_dim(x, mb_idx, 0,
+                                                       keepdims=False),
+                          prev_out)
+        live = (t - stage >= 0) & (t - stage < m)
+        out = layer_fn(stage_params, my_in)
+        out = jnp.where(live, out, zero)
+        nxt = jax.lax.ppermute(out, axis_name, perm)
+        return nxt, out
+
+    _, outs = jax.lax.scan(tick, zero, jnp.arange(ticks))
+    # The last stage emits microbatch j at tick j + (n_stage - 1); select
+    # those ticks and replicate the final stage's result to every stage
+    # (psum of a one-hot-by-stage contribution) so the out_spec can be
+    # pp-replicated and the loss computes identically everywhere.
+    idx = jnp.arange(m) + n_stage - 1
+    mine = outs[idx]
+    return jax.lax.psum(
+        jnp.where(stage == n_stage - 1, mine, jnp.zeros_like(mine)),
+        axis_name,
+    )
+
+
+def make_pipeline_fn(mesh: Mesh, layer_fn: Callable,
+                     *, num_microbatches: int,
+                     axis_name: str = "pp",
+                     data_axes=("dp", "fsdp")):
+    """shard_map wrapper: params sharded layers→pp, x sharded batch→data
+    axes, microbatch dim replicated."""
+    from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(
+        functools.partial(pipeline_apply, layer_fn,
+                          axis_name=axis_name,
+                          num_microbatches=num_microbatches),
+        mesh=mesh,
+        in_specs=(P(axis_name), P(None, data_axes)),
+        out_specs=P(None, data_axes),
+        check_rep=False,
+    )
+    return fn
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]."""
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible by M={num_microbatches}")
+    return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])

@@ -1,0 +1,116 @@
+"""Ring attention — context/sequence parallelism over the ``cp`` mesh axis.
+
+Long-context support is first-class here (the reference has none anywhere —
+SURVEY.md §5 "long-context/sequence parallelism: absent"): the sequence is
+sharded across the ``cp`` axis, Q stays resident, and K/V chunks rotate
+around the ring via ``ppermute`` while each device accumulates its part of
+the softmax online (same math as flash attention at chunk granularity).
+Peak memory per device is O(S/cp · S/cp) for the score tile instead of
+O(S²); communication is cp-1 neighbor hops riding ICI.
+
+Causality at chunk granularity: with contiguous chunking, chunk j
+contributes to chunk i fully when j < i, with a causal mask when j == i,
+and not at all when j > i (the contribution is masked out; the rotation
+is uniform so the program stays SPMD).
+
+Use :func:`ring_attention` inside ``shard_map`` (see
+:func:`make_ring_attention_fn` for the wrapped version).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _chunk_scores(q, k, *, scale):
+    """[B, Sq, H, D] x [B, Sk, H, D] -> [B, H, Sq, Sk] f32 (GQA-aware)."""
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:
+        k = jnp.repeat(k, n_rep, axis=2)
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *, axis_name: str = "cp",
+                   causal: bool = True) -> jax.Array:
+    """Per-device body: local [B, S_loc, H, D] shards, full attention over
+    the distributed sequence.  Must run inside shard_map with `axis_name`
+    bound."""
+    my = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    scale = q.shape[-1] ** -0.5
+    b, s_loc, h, d = q.shape
+    hkv = k.shape[2]
+    n_rep = h // hkv
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # accumulators (chunk-granular online softmax), [B, H, Sq, *]
+    m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+
+    def body(carry, step):
+        m, l, acc, k_cur, v_cur = carry
+        src = (my - step) % n          # which chunk k_cur/v_cur came from
+
+        s = _chunk_scores(q, k_cur, scale=scale)      # [B, H, Sq, Sk]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+            diag_mask = rows >= cols
+            # full when src < my; diagonal-causal when src == my; none after
+            keep = jnp.where(src == my, diag_mask, src < my)
+            s = jnp.where(keep[None, None], s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)                        # [B, H, Sq, Sk]
+        v_rep = jnp.repeat(v_cur, n_rep, axis=2) if n_rep > 1 else v_cur
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_rep.dtype), v_rep,
+                        preferred_element_type=jnp.float32)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + pv
+        # rotate K/V to the next device (skip after the final use)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l).astype(q.dtype)                   # [B, H, Sq, D]
+    return out.transpose(0, 2, 1, 3)                  # [B, Sq, H, D]
+
+
+def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
+                           axis_name: str = "cp"):
+    """shard_map-wrapped ring attention: global [B, S, H, D] arrays with the
+    sequence sharded over `axis_name`; batch over (dp, fsdp); heads over tp.
+
+    When the cp axis has size 1 this degrades to plain attention (the ring
+    has one hop), so model code can call it unconditionally.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    qkv_spec = P(("dp", "fsdp"), axis_name, "tp", None)
+
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_rep=False,
+    )
+    return fn

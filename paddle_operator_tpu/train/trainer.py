@@ -138,9 +138,11 @@ def make_train_step(model: nn.Module,
         }
         return new_state, metrics
 
+    # data_sharding is a pytree *prefix*: it applies to every leaf of the
+    # batch dict, so optional keys ("mask") shard the same way as tokens.
     in_shardings = (
         state_sharding,
-        {"tokens": data_sharding},
+        data_sharding,
     ) if state_sharding is not None else None
     out_shardings = (state_sharding, None) if state_sharding is not None else None
 
@@ -153,7 +155,8 @@ def make_train_step(model: nn.Module,
         )
 
 
-def make_eval_step(model: nn.Module, mesh: Mesh) -> Callable:
+def make_eval_step(model: nn.Module, mesh: Mesh,
+                   params_sharding=None) -> Callable:
     data_sharding = batch_sharding(mesh, extra_dims=1)
 
     def eval_fn(params, batch):
@@ -163,8 +166,10 @@ def make_eval_step(model: nn.Module, mesh: Mesh) -> Callable:
                                      batch.get("mask"))
         return {"loss": loss}
 
+    in_shardings = ((params_sharding, data_sharding)
+                    if params_sharding is not None else None)
     with mesh:
-        return jax.jit(eval_fn)
+        return jax.jit(eval_fn, in_shardings=in_shardings)
 
 
 def synthetic_batch(batch_size: int, seq_len: int, vocab: int,
