@@ -18,7 +18,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import yaml  # noqa: E402
 
 from paddle_operator_tpu import GROUP, PLURAL  # noqa: E402
-from paddle_operator_tpu.api.crd import generate_crd  # noqa: E402
+from paddle_operator_tpu.api.crd import generate_crd, generate_crd_v1beta1  # noqa: E402
 
 NAMESPACE = "tpujob-system"
 IMAGE = "tpujob/controller:latest"
@@ -140,6 +140,11 @@ def main() -> int:
     write_yaml(os.path.join(root, "deploy", "v1", "crd.yaml"),
                [generate_crd()])
     write_yaml(os.path.join(root, "deploy", "v1", "operator.yaml"),
+               operator_manifests())
+    # legacy rendering for k8s <= 1.15 (reference parity: deploy/v1beta1)
+    write_yaml(os.path.join(root, "deploy", "v1beta1", "crd.yaml"),
+               [generate_crd_v1beta1()])
+    write_yaml(os.path.join(root, "deploy", "v1beta1", "operator.yaml"),
                operator_manifests())
     render_chart(root)
     return 0

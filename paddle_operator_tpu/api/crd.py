@@ -176,6 +176,36 @@ def generate_crd() -> Dict[str, Any]:
     }
 
 
+def generate_crd_v1beta1() -> Dict[str, Any]:
+    """Legacy apiextensions/v1beta1 rendering for k8s <= 1.15 clusters
+    (reference ships the same dual rendering: deploy/v1beta1/crd.yaml with
+    top-level printer columns)."""
+    v1 = generate_crd()
+    version = v1["spec"]["versions"][0]
+    cols = [
+        {**{k: v for k, v in c.items() if k != "jsonPath"},
+         "JSONPath": c["jsonPath"]}
+        for c in version["additionalPrinterColumns"]
+    ]
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1beta1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{PLURAL}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": v1["spec"]["names"],
+            "scope": "Namespaced",
+            "version": VERSION,
+            "versions": [{"name": VERSION, "served": True, "storage": True}],
+            "subresources": {"status": {}},
+            "additionalPrinterColumns": cols,
+            "validation": {
+                "openAPIV3Schema": version["schema"]["openAPIV3Schema"],
+            },
+        },
+    }
+
+
 def crd_yaml() -> str:
     import yaml
 

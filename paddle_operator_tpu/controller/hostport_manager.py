@@ -1,0 +1,129 @@
+"""Standalone host-port manager.
+
+Capability parity with the reference's legacy sidecar controller
+(``third_party/hostport-allocator`` — an informer/workqueue process that
+served the pre-CRD ``TrainingJob`` resource): an independent binary that
+watches annotated objects, allocates N host ports from a range, and writes
+them back as an annotation.  Kept for jobs that bring their own controller
+but still need cluster-wide port coordination.
+
+Annotation contract (reference: ``hostport-manager/portnum`` in,
+``hostport-manager/hostport`` out; portparse/parse.py):
+
+    request:  metadata.annotations["hostport-manager/portnum"]  = "3"
+    response: metadata.annotations["hostport-manager/hostport"] = "p1,p2,p3"
+
+Re-adoption on restart: existing response annotations are re-registered
+into the allocator before any new allocation (reference
+hostportmanager.go:344-385); ports release when the object disappears.
+
+Run: ``python -m paddle_operator_tpu.controller.hostport_manager
+--hostport-range 35000,65000 --kind TPUJob``
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Dict, List, Set, Tuple
+
+from paddle_operator_tpu.controller.api_client import APIClient, Conflict, NotFound
+from paddle_operator_tpu.controller.hostport import make_allocator
+
+REQUEST_ANNOTATION = "hostport-manager/portnum"
+RESPONSE_ANNOTATION = "hostport-manager/hostport"
+
+
+class HostPortManager:
+    """Poll loop over one namespaced kind (reference: informer+workqueue
+    over TrainingJob)."""
+
+    def __init__(self, api: APIClient, *, kind: str = "TPUJob",
+                 namespace: str = "default",
+                 port_range: Tuple[int, int] = (35000, 65000)) -> None:
+        self.api = api
+        self.kind = kind
+        self.namespace = namespace
+        # block size 1: this manager hands out individual ports
+        self.allocator = make_allocator(port_range[0], port_range[1], 1)
+        # object name -> ports held
+        self.held: Dict[str, List[int]] = {}
+
+    # -- one reconcile pass -------------------------------------------------
+
+    def sync(self, objects: List[dict]) -> int:
+        """Process the current object list; returns allocations performed.
+        Handles adoption, new requests, and release of deleted objects."""
+        seen: Set[str] = set()
+        done = 0
+        for obj in objects:
+            name = obj["metadata"]["name"]
+            seen.add(name)
+            ann = obj["metadata"].get("annotations") or {}
+            if RESPONSE_ANNOTATION in ann:
+                if name not in self.held:  # re-adopt after restart
+                    ports = [int(p) for p in
+                             ann[RESPONSE_ANNOTATION].split(",") if p]
+                    for p in ports:
+                        self.allocator.adopt(p)
+                    self.held[name] = ports
+                continue
+            if REQUEST_ANNOTATION not in ann:
+                continue
+            try:
+                n = int(ann[REQUEST_ANNOTATION])
+            except ValueError:
+                continue
+            if n <= 0:
+                continue
+            ports = [self.allocator.allocate() for _ in range(n)]
+            ann[RESPONSE_ANNOTATION] = ",".join(str(p) for p in ports)
+            obj["metadata"]["annotations"] = ann
+            try:
+                self.api.update(self.kind, obj)
+                self.held[name] = ports
+                done += 1
+            except (Conflict, NotFound):
+                for p in ports:
+                    self.allocator.release(p)
+        # release ports of deleted objects (reference deleteObject path)
+        for gone in [n for n in self.held if n not in seen]:
+            for p in self.held.pop(gone):
+                self.allocator.release(p)
+        return done
+
+    def list_objects(self) -> List[dict]:
+        if hasattr(self.api, "store"):  # FakeAPI
+            return [o for (k, ns, _), o in sorted(self.api.store.items())
+                    if k == self.kind and ns == self.namespace]
+        from paddle_operator_tpu import GROUP, PLURAL, VERSION
+
+        url = (f"{self.api.host}/apis/{GROUP}/{VERSION}/namespaces/"
+               f"{self.namespace}/{PLURAL}")
+        return self.api._request("GET", url).get("items", [])
+
+    def run(self, period: float = 2.0) -> None:
+        while True:
+            self.sync(self.list_objects())
+            time.sleep(period)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="hostport-manager")
+    p.add_argument("--hostport-range", default="35000,65000")
+    p.add_argument("--kind", default="TPUJob")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--period", type=float, default=2.0)
+    args = p.parse_args(argv)
+    lo, hi = (int(x) for x in args.hostport_range.split(","))
+
+    from paddle_operator_tpu.controller.kube_api import KubeAPI
+
+    mgr = HostPortManager(KubeAPI(), kind=args.kind,
+                          namespace=args.namespace, port_range=(lo, hi))
+    mgr.run(args.period)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

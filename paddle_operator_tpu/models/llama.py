@@ -130,6 +130,10 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
 
 class Attention(nn.Module):
     cfg: LlamaConfig
+    # When set (and its cp axis > 1), attention runs as ring attention over
+    # the cp mesh axis — sequence sharded, K/V rotating on ICI
+    # (parallel/ring_attention.py).  None => single-sequence attention.
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
@@ -149,7 +153,18 @@ class Attention(nn.Module):
         v = v.reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        out = attention(q, k, v, causal=True, segment_ids=segment_ids)
+        cp = 1
+        if self.mesh is not None:
+            cp = dict(zip(self.mesh.axis_names,
+                          self.mesh.devices.shape)).get("cp", 1)
+        if cp > 1:
+            from paddle_operator_tpu.parallel.ring_attention import (
+                make_ring_attention_fn,
+            )
+
+            out = make_ring_attention_fn(self.mesh, causal=True)(q, k, v)
+        else:
+            out = attention(q, k, v, causal=True, segment_ids=segment_ids)
         # Tag for remat_policy="save_attn": under that policy the flash
         # kernel is not re-run in the backward pass.  Under the default
         # full-remat policy the tag is a no-op and attention recomputes —
@@ -181,12 +196,13 @@ class MLP(nn.Module):
 
 class DecoderLayer(nn.Module):
     cfg: LlamaConfig
+    mesh: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
                  segment_ids: Optional[jax.Array] = None):
         cfg = self.cfg
-        h = x + Attention(cfg, name="attn")(
+        h = x + Attention(cfg, self.mesh, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name="attn_norm")(x), cos, sin, segment_ids)
         out = h + MLP(cfg, name="mlp")(
@@ -199,6 +215,7 @@ class DecoderLayer(nn.Module):
 
 class Llama(nn.Module):
     cfg: LlamaConfig
+    mesh: Optional[Any] = None   # enables ring attention when cp > 1
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -235,11 +252,12 @@ class Llama(nn.Module):
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )
-            x, _ = ScanLayers(cfg, name="layers")(x, cos, sin, segment_ids)
+            x, _ = ScanLayers(cfg, self.mesh, name="layers")(
+                x, cos, sin, segment_ids)
         else:
             for i in range(cfg.n_layers):
-                x, _ = layer_cls(cfg, name=f"layer_{i}")(x, cos, sin,
-                                                         segment_ids)
+                x, _ = layer_cls(cfg, self.mesh, name=f"layer_{i}")(
+                    x, cos, sin, segment_ids)
 
         x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name="final_norm")(x)
@@ -281,6 +299,8 @@ def partition_patterns(cfg: LlamaConfig):
     return pats
 
 
-def make_model(preset: str = "tiny", **overrides) -> Tuple[Llama, LlamaConfig]:
+def make_model(preset: str = "tiny", mesh=None, **overrides) -> Tuple[Llama, LlamaConfig]:
+    """`mesh` activates context parallelism (ring attention) when its cp
+    axis is > 1; otherwise it is inert."""
     cfg = dataclasses.replace(CONFIGS[preset], **overrides)
-    return Llama(cfg), cfg
+    return Llama(cfg, mesh), cfg

@@ -1,0 +1,88 @@
+"""Tracing / profiling / structured logging.
+
+The reference has no tracing or profiling at all (SURVEY.md §5: zap
+structured logging only).  This module is the framework's observability
+kit:
+
+- :func:`get_logger` — structured (key=value) logging with rank prefix.
+- :class:`StepTimer` — rolling step-time/throughput/MFU accounting for
+  training loops (what bench.py measures, as a reusable component).
+- :func:`trace` — context manager around ``jax.profiler`` writing a
+  TensorBoard-loadable trace (XLA ops, fusion view) to a directory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+from collections import deque
+from typing import Optional
+
+_FMT = "%(asctime)s %(levelname).1s %(name)s %(message)s"
+
+
+def get_logger(name: str = "tpujob") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        rank = os.environ.get("TPUJOB_RANK", "0")
+        h.setFormatter(logging.Formatter(f"[rank {rank}] {_FMT}"))
+        logger.addHandler(h)
+        logger.setLevel(os.environ.get("TPUJOB_LOG_LEVEL", "INFO"))
+    return logger
+
+
+class StepTimer:
+    """Rolling window of step times -> tokens/s and MFU."""
+
+    def __init__(self, tokens_per_step: int,
+                 flops_per_token: Optional[float] = None,
+                 peak_flops: Optional[float] = None,
+                 window: int = 20) -> None:
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_token = flops_per_token
+        self.peak_flops = peak_flops
+        self.times: deque = deque(maxlen=window)
+        self._last: Optional[float] = None
+
+    def tick(self) -> None:
+        now = time.perf_counter()
+        if self._last is not None:
+            self.times.append(now - self._last)
+        self._last = now
+
+    @property
+    def step_time(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    @property
+    def tokens_per_sec(self) -> float:
+        st = self.step_time
+        return self.tokens_per_step / st if st else 0.0
+
+    @property
+    def mfu(self) -> Optional[float]:
+        if not (self.flops_per_token and self.peak_flops):
+            return None
+        return self.tokens_per_sec * self.flops_per_token / self.peak_flops
+
+    def report(self) -> str:
+        s = f"step_time={self.step_time:.3f}s tok/s={self.tokens_per_sec:.0f}"
+        if self.mfu is not None:
+            s += f" mfu={self.mfu:.3f}"
+        return s
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """``with trace('/tmp/trace'):`` profiles the enclosed steps; load the
+    result in TensorBoard (or xprof) for the XLA op/fusion timeline."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -126,3 +126,49 @@ class TestDeployArtifacts:
             text = f.read()
         assert "{{ .Values.controllernamespace }}" in text
         assert "{{ .Values.image }}" in text
+
+
+class TestHostPortManager:
+    """Standalone hostport-manager (reference third_party/hostport-allocator
+    parity): annotation request -> allocated ports -> release on delete."""
+
+    def test_allocate_adopt_release(self):
+        from paddle_operator_tpu.controller.hostport_manager import (
+            REQUEST_ANNOTATION, RESPONSE_ANNOTATION, HostPortManager,
+        )
+
+        api = FakeAPI()
+        job = TPUJob(name="legacy")
+        job.annotations[REQUEST_ANNOTATION] = "3"
+        api.create(KIND_JOB, job.to_dict())
+
+        mgr = HostPortManager(api, port_range=(35000, 35100))
+        assert mgr.sync(mgr.list_objects()) == 1
+        got = api.get(KIND_JOB, "default", "legacy")
+        ports = [int(p) for p in
+                 got["metadata"]["annotations"][RESPONSE_ANNOTATION].split(",")]
+        assert len(set(ports)) == 3
+        assert all(mgr.allocator.in_use(p) for p in ports)
+
+        # restart: a fresh manager re-adopts instead of double-allocating
+        mgr2 = HostPortManager(api, port_range=(35000, 35100))
+        assert mgr2.sync(mgr2.list_objects()) == 0
+        assert all(mgr2.allocator.in_use(p) for p in ports)
+
+        # delete -> release
+        api.delete(KIND_JOB, "default", "legacy")
+        api.store.pop((KIND_JOB, "default", "legacy"), None)
+        mgr2.sync(mgr2.list_objects())
+        assert not any(mgr2.allocator.in_use(p) for p in ports)
+
+    def test_v1beta1_crd_renders(self):
+        from paddle_operator_tpu.api.crd import generate_crd_v1beta1
+
+        crd = generate_crd_v1beta1()
+        assert crd["apiVersion"] == "apiextensions.k8s.io/v1beta1"
+        assert crd["spec"]["validation"]["openAPIV3Schema"]["type"] == "object"
+        assert crd["spec"]["additionalPrinterColumns"][0]["JSONPath"] == \
+            ".status.phase"
+        import os as _os
+        assert _os.path.exists(_os.path.join(REPO, "deploy", "v1beta1",
+                                             "crd.yaml"))
