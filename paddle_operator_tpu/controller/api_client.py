@@ -8,8 +8,9 @@ interface so the reconciler is a pure state machine over it:
 - :class:`FakeAPI` (fake_api.py) — in-process stand-in used by the test
   suite, playing the role envtest plays for the reference
   (controllers/suite_test.go:51-89).
-- :class:`KubeAPI` (kube_api.py) — the real thing, backed by the
-  ``kubernetes`` Python client (import-gated; not needed for tests).
+- :class:`KubeAPI` (kube_api.py) — the real thing: stdlib ``urllib`` over
+  the apiserver REST API (bearer token + CA from the in-cluster
+  service-account mount; no third-party client dependency).
 
 Objects are plain dicts in k8s JSON form; TPUJob crosses the boundary as a
 dict too and is (de)serialized by the reconciler.

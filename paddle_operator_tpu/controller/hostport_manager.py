@@ -28,7 +28,7 @@ import time
 from typing import Dict, List, Set, Tuple
 
 from paddle_operator_tpu.controller.api_client import APIClient, Conflict, NotFound
-from paddle_operator_tpu.controller.hostport import make_allocator
+from paddle_operator_tpu.controller.hostport import PortExhausted, make_allocator
 
 REQUEST_ANNOTATION = "hostport-manager/portnum"
 RESPONSE_ANNOTATION = "hostport-manager/hostport"
@@ -64,9 +64,12 @@ class HostPortManager:
                 if name not in self.held:  # re-adopt after restart
                     ports = [int(p) for p in
                              ann[RESPONSE_ANNOTATION].split(",") if p]
-                    for p in ports:
-                        self.allocator.adopt(p)
-                    self.held[name] = ports
+                    # Track only ports we actually adopted: if another
+                    # object already holds one (stale/copied annotation),
+                    # this object's deletion must not release it from
+                    # under the first holder.
+                    self.held[name] = [p for p in ports
+                                       if self.allocator.adopt(p)]
                 continue
             if REQUEST_ANNOTATION not in ann:
                 continue
@@ -76,7 +79,16 @@ class HostPortManager:
                 continue
             if n <= 0:
                 continue
-            ports = [self.allocator.allocate() for _ in range(n)]
+            ports: List[int] = []
+            try:
+                for _ in range(n):
+                    ports.append(self.allocator.allocate())
+            except PortExhausted:
+                # partial allocation mid-loop: return what we took and skip
+                # the object this pass (retries once ports free up)
+                for p in ports:
+                    self.allocator.release(p)
+                continue
             ann[RESPONSE_ANNOTATION] = ",".join(str(p) for p in ports)
             obj["metadata"]["annotations"] = ann
             try:

@@ -11,10 +11,12 @@ Differences, by design:
   an optimization, not a semantic; the reconciler is level-triggered either
   way (same property the reference relies on).  A real cluster deployment
   can shrink the period; the apiserver load is O(jobs) per period.
-- **Leader election** via a Kubernetes Lease object (the reference uses
-  controller-runtime's leasing with ID ``b2a304f2.paddlepaddle.org``,
-  main.go:78); ours is a plain Lease CRUD loop with the same
-  fencing-by-resourceVersion property.
+- **Leader election** via compare-and-swap on a ConfigMap (the reference
+  uses controller-runtime's Lease-based election with ID
+  ``b2a304f2.paddlepaddle.org``, main.go:78); a ConfigMap carries the same
+  fencing-by-resourceVersion property and needs no coordination.k8s.io
+  RBAC.  Expiry compares wall clocks across replicas, so it assumes
+  cluster-node clock skew well under ``lease_seconds``.
 - **Metrics** are Prometheus text format served from the process
   (controller-runtime binds :8080, main.go:57,75).
 """
@@ -90,8 +92,10 @@ def _serve(port: int, metrics: Metrics, ready_fn) -> threading.Thread:
 
 
 class LeaderElector:
-    """Lease-based leader election (parity: manager leaderElection,
-    main.go:77-79)."""
+    """ConfigMap-CAS leader election (parity: manager leaderElection,
+    main.go:77-79).  The holder/renewed pair lives in a ConfigMap; updates
+    go through the apiserver's optimistic concurrency, and lease expiry is
+    wall-clock based (assumes clock skew << lease_seconds)."""
 
     def __init__(self, api, identity: str, namespace: str,
                  lease_seconds: int = 15) -> None:

@@ -117,7 +117,11 @@ def rope_frequencies(head_dim: int, max_len: int,
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
                offset: int = 0) -> jax.Array:
-    """[B, S, H, D] rotary embedding (interleaved-pairs formulation)."""
+    """[B, S, H, D] rotary embedding, half-split ("rotate-half"/NeoX)
+    formulation: the head dim is split into two contiguous halves rather
+    than interleaved even/odd pairs.  Self-consistent for from-scratch
+    training; importing official LLaMA checkpoints (which use interleaved
+    pairs) requires a one-time permutation of wq/wk columns."""
     seq = x.shape[1]
     cos = jax.lax.dynamic_slice_in_dim(cos, offset, seq)[None, :, None, :]
     sin = jax.lax.dynamic_slice_in_dim(sin, offset, seq)[None, :, None, :]

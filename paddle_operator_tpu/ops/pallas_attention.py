@@ -95,9 +95,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     @pl.when(ik == nk - 1)
     def _finish():
         l = l_ref[:, :1]
-        l = jnp.where(l == 0.0, 1.0, l)              # fully-masked rows
-        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:, :1] + jnp.log(l)
+        # A row with no unmasked entry anywhere still has m == NEG_INF:
+        # either every k-block was skipped by the block-level `live` gate
+        # (then l == 0 too) or live blocks saw only NEG_INF scores (then
+        # p = exp(0) = 1 accumulated l = block_k, and acc = sum(v) —
+        # garbage).  NEG_INF is finite (-1e30), so without the clamp lse
+        # would be ~NEG_INF and the backward kernels would compute
+        # p = exp(s - lse) ≈ 1 per masked entry.  Emit o = 0 and lse = 0
+        # for such rows so backward p = exp(NEG_INF - 0) = 0 (correct zero
+        # gradient).  Unreachable for causal self-attention (each row
+        # attends itself) but real with sq > sk or extra masking.
+        masked_row = m_ref[:, :1] <= NEG_INF / 2
+        l = jnp.where(l == 0.0, 1.0, l)
+        o = acc_ref[:] / l
+        o_ref[0, 0] = jnp.where(masked_row, 0.0, o).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(masked_row, 0.0,
+                                  m_ref[:, :1] + jnp.log(l))
 
 
 def _fwd(q, k, v, *, scale, causal, block_q, block_k, n_rep,

@@ -40,15 +40,17 @@ class StepTimer:
     def __init__(self, tokens_per_step: int,
                  flops_per_token: Optional[float] = None,
                  peak_flops: Optional[float] = None,
-                 window: int = 20) -> None:
+                 window: int = 20,
+                 clock=time.perf_counter) -> None:
         self.tokens_per_step = tokens_per_step
         self.flops_per_token = flops_per_token
         self.peak_flops = peak_flops
         self.times: deque = deque(maxlen=window)
         self._last: Optional[float] = None
+        self._clock = clock
 
     def tick(self) -> None:
-        now = time.perf_counter()
+        now = self._clock()
         if self._last is not None:
             self.times.append(now - self._last)
         self._last = now

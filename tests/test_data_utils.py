@@ -56,14 +56,20 @@ class TestData:
 
 class TestObservability:
     def test_step_timer(self):
-        t = StepTimer(tokens_per_step=1000, flops_per_token=2e9,
-                      peak_flops=197e12)
-        import time
+        # Injected clock: 0.02 s/step exactly -> 50k tok/s ->
+        # mfu = 50e3 * 2e9 / 197e12 ~= 0.5076, deterministically.
+        fake_now = [0.0]
 
-        t.tick(); time.sleep(0.01); t.tick(); time.sleep(0.01); t.tick()
-        assert 0.005 < t.step_time < 0.2
-        assert t.tokens_per_sec > 0
-        assert 0 < t.mfu < 1
+        def clock():
+            fake_now[0] += 0.02
+            return fake_now[0]
+
+        t = StepTimer(tokens_per_step=1000, flops_per_token=2e9,
+                      peak_flops=197e12, clock=clock)
+        t.tick(); t.tick(); t.tick()
+        assert abs(t.step_time - 0.02) < 1e-9
+        assert abs(t.tokens_per_sec - 50000.0) < 1e-6
+        assert abs(t.mfu - 50000.0 * 2e9 / 197e12) < 1e-9
         assert "mfu=" in t.report()
 
     def test_logger_singleton(self):
