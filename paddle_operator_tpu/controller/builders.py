@@ -369,7 +369,23 @@ def construct_pod(job: TPUJob, res_type: str, idx: int) -> Dict[str, Any]:
     else:
         slice_id, worker_in_slice = 0, idx
 
-    env.append({"name": "TPUJOB_RANK", "value": str(idx)})
+    # Disjoint global ranks across roles: workers first (so worker ranks
+    # double as XLA process ids 0..W-1), then ps, then heter.  The reference
+    # hands every role its own 0-based PADDLE_TRAINER_ID (helper.go:203-206,
+    # safe there because only trainers read it); with a single launcher
+    # consuming the contract, same-index PS and worker pods must not share a
+    # rank.  Only `worker` pods join the XLA world (launch/launcher.py).
+    n_workers = job.spec.worker.replicas if job.spec.worker else 0
+    n_ps = job.spec.ps.replicas if job.spec.ps else 0
+    rank_base = {
+        RESOURCE_WORKER: 0,
+        RESOURCE_PS: n_workers,
+        RESOURCE_HETER: n_workers + n_ps,
+    }[res_type]
+
+    env.append({"name": "TPUJOB_RANK", "value": str(rank_base + idx)})
+    env.append({"name": "TPUJOB_ROLE_RANK", "value": str(idx)})
+    env.append({"name": "TPUJOB_RES_TYPE", "value": res_type})
     env.append({"name": "TPU_WORKER_ID", "value": str(worker_in_slice)})
     env.append({"name": "TPUJOB_ROLE", "value": TRAINING_ROLE[res_type]})
     env.append({"name": "TRAINING_ROLE", "value": TRAINING_ROLE[res_type]})

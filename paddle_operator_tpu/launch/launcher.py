@@ -35,7 +35,9 @@ class JobEnv:
     """Parsed view of the env contract one pod sees."""
 
     job_name: str = ""
-    rank: int = 0                    # global worker rank (TPUJOB_RANK)
+    rank: int = 0                    # global rank, disjoint across roles
+    role_rank: int = 0               # index within this pod's role
+    res_type: str = "worker"         # worker | ps | heter
     worker_id: int = 0               # slice-local id (TPU_WORKER_ID)
     slice_id: int = 0                # MEGASCALE_SLICE_ID
     num_workers: int = 1
@@ -63,9 +65,20 @@ class JobEnv:
             v = e.get(key, "")
             return [s for s in v.split(",") if s]
 
+        rank = int(e.get("TPUJOB_RANK", 0))
+        role = e.get("TPUJOB_ROLE", e.get("TRAINING_ROLE", "TRAINER"))
+        # Fallback for env from a pre-TPUJOB_RES_TYPE controller (rolling
+        # upgrade skew): PSERVER role implies the ps tier — without this an
+        # old-contract PS pod would default to 'worker' and re-enter the
+        # rank collision this field exists to prevent.
+        res_type = e.get("TPUJOB_RES_TYPE") or (
+            "ps" if role == "PSERVER" else "worker"
+        )
         return cls(
             job_name=e.get("TPUJOB_NAME", ""),
-            rank=int(e.get("TPUJOB_RANK", 0)),
+            rank=rank,
+            role_rank=int(e.get("TPUJOB_ROLE_RANK", rank)),
+            res_type=res_type,
             worker_id=int(e.get("TPU_WORKER_ID", 0)),
             slice_id=int(e.get("MEGASCALE_SLICE_ID", 0)),
             num_workers=int(e.get("TPUJOB_NUM_WORKERS", 1)),
@@ -75,7 +88,7 @@ class JobEnv:
             worker_hosts=split("TPUJOB_WORKER_HOSTS"),
             ps_endpoints=split("TPUJOB_PS_ENDPOINTS"),
             heter_endpoints=split("TPUJOB_HETER_ENDPOINTS"),
-            role=e.get("TPUJOB_ROLE", e.get("TRAINING_ROLE", "TRAINER")),
+            role=role,
             port=int(e.get("TPUJOB_PORT", COORDINATOR_PORT)),
             mesh=mesh,
             topology=e.get("TPUJOB_TOPOLOGY", ""),
@@ -83,6 +96,19 @@ class JobEnv:
             checkpoint_path=e.get("TPUJOB_CHECKPOINT_PATH", ""),
             max_restarts=int(e.get("TPUJOB_MAX_RESTARTS", 0)),
         )
+
+    @property
+    def is_xla_worker(self) -> bool:
+        """Whether this process belongs to the XLA collective world.
+
+        Only ``worker`` pods do: the PS/heter tiers are CPU-side services
+        (sharded-embedding hosts, preprocessors) that talk to workers over
+        their own endpoints (``TPUJOB_PS_ENDPOINTS``), not via XLA
+        collectives — so they must not occupy coordinator slots.  Worker
+        global ranks are 0..num_workers-1 by construction
+        (controller/builders.py construct_pod), so ``rank`` doubles as the
+        XLA process id."""
+        return self.res_type == "worker"
 
     def slice_local_hosts(self) -> List[str]:
         """The hostnames of this pod's slice (what the TPU runtime wants as
@@ -100,6 +126,13 @@ def initialize(env: Optional[JobEnv] = None, *, force: bool = False) -> JobEnv:
     init must precede backend init).
     """
     env = env or JobEnv.from_env()
+    if not env.is_xla_worker and not force:
+        # PS / heter pods are not part of the XLA world (see
+        # JobEnv.is_xla_worker) — running the launcher in them must not
+        # register with the coordinator (their global ranks are >= the
+        # worker count and would be rejected; pre-fix they COLLIDED with
+        # same-index worker ranks).
+        return env
     if env.num_workers > 1 or force:
         import jax
 

@@ -190,9 +190,24 @@ class TestPod:
         job = make_job(ps=1, workers=1)
         pod = B.construct_pod(job, "ps", 0)
         assert self.env_map(pod)["TPUJOB_ROLE"] == "PSERVER"
+        assert self.env_map(pod)["TPUJOB_RES_TYPE"] == "ps"
         assert "resources" not in pod["spec"]["containers"][0] or \
             "google.com/tpu" not in pod["spec"]["containers"][0].get(
                 "resources", {}).get("limits", {})
+
+    def test_global_ranks_disjoint_across_roles(self):
+        """Workers 0..W-1 (XLA process ids), then ps, then heter — a PS pod
+        must never share TPUJOB_RANK with a same-index worker (round-1
+        contract bug)."""
+        job = make_job(ps=2, workers=3)
+        ranks = {}
+        for res_type, n in (("worker", 3), ("ps", 2)):
+            for i in range(n):
+                env = self.env_map(B.construct_pod(job, res_type, i))
+                ranks[(res_type, i)] = int(env["TPUJOB_RANK"])
+                assert env["TPUJOB_ROLE_RANK"] == str(i)
+        assert sorted(ranks.values()) == [0, 1, 2, 3, 4]
+        assert ranks[("worker", 0)] == 0 and ranks[("ps", 0)] == 3
 
     def test_tpu_placement(self):
         tpu = TPUSpec(accelerator="tpu-v5p-slice", topology="4x8",
