@@ -70,7 +70,10 @@ class JobEnv:
         # Fallback for env from a pre-TPUJOB_RES_TYPE controller (rolling
         # upgrade skew): PSERVER role implies the ps tier — without this an
         # old-contract PS pod would default to 'worker' and re-enter the
-        # rank collision this field exists to prevent.
+        # rank collision this field exists to prevent.  Old-contract HETER
+        # pods are NOT distinguishable (their TRAINING_ROLE is also
+        # "TRAINER") and will be misclassified as workers; finish the
+        # controller upgrade before adding heter replicas.
         res_type = e.get("TPUJOB_RES_TYPE") or (
             "ps" if role == "PSERVER" else "worker"
         )

@@ -217,6 +217,76 @@ class DecoderLayer(nn.Module):
         return out, None
 
 
+def _layer_cls(cfg: LlamaConfig):
+    """DecoderLayer, optionally remat-wrapped per cfg (shared by Llama and
+    LayerStack so the pipeline path runs byte-identical layer math)."""
+    layer_cls = DecoderLayer
+    if cfg.remat:
+        policy = {
+            "full": jax.checkpoint_policies.nothing_saveable,
+            "save_attn": jax.checkpoint_policies.save_only_these_names(
+                "attn_out"),
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }[cfg.remat_policy]
+        layer_cls = nn.remat(layer_cls, policy=policy)
+    return layer_cls
+
+
+def _scanned(layer_cls, length: int):
+    """nn.scan over the layer axis: one traced body, params stacked on a
+    leading `layers` axis (the pp-shardable layout)."""
+    return nn.scan(
+        layer_cls,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+        in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
+        length=length,
+        metadata_params={nn.PARTITION_NAME: "layers"},
+    )
+
+
+class LayerStack(nn.Module):
+    """The decoder trunk alone: `n_layers` DecoderLayers under the same
+    scan/remat machinery (and the same `layers/...` param paths) as
+    :class:`Llama`.  The pipeline-parallel train step
+    (train/trainer.py make_pp_train_step) applies this per stage inside
+    shard_map with the stage's local slice of the layer-stacked params
+    (`layers` axis sharded over the `pp` mesh axis)."""
+
+    cfg: LlamaConfig
+    n_layers: int
+
+    @nn.compact
+    def __call__(self, x: jax.Array, cos: jax.Array,
+                 sin: jax.Array) -> jax.Array:
+        Scan = _scanned(_layer_cls(self.cfg), self.n_layers)
+        x, _ = Scan(self.cfg, None, name="layers")(x, cos, sin, None)
+        return x
+
+
+def embed_module(cfg: LlamaConfig, name: Optional[str] = None) -> nn.Embed:
+    """Token embedding — single definition shared by Llama.__call__ (as
+    submodule "tok_embed") and the pipeline train step (applied standalone
+    on the `tok_embed` param subtree), so names/dtypes cannot drift."""
+    return nn.Embed(
+        cfg.vocab_size, cfg.dim, name=name,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        embedding_init=nn.initializers.normal(0.02),
+    )
+
+
+def final_norm_module(cfg: LlamaConfig, name: Optional[str] = None) -> "RMSNorm":
+    return RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype, name=name)
+
+
+def lm_head_module(cfg: LlamaConfig, name: Optional[str] = None) -> nn.DenseGeneral:
+    return nn.DenseGeneral(
+        cfg.vocab_size, use_bias=False, name=name,
+        dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.initializers.normal(0.02),
+    )
+
+
 class Llama(nn.Module):
     cfg: LlamaConfig
     mesh: Optional[Any] = None   # enables ring attention when cp > 1
@@ -226,50 +296,22 @@ class Llama(nn.Module):
                  segment_ids: Optional[jax.Array] = None) -> jax.Array:
         """[B, S] int32 tokens -> [B, S, vocab] logits."""
         cfg = self.cfg
-        embed = nn.Embed(
-            cfg.vocab_size, cfg.dim, name="tok_embed",
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            embedding_init=nn.initializers.normal(0.02),
-        )
-        x = embed(tokens)
+        x = embed_module(cfg, name="tok_embed")(tokens)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
 
-        layer_cls = DecoderLayer
-        if cfg.remat:
-            policy = {
-                "full": jax.checkpoint_policies.nothing_saveable,
-                "save_attn": jax.checkpoint_policies.save_only_these_names(
-                    "attn_out"),
-                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            }[cfg.remat_policy]
-            layer_cls = nn.remat(layer_cls, policy=policy)
+        layer_cls = _layer_cls(cfg)
 
         if cfg.scan_layers:
-            # One traced layer body; params stacked on a leading `layers`
-            # axis (pp-ready).  Carry is the hidden state.
-            ScanLayers = nn.scan(
-                layer_cls,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-                in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
-                length=cfg.n_layers,
-                metadata_params={nn.PARTITION_NAME: "layers"},
-            )
-            x, _ = ScanLayers(cfg, self.mesh, name="layers")(
-                x, cos, sin, segment_ids)
+            x, _ = _scanned(layer_cls, cfg.n_layers)(
+                cfg, self.mesh, name="layers")(x, cos, sin, segment_ids)
         else:
             for i in range(cfg.n_layers):
                 x, _ = layer_cls(cfg, self.mesh, name=f"layer_{i}")(
                     x, cos, sin, segment_ids)
 
-        x = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
-                    name="final_norm")(x)
-        logits = nn.DenseGeneral(
-            cfg.vocab_size, use_bias=False, name="lm_head",
-            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-            kernel_init=nn.initializers.normal(0.02),
-        )(x)
+        x = final_norm_module(cfg, name="final_norm")(x)
+        logits = lm_head_module(cfg, name="lm_head")(x)
         return logits.astype(jnp.float32)
 
 

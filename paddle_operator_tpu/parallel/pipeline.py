@@ -82,7 +82,7 @@ def make_pipeline_fn(mesh: Mesh, layer_fn: Callable,
                      data_axes=("dp", "fsdp")):
     """shard_map wrapper: params sharded layers→pp, x sharded batch→data
     axes, microbatch dim replicated."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     fn = shard_map(
         functools.partial(pipeline_apply, layer_fn,
@@ -91,7 +91,7 @@ def make_pipeline_fn(mesh: Mesh, layer_fn: Callable,
         mesh=mesh,
         in_specs=(P(axis_name), P(None, data_axes)),
         out_specs=P(None, data_axes),
-        check_rep=False,
+        check_vma=False,
     )
     return fn
 

@@ -56,7 +56,7 @@ def make_ps_embedding(mesh: Mesh, vocab: int, dim: int,
     init_fn(rng) -> sharded [V, D] table (rows over `axis`);
     lookup_fn(table, ids[B]) -> [B, D] via shard_map+psum.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
     if vocab % axis_size:
@@ -76,6 +76,6 @@ def make_ps_embedding(mesh: Mesh, vocab: int, dim: int,
         mesh=mesh,
         in_specs=(P(axis, None), P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     return init_fn, lookup

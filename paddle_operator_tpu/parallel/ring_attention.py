@@ -101,7 +101,7 @@ def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
     When the cp axis has size 1 this degrades to plain attention (the ring
     has one hop), so model code can call it unconditionally.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     qkv_spec = P(("dp", "fsdp"), axis_name, "tp", None)
 
@@ -111,6 +111,6 @@ def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec),
         out_specs=qkv_spec,
-        check_rep=False,
+        check_vma=False,
     )
     return fn

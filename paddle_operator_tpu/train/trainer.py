@@ -99,16 +99,14 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
     return -(ll * mask).sum() / denom, denom
 
 
-def make_train_step(model: nn.Module,
-                    optimizer: optax.GradientTransformation,
-                    mesh: Mesh,
-                    state_sharding=None) -> Callable:
-    """Build the jitted train step.
-
-    batch: {"tokens": int32 [B, S]} (optionally "mask" [B, S]).  Computes
-    next-token loss on tokens[:, 1:], updates params, returns (state,
-    metrics).  Donates the input state.
-    """
+def _jit_train_step(forward_loss, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, state_sharding) -> Callable:
+    """Shared tail of every train step: value_and_grad around
+    ``forward_loss(params, inputs, targets, mask) -> (total_loss,
+    metrics_dict)`` (metrics must include "loss" and "tokens"), optimizer
+    update, metrics, and the jit with sharded/donated state.  Used by both
+    the plain-GSPMD and the pipeline-parallel steps so the update rule can
+    never diverge between them."""
     data_sharding = batch_sharding(mesh, extra_dims=1)
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
@@ -118,24 +116,15 @@ def make_train_step(model: nn.Module,
         if mask is not None:
             mask = mask[:, 1:]
 
-        def loss_fn(params):
-            logits = model.apply({"params": params}, inputs)
-            loss, denom = cross_entropy_loss(logits, targets, mask)
-            return loss, denom
-
-        (loss, denom), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
+        (_, aux), grads = jax.value_and_grad(
+            forward_loss, has_aux=True)(state.params, inputs, targets, mask)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt)
-        metrics = {
-            "loss": loss,
-            "tokens": denom,
-            "grad_norm": optax.global_norm(grads),
-        }
+        metrics = dict(aux)
+        metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
     # data_sharding is a pytree *prefix*: it applies to every leaf of the
@@ -153,6 +142,112 @@ def make_train_step(model: nn.Module,
             out_shardings=out_shardings,
             donate_argnums=(0,),
         )
+
+
+def make_train_step(model: nn.Module,
+                    optimizer: optax.GradientTransformation,
+                    mesh: Mesh,
+                    state_sharding=None) -> Callable:
+    """Build the jitted train step.
+
+    batch: {"tokens": int32 [B, S]} (optionally "mask" [B, S]).  Computes
+    next-token loss on tokens[:, 1:], updates params, returns (state,
+    metrics).  Donates the input state.
+    """
+
+    def forward_loss(params, inputs, targets, mask):
+        logits = model.apply({"params": params}, inputs)
+        loss, denom = cross_entropy_loss(logits, targets, mask)
+        return loss, {"loss": loss, "tokens": denom}
+
+    return _jit_train_step(forward_loss, optimizer, mesh, state_sharding)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
+                       mesh: Mesh, state_sharding,
+                       *, num_microbatches: int) -> Callable:
+    """Pipeline-parallel LLaMA train step (GPipe over the ``pp`` mesh axis).
+
+    Split of labour (SURVEY.md §2 promised TP/PP as first-class — the
+    reference delegates all of it to in-container Fleet):
+
+    - embedding and LM head run under plain GSPMD (their params follow the
+      usual fsdp/tp rules);
+    - the decoder trunk runs inside ``shard_map`` as a real pipeline:
+      activations are split into ``num_microbatches`` microbatches that
+      stream through the pp stages, hopping stage→stage on ICI via
+      ``ppermute`` (parallel/pipeline.py); each stage applies its local
+      ``n_layers/pp`` block with :class:`models.llama.LayerStack` — the
+      same scanned/remat layer body as the non-pp path, so losses match;
+    - loss is computed on the (pp-replicated) last-stage output.
+
+    Composes with dp/fsdp on the batch dim.  tp/cp must be 1: in-stage
+    tensor collectives are hand-written inside shard_map and not wired yet.
+    """
+    from paddle_operator_tpu.models.llama import (
+        LayerStack,
+        embed_module,
+        final_norm_module,
+        lm_head_module,
+        rope_frequencies,
+    )
+    from paddle_operator_tpu.parallel import pipeline as PP
+
+    sizes = mesh_axis_sizes(mesh)
+    pp = sizes.get("pp", 1)
+    if pp <= 1:
+        raise ValueError("make_pp_train_step needs a mesh with pp > 1")
+    if sizes.get("tp", 1) != 1 or sizes.get("cp", 1) != 1:
+        raise ValueError("pp train step composes with dp/fsdp only "
+                         "(tp and cp must be 1)")
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+
+    stack = LayerStack(cfg, cfg.n_layers // pp)
+
+    def stage_fn(stage_params, h):
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                    cfg.rope_theta)
+        return stack.apply({"params": {"layers": stage_params}}, h, cos, sin)
+
+    pipe = PP.make_pipeline_fn(mesh, stage_fn,
+                               num_microbatches=num_microbatches)
+
+    # Head/tail are the same module definitions Llama.__call__ composes
+    # (models/llama.py), applied standalone on their param subtrees.
+    embed_mod = embed_module(cfg)
+    norm_mod = final_norm_module(cfg)
+    head_mod = lm_head_module(cfg)
+
+    def forward_loss(params, inputs, targets, mask):
+        x = embed_mod.apply({"params": params["tok_embed"]}, inputs)
+        b = x.shape[0]
+        xm = PP.microbatch(x, num_microbatches)
+        ym = pipe(params["layers"], xm)
+        y = ym.reshape(b, *ym.shape[2:])
+        y = norm_mod.apply({"params": params["final_norm"]}, y)
+        logits = head_mod.apply(
+            {"params": params["lm_head"]}, y).astype(jnp.float32)
+        loss, denom = cross_entropy_loss(logits, targets, mask)
+        return loss, {"loss": loss, "tokens": denom}
+
+    return _jit_train_step(forward_loss, optimizer, mesh, state_sharding)
+
+
+def make_step_for_mesh(model: nn.Module, cfg,
+                       optimizer: optax.GradientTransformation,
+                       mesh: Mesh, state_sharding=None,
+                       *, num_microbatches: int = 4) -> Callable:
+    """Pick the right train step for the mesh: the GPipe step when pp > 1,
+    the plain GSPMD step otherwise."""
+    if mesh_axis_sizes(mesh).get("pp", 1) > 1:
+        return make_pp_train_step(cfg, optimizer, mesh, state_sharding,
+                                  num_microbatches=num_microbatches)
+    return make_train_step(model, optimizer, mesh, state_sharding)
 
 
 def make_eval_step(model: nn.Module, mesh: Mesh,
