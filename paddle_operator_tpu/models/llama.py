@@ -49,24 +49,49 @@ class LlamaConfig:
     # "full" (recompute everything — fastest measured on v5e),
     # "save_attn" (keep flash-attention outputs), "dots" (save matmul outs)
     remat_policy: str = "full"
+    # Mixture-of-Experts: n_experts > 0 replaces every layer's SwiGLU MLP
+    # with a Switch-style top-1 MoE (models/moe.py), expert-sharded over the
+    # `ep` mesh axis.  The model then returns (logits, aux_loss) where
+    # aux_loss is the load-balancing loss already scaled by moe_aux_weight.
+    n_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
     def flops_per_token(self) -> float:
-        """Approximate training FLOPs/token (fwd+bwd ≈ 6N + attention)."""
-        n_params = self.num_params()
+        """Approximate training FLOPs/token (fwd+bwd ≈ 6N_active +
+        attention).  For MoE, N_active counts ONE expert per token (top-1
+        routing) — using total params would inflate MFU by ~n_experts on
+        the FFN share."""
+        n_params = self.active_params()
         attn = 12 * self.n_layers * self.dim * self.max_seq_len
         return 6 * n_params + attn
 
+    def active_params(self) -> int:
+        """Params touched per token: equals num_params() for dense configs;
+        for MoE the per-layer FFN counts router + a single expert."""
+        if self.n_experts <= 0:
+            return self.num_params()
+        d, f = self.dim, self.ffn_dim
+        all_experts = self.n_experts * 2 * d * f
+        one_expert = 2 * d * f
+        return self.num_params() - self.n_layers * (all_experts - one_expert)
+
     def num_params(self) -> int:
         d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        if self.n_experts > 0:
+            # router [D, E] + per-expert w1 [D, F], w2 [F, D] (models/moe.py)
+            ffn = d * self.n_experts + self.n_experts * 2 * d * f
+        else:
+            ffn = 3 * d * f                            # w1, w2, w3 (SwiGLU)
         per_layer = (
             d * self.n_heads * self.head_dim           # wq
             + 2 * d * self.n_kv_heads * self.head_dim  # wk, wv
             + self.n_heads * self.head_dim * d         # wo
-            + 3 * d * f                                # w1, w2, w3
+            + ffn
             + 2 * d                                    # norms
         )
         return v * d + self.n_layers * per_layer + d + d * v
@@ -76,9 +101,13 @@ class LlamaConfig:
 CONFIGS = {
     "tiny": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                         n_kv_heads=2, ffn_dim=128, max_seq_len=128),
+    "tiny-moe": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                            n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                            n_experts=4),
     "1b": LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
                       n_kv_heads=16, ffn_dim=5504),
     "7b": LlamaConfig(),
+    "7b-moe": LlamaConfig(n_experts=8),   # Switch-style 8-expert variant
     "13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
                        ffn_dim=13824),
 }
@@ -209,12 +238,23 @@ class DecoderLayer(nn.Module):
         h = x + Attention(cfg, self.mesh, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
                     name="attn_norm")(x), cos, sin, segment_ids)
-        out = h + MLP(cfg, name="mlp")(
-            RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
-                    name="mlp_norm")(h))
-        # (carry, scan-output) pair — the scan axis carries only the
-        # hidden state; cos/sin/segment_ids are broadcast.
-        return out, None
+        normed = RMSNorm(cfg.norm_eps, cfg.dtype, cfg.param_dtype,
+                         name="mlp_norm")(h)
+        if cfg.n_experts > 0:
+            from paddle_operator_tpu.models.moe import MoEConfig, MoELayer
+
+            ffn_out, aux = MoELayer(MoEConfig(
+                dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            ), name="moe")(normed)
+        else:
+            ffn_out, aux = MLP(cfg, name="mlp")(normed), None
+        out = h + ffn_out
+        # (carry, scan-output) pair — the scan axis carries the hidden
+        # state; the per-layer MoE aux loss rides the scan output (stacked
+        # [n_layers] by nn.scan, summed in Llama.__call__).
+        return out, aux
 
 
 def _layer_cls(cfg: LlamaConfig):
@@ -293,8 +333,11 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
-        """[B, S] int32 tokens -> [B, S, vocab] logits."""
+                 segment_ids: Optional[jax.Array] = None):
+        """[B, S] int32 tokens -> [B, S, vocab] logits, or
+        (logits, aux_loss) when the config is MoE (n_experts > 0): aux_loss
+        is the summed per-layer load-balancing loss scaled by
+        cfg.moe_aux_weight, to be ADDED to the task loss by the trainer."""
         cfg = self.cfg
         x = embed_module(cfg, name="tok_embed")(tokens)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
@@ -303,16 +346,23 @@ class Llama(nn.Module):
         layer_cls = _layer_cls(cfg)
 
         if cfg.scan_layers:
-            x, _ = _scanned(layer_cls, cfg.n_layers)(
+            x, aux = _scanned(layer_cls, cfg.n_layers)(
                 cfg, self.mesh, name="layers")(x, cos, sin, segment_ids)
+            aux_sum = aux.sum() if aux is not None else None
         else:
+            aux_sum = None
             for i in range(cfg.n_layers):
-                x, _ = layer_cls(cfg, self.mesh, name=f"layer_{i}")(
+                x, aux = layer_cls(cfg, self.mesh, name=f"layer_{i}")(
                     x, cos, sin, segment_ids)
+                if aux is not None:
+                    aux_sum = aux if aux_sum is None else aux_sum + aux
 
         x = final_norm_module(cfg, name="final_norm")(x)
         logits = lm_head_module(cfg, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        logits = logits.astype(jnp.float32)
+        if cfg.n_experts > 0:
+            return logits, aux_sum * cfg.moe_aux_weight
+        return logits
 
 
 # nn.scan stacks layer params with a leading dim; DecoderLayer body needs
@@ -330,6 +380,8 @@ _LAYER_PATTERNS = [
 ]
 
 
+
+
 def partition_patterns(cfg: LlamaConfig):
     """(path-regex, logical spec) table for parallel.sharding.tree_shardings."""
     pats = [
@@ -337,7 +389,15 @@ def partition_patterns(cfg: LlamaConfig):
         (r"final_norm/scale", ("embed",)),
         (r"lm_head/kernel", ("embed", "vocab")),
     ]
-    for pat, spec in _LAYER_PATTERNS:
+    layer_pats = list(_LAYER_PATTERNS)
+    if cfg.n_experts > 0:
+        # MoE params under the "moe" submodule: expert axis → ep mesh axis,
+        # so GSPMD lowers dispatch/combine einsums to all-to-alls.  Derived
+        # from moe.py's canonical table so the specs cannot drift.
+        from paddle_operator_tpu.models.moe import moe_partition_patterns
+
+        layer_pats += moe_partition_patterns(prefix="moe/")
+    for pat, spec in layer_pats:
         if cfg.scan_layers:
             pats.append((pat, ("layers",) + spec))
         else:

@@ -88,16 +88,20 @@ class MoELayer(nn.Module):
         return out.reshape(b, s, d).astype(x.dtype), aux
 
 
-MOE_PATTERNS = [
-    (r"router/kernel", ("embed", None)),
-    (r"moe.*/w1", ("expert", "embed", "mlp")),
-    (r"moe.*/w2", ("expert", "mlp", "embed")),
-    (r"/w1$", ("expert", "embed", "mlp")),
-    (r"/w2$", ("expert", "mlp", "embed")),
-]
+# Canonical logical specs for MoELayer's params, keyed by param path
+# relative to the layer.  Single source of truth: models/llama.py derives
+# its scan-prefixed rows from this table, so the specs cannot drift from
+# the param shapes above.
+MOE_PARAM_SPECS = {
+    "router/kernel": ("embed", None),
+    "w1": ("expert", "embed", "mlp"),
+    "w2": ("expert", "mlp", "embed"),
+}
 
 
-def moe_partition_patterns():
+def moe_partition_patterns(prefix: str = ""):
     """(path-regex, logical spec) rows for parallel.sharding — merge into a
-    model's pattern table."""
-    return list(MOE_PATTERNS)
+    model's pattern table.  `prefix` anchors the rows under a submodule
+    path (e.g. ``"moe/"`` when MoELayer is mounted as ``name="moe"``)."""
+    return [(rf"{prefix}{name}$", spec)
+            for name, spec in MOE_PARAM_SPECS.items()]

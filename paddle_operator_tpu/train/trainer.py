@@ -156,9 +156,17 @@ def make_train_step(model: nn.Module,
     """
 
     def forward_loss(params, inputs, targets, mask):
-        logits = model.apply({"params": params}, inputs)
+        out = model.apply({"params": params}, inputs)
+        # MoE models return (logits, aux): aux is the load-balancing loss
+        # already scaled by the model (models/llama.py Llama.__call__) —
+        # it joins the optimized total but not the reported task loss.
+        logits, aux = out if isinstance(out, tuple) else (out, None)
         loss, denom = cross_entropy_loss(logits, targets, mask)
-        return loss, {"loss": loss, "tokens": denom}
+        metrics = {"loss": loss, "tokens": denom}
+        if aux is None:
+            return loss, metrics
+        metrics["aux_loss"] = aux
+        return loss + aux, metrics
 
     return _jit_train_step(forward_loss, optimizer, mesh, state_sharding)
 
@@ -206,6 +214,9 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
                          "(tp and cp must be 1)")
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if getattr(cfg, "n_experts", 0) > 0:
+        raise ValueError("pp train step does not compose with MoE yet "
+                         "(LayerStack drops the aux loss); use ep×dp/fsdp")
 
     stack = LayerStack(cfg, cfg.n_layers // pp)
 
@@ -256,7 +267,8 @@ def make_eval_step(model: nn.Module, mesh: Mesh,
 
     def eval_fn(params, batch):
         tokens = batch["tokens"]
-        logits = model.apply({"params": params}, tokens[:, :-1])
+        out = model.apply({"params": params}, tokens[:, :-1])
+        logits = out[0] if isinstance(out, tuple) else out
         loss, _ = cross_entropy_loss(logits, tokens[:, 1:],
                                      batch.get("mask"))
         return {"loss": loss}
