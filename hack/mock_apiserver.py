@@ -65,6 +65,8 @@ def make_handler(api: FakeAPI):
             if not m:
                 return self._send(404, {"reason": "NotFound"})
             ns, kind, name, _, query = m
+            if not name and query.get("watch") == ["true"]:
+                return self._watch(ns, kind, query)
             with lock:
                 if name:
                     try:
@@ -79,6 +81,57 @@ def make_handler(api: FakeAPI):
                     items = [o for o in items
                              if o.get("metadata", {}).get("labels", {}).get(key) == val]
                 return self._send(200, {"kind": f"{kind}List", "items": items})
+
+        def _watch(self, ns, kind, query):
+            """``?watch=true``: newline-delimited JSON event stream (the
+            k8s watch dialect).  Starts with ADDED for existing objects;
+            blank-line heartbeats let us detect client disconnect.  Honors
+            ``labelSelector`` like the plain list path."""
+            import copy as _copy
+            import queue as _queue
+
+            sel = query.get("labelSelector", [None])[0]
+            sel_key, _, sel_val = (sel or "").partition("=")
+
+            def matches(obj):
+                if not sel:
+                    return True
+                labels = obj.get("metadata", {}).get("labels", {}) or {}
+                return labels.get(sel_key) == sel_val
+
+            with lock:
+                sub = api.subscribe(kind)
+                # deepcopy under the lock: handler threads must not
+                # serialize live store dicts while others mutate them
+                existing = [_copy.deepcopy(o)
+                            for (k, n2, _), o in sorted(api.store.items())
+                            if k == kind and n2 == ns]
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            try:
+                for obj in existing:
+                    if matches(obj):
+                        self.wfile.write(
+                            json.dumps({"type": "ADDED",
+                                        "object": obj}).encode() + b"\n")
+                self.wfile.flush()
+                while True:
+                    try:
+                        evt = sub.get(timeout=1.0)
+                    except _queue.Empty:
+                        self.wfile.write(b"\n")   # heartbeat
+                        self.wfile.flush()
+                        continue
+                    obj = evt["object"]
+                    ons = obj.get("metadata", {}).get("namespace", "default")
+                    if ons == ns and matches(obj):
+                        self.wfile.write(json.dumps(evt).encode() + b"\n")
+                        self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            finally:
+                api.unsubscribe(sub)
 
         def do_POST(self):  # noqa: N802
             m = self._match()

@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import copy
 import itertools
-from typing import Any, Dict, List, Optional, Tuple
+import queue
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from paddle_operator_tpu.controller.api_client import APIClient, Conflict, NotFound
 
@@ -28,6 +30,13 @@ class FakeAPI(APIClient):
         self.events: List[Dict[str, Any]] = []
         self._rv = itertools.count(1)
         self._uid = itertools.count(1)
+        # watch subscribers: (kind, queue) — every mutation pushes a
+        # {"type": ADDED|MODIFIED|DELETED, "object": ...} event (the k8s
+        # watch dialect, mirroring the reference's informer feed)
+        self._subs: List[Tuple[str, "queue.Queue"]] = []
+        # The watch-driven manager makes this store multi-threaded (pump /
+        # resync / worker threads); RLock because delete() cascades.
+        self._lock = threading.RLock()
 
     # -- internal ----------------------------------------------------------
 
@@ -38,47 +47,99 @@ class FakeAPI(APIClient):
     def _bump(self, obj: Dict[str, Any]) -> None:
         obj["metadata"]["resourceVersion"] = str(next(self._rv))
 
+    def _notify(self, kind: str, etype: str, obj: Dict[str, Any]) -> None:
+        for k, q in list(self._subs):
+            if k == kind:
+                q.put({"type": etype, "object": copy.deepcopy(obj)})
+
+    # -- watch -------------------------------------------------------------
+
+    def subscribe(self, kind: str) -> "queue.Queue":
+        q: "queue.Queue" = queue.Queue()
+        self._subs.append((kind, q))
+        return q
+
+    def unsubscribe(self, q: "queue.Queue") -> None:
+        self._subs = [(k, s) for (k, s) in self._subs if s is not q]
+
+    def watch(self, kind: str, namespace: str,
+              stop=None, timeout: float = 1.0) -> Iterator[Dict[str, Any]]:
+        """Yield watch events for `kind` until `stop` (threading.Event) is
+        set.  Starts with synthetic ADDED events for existing objects, like
+        a k8s watch at resourceVersion=0."""
+        with self._lock:
+            q = self.subscribe(kind)
+            initial = self.list_kind(kind, namespace)
+        try:
+            for obj in initial:
+                yield {"type": "ADDED", "object": obj}
+            while stop is None or not stop.is_set():
+                try:
+                    evt = q.get(timeout=timeout)
+                except queue.Empty:
+                    continue
+                ns = evt["object"].get("metadata", {}).get("namespace",
+                                                           "default")
+                if ns == namespace:
+                    yield evt
+        finally:
+            self.unsubscribe(q)
+
     # -- APIClient ---------------------------------------------------------
 
+    def list_kind(self, kind: str, namespace: str) -> List[Dict[str, Any]]:
+        """Locked snapshot of every `kind` object in `namespace` (what the
+        manager's resync and the hostport manager list)."""
+        with self._lock:
+            return [copy.deepcopy(o) for (k, ns, _), o in
+                    sorted(self.store.items())
+                    if k == kind and ns == namespace]
+
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
-        try:
-            return copy.deepcopy(self.store[(kind, namespace, name)])
-        except KeyError:
-            raise NotFound(f"{kind} {namespace}/{name}")
+        with self._lock:
+            try:
+                return copy.deepcopy(self.store[(kind, namespace, name)])
+            except KeyError:
+                raise NotFound(f"{kind} {namespace}/{name}")
 
     def list_owned(self, kind: str, namespace: str, owner_name: str) -> List[Dict[str, Any]]:
-        out = []
-        for (k, ns, _), obj in sorted(self.store.items()):
-            if k == kind and ns == namespace and self.controller_of(obj) == owner_name:
-                out.append(copy.deepcopy(obj))
-        return out
+        with self._lock:
+            return [copy.deepcopy(obj)
+                    for (k, ns, _), obj in sorted(self.store.items())
+                    if k == kind and ns == namespace
+                    and self.controller_of(obj) == owner_name]
 
     def create(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
-        key = self._key(kind, obj)
-        if key in self.store:
-            raise Conflict(f"{kind} {key[1]}/{key[2]} already exists")
-        obj = copy.deepcopy(obj)
-        meta = obj.setdefault("metadata", {})
-        meta.setdefault("uid", f"uid-{next(self._uid)}")
-        self._bump(obj)
-        self.store[key] = obj
-        return copy.deepcopy(obj)
+        with self._lock:
+            key = self._key(kind, obj)
+            if key in self.store:
+                raise Conflict(f"{kind} {key[1]}/{key[2]} already exists")
+            obj = copy.deepcopy(obj)
+            meta = obj.setdefault("metadata", {})
+            meta.setdefault("uid", f"uid-{next(self._uid)}")
+            self._bump(obj)
+            self.store[key] = obj
+            self._notify(kind, "ADDED", obj)
+            return copy.deepcopy(obj)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        key = (kind, namespace, name)
-        if key not in self.store:
-            raise NotFound(f"{kind} {namespace}/{name}")
-        obj = self.store[key]
-        finalizers = obj["metadata"].get("finalizers") or []
-        if finalizers:
-            # Mirror apiserver semantics: finalized objects linger with a
-            # deletionTimestamp until finalizers are stripped.
-            if not obj["metadata"].get("deletionTimestamp"):
-                obj["metadata"]["deletionTimestamp"] = "now"
-                self._bump(obj)
-            return
-        del self.store[key]
-        self._cascade(namespace, name)
+        with self._lock:
+            key = (kind, namespace, name)
+            if key not in self.store:
+                raise NotFound(f"{kind} {namespace}/{name}")
+            obj = self.store[key]
+            finalizers = obj["metadata"].get("finalizers") or []
+            if finalizers:
+                # Mirror apiserver semantics: finalized objects linger with
+                # a deletionTimestamp until finalizers are stripped.
+                if not obj["metadata"].get("deletionTimestamp"):
+                    obj["metadata"]["deletionTimestamp"] = "now"
+                    self._bump(obj)
+                    self._notify(kind, "MODIFIED", obj)
+                return
+            del self.store[key]
+            self._notify(kind, "DELETED", obj)
+            self._cascade(namespace, name)
 
     def _cascade(self, namespace: str, owner_name: str) -> None:
         """Garbage-collect owned objects (apiserver GC behavior the
@@ -88,39 +149,45 @@ class FakeAPI(APIClient):
             obj = self.store[key]
             if not obj["metadata"].get("finalizers"):
                 del self.store[key]
+                self._notify(key[0], "DELETED", obj)
 
     def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
-        key = self._key(kind, obj)
-        if key not in self.store:
-            raise NotFound(f"{kind} {key[1]}/{key[2]}")
-        cur = self.store[key]
-        if obj["metadata"].get("resourceVersion") != cur["metadata"].get("resourceVersion"):
-            raise Conflict(f"{kind} {key[2]}: resourceVersion mismatch")
-        obj = copy.deepcopy(obj)
-        # Status is a subresource: full-object update cannot change it.
-        if "status" in cur:
-            obj["status"] = copy.deepcopy(cur["status"])
-        # Finalizer removal completes a pending delete.
-        if cur["metadata"].get("deletionTimestamp"):
-            obj["metadata"]["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
-            if not obj["metadata"].get("finalizers"):
-                del self.store[key]
-                self._cascade(key[1], key[2])
-                return obj
-        self._bump(obj)
-        self.store[key] = obj
-        return copy.deepcopy(obj)
+        with self._lock:
+            key = self._key(kind, obj)
+            if key not in self.store:
+                raise NotFound(f"{kind} {key[1]}/{key[2]}")
+            cur = self.store[key]
+            if obj["metadata"].get("resourceVersion") != cur["metadata"].get("resourceVersion"):
+                raise Conflict(f"{kind} {key[2]}: resourceVersion mismatch")
+            obj = copy.deepcopy(obj)
+            # Status is a subresource: full-object update cannot change it.
+            if "status" in cur:
+                obj["status"] = copy.deepcopy(cur["status"])
+            # Finalizer removal completes a pending delete.
+            if cur["metadata"].get("deletionTimestamp"):
+                obj["metadata"]["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
+                if not obj["metadata"].get("finalizers"):
+                    del self.store[key]
+                    self._notify(kind, "DELETED", obj)
+                    self._cascade(key[1], key[2])
+                    return obj
+            self._bump(obj)
+            self.store[key] = obj
+            self._notify(kind, "MODIFIED", obj)
+            return copy.deepcopy(obj)
 
     def update_status(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
-        key = self._key(kind, obj)
-        if key not in self.store:
-            raise NotFound(f"{kind} {key[1]}/{key[2]}")
-        cur = self.store[key]
-        if obj["metadata"].get("resourceVersion") != cur["metadata"].get("resourceVersion"):
-            raise Conflict(f"{kind} {key[2]}: resourceVersion mismatch")
-        cur["status"] = copy.deepcopy(obj.get("status", {}))
-        self._bump(cur)
-        return copy.deepcopy(cur)
+        with self._lock:
+            key = self._key(kind, obj)
+            if key not in self.store:
+                raise NotFound(f"{kind} {key[1]}/{key[2]}")
+            cur = self.store[key]
+            if obj["metadata"].get("resourceVersion") != cur["metadata"].get("resourceVersion"):
+                raise Conflict(f"{kind} {key[2]}: resourceVersion mismatch")
+            cur["status"] = copy.deepcopy(obj.get("status", {}))
+            self._bump(cur)
+            self._notify(kind, "MODIFIED", cur)
+            return copy.deepcopy(cur)
 
     def record_event(self, obj: Dict[str, Any], event_type: str, reason: str,
                     message: str) -> None:
@@ -145,31 +212,37 @@ class FakeFleet:
 
     def schedule_all(self) -> None:
         """Assign IPs and move Pending pods to Pending-with-IP (scheduled)."""
-        for _, pod in self._pods():
-            st = pod.setdefault("status", {})
-            st.setdefault("phase", "Pending")
-            if not st.get("podIP"):
-                st["podIP"] = f"10.1.0.{next(self._ip)}"
+        with self.api._lock:
+            for _, pod in self._pods():
+                st = pod.setdefault("status", {})
+                st.setdefault("phase", "Pending")
+                if not st.get("podIP"):
+                    st["podIP"] = f"10.1.0.{next(self._ip)}"
+                    self.api._notify("Pod", "MODIFIED", pod)
 
     def run_all(self) -> None:
         """Flip every pod to a fully-ready Running state."""
-        self.schedule_all()
-        for _, pod in self._pods():
-            st = pod["status"]
-            st["phase"] = "Running"
-            st["containerStatuses"] = [
-                {"name": c.get("name", "main"), "ready": True,
-                 "state": {"running": {}}}
-                for c in pod.get("spec", {}).get("containers", [])
-            ]
+        with self.api._lock:
+            self.schedule_all()
+            for _, pod in self._pods():
+                st = pod["status"]
+                st["phase"] = "Running"
+                st["containerStatuses"] = [
+                    {"name": c.get("name", "main"), "ready": True,
+                     "state": {"running": {}}}
+                    for c in pod.get("spec", {}).get("containers", [])
+                ]
+                self.api._notify("Pod", "MODIFIED", pod)
 
     def set_phase(self, pod_name: str, phase: str) -> None:
-        key = ("Pod", self.namespace, pod_name)
-        pod = self.api.store[key]
-        st = pod.setdefault("status", {})
-        st["phase"] = phase
-        if phase in ("Succeeded", "Failed"):
-            st["containerStatuses"] = []
+        with self.api._lock:
+            key = ("Pod", self.namespace, pod_name)
+            pod = self.api.store[key]
+            st = pod.setdefault("status", {})
+            st["phase"] = phase
+            if phase in ("Succeeded", "Failed"):
+                st["containerStatuses"] = []
+            self.api._notify("Pod", "MODIFIED", pod)
 
     def fail(self, pod_name: str) -> None:
         self.set_phase(pod_name, "Failed")

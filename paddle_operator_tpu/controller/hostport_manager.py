@@ -105,9 +105,8 @@ class HostPortManager:
         return done
 
     def list_objects(self) -> List[dict]:
-        if hasattr(self.api, "store"):  # FakeAPI
-            return [o for (k, ns, _), o in sorted(self.api.store.items())
-                    if k == self.kind and ns == self.namespace]
+        if hasattr(self.api, "list_kind"):  # FakeAPI (locked snapshot)
+            return self.api.list_kind(self.kind, self.namespace)
         from paddle_operator_tpu import GROUP, PLURAL, VERSION
 
         url = (f"{self.api.host}/apis/{GROUP}/{VERSION}/namespaces/"

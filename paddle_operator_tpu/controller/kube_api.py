@@ -100,6 +100,42 @@ class KubeAPI(APIClient):
     def get(self, kind: str, namespace: str, name: str) -> Dict[str, Any]:
         return self._request("GET", self._url(kind, namespace, name))
 
+    def watch(self, kind: str, namespace: str, *, stop=None,
+              label_selector: Optional[str] = None,
+              read_timeout: float = 30.0):
+        """Stream k8s watch events (``?watch=true`` newline-delimited JSON,
+        the reference's informer transport).  Reconnects internally until
+        `stop` (threading.Event) is set; yields {"type", "object"} dicts."""
+        import socket
+
+        while stop is None or not stop.is_set():
+            params = {"watch": "true"}
+            if label_selector:
+                params["labelSelector"] = label_selector
+            url = self._url(kind, namespace,
+                            query=urllib.parse.urlencode(params))
+            req = urllib.request.Request(url, method="GET")
+            req.add_header("Accept", "application/json")
+            if self.token:
+                req.add_header("Authorization", f"Bearer {self.token}")
+            kwargs = {"context": self._ctx} if url.startswith("https") else {}
+            try:
+                with urllib.request.urlopen(req, timeout=read_timeout,
+                                            **kwargs) as resp:
+                    for line in resp:
+                        if stop is not None and stop.is_set():
+                            return
+                        line = line.strip()
+                        if line:   # blank lines are server heartbeats
+                            yield json.loads(line)
+            except (urllib.error.URLError, socket.timeout, OSError,
+                    json.JSONDecodeError):
+                if stop is not None:
+                    stop.wait(0.5)
+                else:
+                    return
+            # stream closed: reconnect (list+watch resume)
+
     def list_owned(self, kind: str, namespace: str, owner_name: str) -> List[Dict[str, Any]]:
         q = urllib.parse.urlencode(
             {"labelSelector": f"{GANG_LABEL}={owner_name}"}

@@ -380,8 +380,6 @@ _LAYER_PATTERNS = [
 ]
 
 
-
-
 def partition_patterns(cfg: LlamaConfig):
     """(path-regex, logical spec) table for parallel.sharding.tree_shardings."""
     pats = [
