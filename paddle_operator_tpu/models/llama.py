@@ -291,17 +291,22 @@ class LayerStack(nn.Module):
     :class:`Llama`.  The pipeline-parallel train step
     (train/trainer.py make_pp_train_step) applies this per stage inside
     shard_map with the stage's local slice of the layer-stacked params
-    (`layers` axis sharded over the `pp` mesh axis)."""
+    (`layers` axis sharded over the `pp` mesh axis).  `mesh` flows to the
+    layers' Attention exactly as in :class:`Llama` (enables ring attention
+    when cp > 1 — nested manual region inside the pipeline body).
+
+    Returns ``(x, aux)``: aux is the summed per-layer MoE load-balancing
+    loss (un-scaled), or ``None`` for dense configs."""
 
     cfg: LlamaConfig
     n_layers: int
+    mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, cos: jax.Array,
-                 sin: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array):
         Scan = _scanned(_layer_cls(self.cfg), self.n_layers)
-        x, _ = Scan(self.cfg, None, name="layers")(x, cos, sin, None)
-        return x
+        x, aux = Scan(self.cfg, self.mesh, name="layers")(x, cos, sin, None)
+        return x, (aux.sum() if aux is not None else None)
 
 
 def embed_module(cfg: LlamaConfig, name: Optional[str] = None) -> nn.Embed:

@@ -13,6 +13,12 @@ primitive.  Design:
   (SPMD) so the whole thing jits once and differentiates automatically
   (``ppermute``'s transpose is the reverse permute, giving the backward
   pipeline for free).
+- **Composition with the other axes is by partial-manual shard_map**
+  (``axis_names={"pp"}``): only ``pp`` is manual inside the body; dp, fsdp,
+  tp, cp and ep stay *auto*, so GSPMD keeps stage params tp/fsdp-sharded
+  in place (no boundary all-gather), inserts tp activation collectives
+  inside each stage, and the stage body may itself open a nested manual
+  region over ``cp`` (ring attention, parallel/ring_attention.py).
 - Schedule: GPipe with M microbatches over P stages: M + P - 1 ticks, each
   tick runs every stage's local block once.  Bubble fraction
   (P-1)/(M+P-1) — choose M >= 4·P.
@@ -28,19 +34,44 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def pipeline_apply(layer_fn: Callable[[Any, jax.Array], jax.Array],
+def _psum_act(x: jax.Array, axis_name: str) -> jax.Array:
+    """psum that upcasts bf16 → f32 around the reduction: XLA:CPU folds a
+    bf16 all-reduce inside a *partial-manual* region into an invalid binary
+    "copy" instruction (hlo_instruction.cc CHECK crash, observed jax 0.9 /
+    8-device host platform).  One upcast on the final pipeline output is
+    noise next to the per-tick ppermutes, so apply it unconditionally."""
+    if x.dtype == jnp.bfloat16:
+        return jax.lax.psum(x.astype(jnp.float32),
+                            axis_name).astype(jnp.bfloat16)
+    return jax.lax.psum(x, axis_name)
+
+
+def pipeline_apply(layer_fn: Callable,
                    stage_params: Any,
                    x: jax.Array,
                    *, axis_name: str = "pp",
-                   num_microbatches: int) -> jax.Array:
-    """Run a stacked layer pipeline inside shard_map.
+                   num_microbatches: int,
+                   has_aux: bool = False,
+                   compute_dtype: Any = None):
+    """Run a stacked layer pipeline inside shard_map (manual over ``pp``).
 
-    layer_fn(stage_params, h) applies THIS stage's local layer block.
+    layer_fn(stage_params, h) applies THIS stage's local layer block; when
+    ``has_aux`` it returns ``(h, aux_scalar)`` (e.g. the MoE load-balancing
+    loss of the stage's layers) instead of ``h`` alone.
+
     x: [M, Bm, ...] microbatched input (every stage receives the same x;
-    only stage 0 actually consumes it).  Returns [M, Bm, ...] outputs
-    (valid on the LAST stage; other stages return zeros — callers keep
-    the loss computation on the last stage or psum it out).
+    only stage 0 actually consumes it).  Returns the last stage's outputs
+    [M, Bm, ...] **psum-replicated over pp** — every stage holds the same
+    result, so the out_spec is pp-replicated and the loss computes
+    identically everywhere.  With ``has_aux`` returns ``(out, aux)`` where
+    aux is the per-layer aux summed over stages, averaged over the M
+    microbatches, and likewise pp-replicated.
     """
+    # bf16 boundary dance (see _psum_act): the caller passes x upcast to
+    # f32 so the *cotangent* psum shard_map inserts for this replicated
+    # input is f32 too; compute resumes in the model dtype immediately.
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
     stage = jax.lax.axis_index(axis_name)
     n_stage = jax.lax.psum(1, axis_name)
     m = num_microbatches
@@ -50,7 +81,7 @@ def pipeline_apply(layer_fn: Callable[[Any, jax.Array], jax.Array],
     zero = jnp.zeros_like(x[0])
 
     def tick(carry, t):
-        prev_out = carry                       # activation arriving from left
+        prev_out, aux_acc = carry              # activation arriving from left
         # stage 0 feeds microbatch t (clamped); others feed the received act
         mb_idx = jnp.clip(t, 0, m - 1)
         my_in = jnp.where(stage == 0,
@@ -58,42 +89,77 @@ def pipeline_apply(layer_fn: Callable[[Any, jax.Array], jax.Array],
                                                        keepdims=False),
                           prev_out)
         live = (t - stage >= 0) & (t - stage < m)
-        out = layer_fn(stage_params, my_in)
+        if has_aux:
+            out, aux = layer_fn(stage_params, my_in)
+            aux_acc = aux_acc + jnp.where(live, aux.astype(jnp.float32), 0.0)
+        else:
+            out = layer_fn(stage_params, my_in)
         out = jnp.where(live, out, zero)
         nxt = jax.lax.ppermute(out, axis_name, perm)
-        return nxt, out
+        return (nxt, aux_acc), out
 
-    _, outs = jax.lax.scan(tick, zero, jnp.arange(ticks))
+    (_, aux_total), outs = jax.lax.scan(
+        tick, (zero, jnp.zeros((), jnp.float32)), jnp.arange(ticks))
     # The last stage emits microbatch j at tick j + (n_stage - 1); select
-    # those ticks and replicate the final stage's result to every stage
-    # (psum of a one-hot-by-stage contribution) so the out_spec can be
-    # pp-replicated and the loss computes identically everywhere.
+    # those ticks; psum the one-hot-by-stage contribution so every stage
+    # returns the identical last-stage result (pp-replicated out_spec).
     idx = jnp.arange(m) + n_stage - 1
     mine = outs[idx]
-    return jax.lax.psum(
+    out = _psum_act(
         jnp.where(stage == n_stage - 1, mine, jnp.zeros_like(mine)),
         axis_name,
     )
+    if not has_aux:
+        return out
+    # per-stage aux sums over that stage's live microbatches; psum over pp
+    # adds the stages (≙ sum over all layers), /m averages the microbatches.
+    aux_out = jax.lax.psum(aux_total, axis_name) / m
+    return out, aux_out
 
 
 def make_pipeline_fn(mesh: Mesh, layer_fn: Callable,
                      *, num_microbatches: int,
                      axis_name: str = "pp",
-                     data_axes=("dp", "fsdp")):
-    """shard_map wrapper: params sharded layers→pp, x sharded batch→data
-    axes, microbatch dim replicated."""
+                     has_aux: bool = False):
+    """Partial-manual shard_map wrapper: ONLY ``pp`` is manual; every other
+    mesh axis stays auto (GSPMD).  Consequences:
+
+    - stage params arrive sharded ``layers → pp`` manually while their
+      weight dims keep whatever fsdp/tp sharding the caller laid down —
+      FSDP memory savings survive inside the pipeline body;
+    - tensor-parallel collectives inside the stage block are inserted by
+      XLA as usual;
+    - the stage block may open a nested manual region over ``cp``
+      (ring attention does, via the context mesh).
+    """
     from jax import shard_map
 
-    fn = shard_map(
-        functools.partial(pipeline_apply, layer_fn,
-                          axis_name=axis_name,
-                          num_microbatches=num_microbatches),
-        mesh=mesh,
-        in_specs=(P(axis_name), P(None, data_axes)),
-        out_specs=P(None, data_axes),
-        check_vma=False,
-    )
-    return fn
+    in_specs = (P(axis_name), P())
+    out_specs = (P(), P()) if has_aux else P()
+
+    def call(stage_params, x):
+        # bf16 crosses the shard_map boundary as f32: shard_map transposes
+        # a replicated input into a psum of its cotangent, and a bf16 psum
+        # in a partial-manual region crashes XLA:CPU (see _psum_act).  The
+        # body casts straight back, so inter-stage ppermutes stay bf16.
+        compute_dtype = None
+        if x.dtype == jnp.bfloat16:
+            compute_dtype, x = x.dtype, x.astype(jnp.float32)
+        fn = shard_map(
+            functools.partial(pipeline_apply, layer_fn,
+                              axis_name=axis_name,
+                              num_microbatches=num_microbatches,
+                              has_aux=has_aux,
+                              compute_dtype=compute_dtype),
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            axis_names=frozenset({axis_name}),
+            check_vma=False,
+        )
+        return fn(stage_params, x)
+
+    return call
 
 
 def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:

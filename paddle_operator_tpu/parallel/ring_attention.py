@@ -39,12 +39,21 @@ def _chunk_scores(q, k, *, scale):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   ring_pos: Optional[jax.Array] = None,
                    *, axis_name: str = "cp",
                    causal: bool = True) -> jax.Array:
     """Per-device body: local [B, S_loc, H, D] shards, full attention over
     the distributed sequence.  Must run inside shard_map with `axis_name`
-    bound."""
-    my = jax.lax.axis_index(axis_name)
+    bound.
+
+    ring_pos: optional [1] int32 — this device's position on the ring
+    (the local chunk of an axis-sharded iota).  When None it is read with
+    ``jax.lax.axis_index``; passing it as data instead keeps the body legal
+    in a *nested* manual region (axis_index's lowering re-binds every mesh
+    axis, which MLIR rejects inside a parent manual computation — the pp
+    pipeline body)."""
+    my = (jax.lax.axis_index(axis_name) if ring_pos is None
+          else ring_pos[0])
     n = jax.lax.psum(1, axis_name)
     scale = q.shape[-1] ** -0.5
     b, s_loc, h, d = q.shape
@@ -96,21 +105,39 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
                            axis_name: str = "cp"):
     """shard_map-wrapped ring attention: global [B, S, H, D] arrays with the
-    sequence sharded over `axis_name`; batch over (dp, fsdp); heads over tp.
+    sequence sharded over `axis_name`.
+
+    Partial-manual: ONLY ``cp`` is manual; batch/head dims stay auto so
+    GSPMD keeps them on dp/fsdp/tp however the caller sharded them.  This
+    also makes the wrapper nestable inside another manual region (the pp
+    pipeline body, parallel/pipeline.py): when tracing already happens
+    inside a shard_map, the context's abstract mesh is used instead of the
+    concrete `mesh` (nested shard_map must inherit the ambient mesh).
 
     When the cp axis has size 1 this degrades to plain attention (the ring
     has one hop), so model code can call it unconditionally.
     """
     from jax import shard_map
 
-    qkv_spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    seq_spec = P(None, axis_name)
+
+    ctx = jax.sharding.get_abstract_mesh()
+    use_mesh = None if (ctx is not None and not ctx.empty) else mesh
+    sizes = ctx.shape if use_mesh is None else dict(mesh.shape)
+    size = sizes.get(axis_name, 1)
 
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name,
                           causal=causal),
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
-        out_specs=qkv_spec,
+        mesh=use_mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, P(axis_name)),
+        out_specs=seq_spec,
+        axis_names=frozenset({axis_name}),
         check_vma=False,
     )
-    return fn
+
+    def call(q, k, v):
+        # ring position as data (see ring_attention docstring)
+        return fn(q, k, v, jnp.arange(size, dtype=jnp.int32))
+
+    return call

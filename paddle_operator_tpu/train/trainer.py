@@ -181,20 +181,33 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
     """Pipeline-parallel LLaMA train step (GPipe over the ``pp`` mesh axis).
 
     Split of labour (SURVEY.md §2 promised TP/PP as first-class — the
-    reference delegates all of it to in-container Fleet):
+    reference's only hybrid hook is a rank id,
+    /root/reference/controllers/paddlejob_helper.go:203-206):
 
     - embedding and LM head run under plain GSPMD (their params follow the
       usual fsdp/tp rules);
-    - the decoder trunk runs inside ``shard_map`` as a real pipeline:
-      activations are split into ``num_microbatches`` microbatches that
-      stream through the pp stages, hopping stage→stage on ICI via
-      ``ppermute`` (parallel/pipeline.py); each stage applies its local
-      ``n_layers/pp`` block with :class:`models.llama.LayerStack` — the
-      same scanned/remat layer body as the non-pp path, so losses match;
+    - the decoder trunk runs inside a **partial-manual** ``shard_map``
+      (manual over pp only, parallel/pipeline.py): activations are split
+      into ``num_microbatches`` microbatches that stream through the pp
+      stages, hopping stage→stage on ICI via ``ppermute``; each stage
+      applies its local ``n_layers/pp`` block with
+      :class:`models.llama.LayerStack` — the same scanned/remat layer body
+      as the non-pp path, so losses match;
     - loss is computed on the (pp-replicated) last-stage output.
 
-    Composes with dp/fsdp on the batch dim.  tp/cp must be 1: in-stage
-    tensor collectives are hand-written inside shard_map and not wired yet.
+    Composes with ALL other axes — the full hybrid of BASELINE config 4:
+
+    - dp/fsdp shard the batch dim (auto inside the pipeline body; fsdp
+      weight shards survive — no boundary all-gather);
+    - tp shards stage weights heads/mlp-wise; XLA inserts the in-stage
+      activation collectives;
+    - cp runs ring attention as a nested manual region over the context
+      mesh (models/llama.py Attention via LayerStack.mesh);
+    - MoE (ep) routes **per microbatch** — capacity and the load-balancing
+      aux loss are computed on each microbatch (the standard pipelined-MoE
+      formulation), aux joins the optimized total scaled by
+      cfg.moe_aux_weight; the reported loss trajectory therefore matches
+      GSPMD-MoE only statistically, not bit-exactly.
     """
     from paddle_operator_tpu.models.llama import (
         LayerStack,
@@ -209,24 +222,25 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
     pp = sizes.get("pp", 1)
     if pp <= 1:
         raise ValueError("make_pp_train_step needs a mesh with pp > 1")
-    if sizes.get("tp", 1) != 1 or sizes.get("cp", 1) != 1:
-        raise ValueError("pp train step composes with dp/fsdp only "
-                         "(tp and cp must be 1)")
+    if not cfg.scan_layers:
+        raise ValueError("pp train step needs scan_layers=True (the "
+                         "stacked `layers` axis IS the pp-sharded dim)")
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
-    if getattr(cfg, "n_experts", 0) > 0:
-        raise ValueError("pp train step does not compose with MoE yet "
-                         "(LayerStack drops the aux loss); use ep×dp/fsdp")
+    moe = getattr(cfg, "n_experts", 0) > 0
 
-    stack = LayerStack(cfg, cfg.n_layers // pp)
+    stack = LayerStack(cfg, cfg.n_layers // pp, mesh)
 
     def stage_fn(stage_params, h):
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
-        return stack.apply({"params": {"layers": stage_params}}, h, cos, sin)
+        out, aux = stack.apply({"params": {"layers": stage_params}},
+                               h, cos, sin)
+        return (out, aux) if moe else out
 
     pipe = PP.make_pipeline_fn(mesh, stage_fn,
-                               num_microbatches=num_microbatches)
+                               num_microbatches=num_microbatches,
+                               has_aux=moe)
 
     # Head/tail are the same module definitions Llama.__call__ composes
     # (models/llama.py), applied standalone on their param subtrees.
@@ -238,13 +252,21 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
         x = embed_mod.apply({"params": params["tok_embed"]}, inputs)
         b = x.shape[0]
         xm = PP.microbatch(x, num_microbatches)
-        ym = pipe(params["layers"], xm)
+        if moe:
+            ym, aux = pipe(params["layers"], xm)
+        else:
+            ym, aux = pipe(params["layers"], xm), None
         y = ym.reshape(b, *ym.shape[2:])
         y = norm_mod.apply({"params": params["final_norm"]}, y)
         logits = head_mod.apply(
             {"params": params["lm_head"]}, y).astype(jnp.float32)
         loss, denom = cross_entropy_loss(logits, targets, mask)
-        return loss, {"loss": loss, "tokens": denom}
+        metrics = {"loss": loss, "tokens": denom}
+        if aux is None:
+            return loss, metrics
+        aux = aux * cfg.moe_aux_weight
+        metrics["aux_loss"] = aux
+        return loss + aux, metrics
 
     return _jit_train_step(forward_loss, optimizer, mesh, state_sharding)
 

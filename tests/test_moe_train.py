@@ -81,12 +81,23 @@ class TestMoETrain:
         assert np.isfinite(last)
         assert last <= first * 1.5   # not diverging away from balance
 
-    def test_pp_rejects_moe(self):
-        mesh = make_mesh(MeshSpec(pp=2, dp=4))
-        _, cfg = make_model("tiny-moe")
-        with pytest.raises(ValueError, match="MoE"):
-            T.make_pp_train_step(cfg, T.make_optimizer(), mesh, None,
-                                 num_microbatches=2)
+    def test_pp_moe_reports_aux(self):
+        # pipelined MoE (per-microbatch routing): aux must be reported and
+        # join the optimized total (tested to decrease in test_pp_train.py)
+        mesh = make_mesh(MeshSpec(pp=2, ep=2, dp=2))
+        model, cfg = make_model("tiny-moe")
+        opt = T.make_optimizer(1e-3, warmup_steps=2, decay_steps=10)
+        pats = partition_patterns(cfg)
+        example = (jnp.zeros((BATCH, SEQ), jnp.int32),)
+        shardings, _ = T.state_shardings(model, opt, mesh, pats, example)
+        state = T.create_state(model, opt, mesh, pats, example)
+        step = T.make_step_for_mesh(model, cfg, opt, mesh, shardings,
+                                    num_microbatches=2)
+        state, metrics = step(state, T.synthetic_batch(BATCH, SEQ + 1,
+                                                       cfg.vocab_size))
+        assert np.isfinite(float(metrics["loss"]))
+        assert np.isfinite(float(metrics["aux_loss"]))
+        assert float(metrics["aux_loss"]) > 0.0
 
     def test_eval_step_handles_moe_tuple(self):
         mesh, model, cfg, state, _ = _setup(MeshSpec(ep=2, dp=4))
