@@ -123,6 +123,9 @@ def get_job_phase(job: TPUJob) -> str:
         # Sticky until the reconciler finishes the teardown/recreate cycle
         # and moves the job to Pending itself (reconciler._restart).
         return Phase.RESTARTING
+    if st.phase == Phase.SCALING:
+        # Same stickiness for the gang-rescale cycle (reconciler._rescale).
+        return Phase.SCALING
     if st.ps.failed > 0 or st.worker.failed > 0 or st.heter.failed > 0:
         if st.restart_count < job.spec.max_restarts:
             return Phase.RESTARTING
@@ -265,22 +268,47 @@ def construct_configmap(job: TPUJob, child_pods: List[Dict[str, Any]]) -> Option
 
     tpu = job.spec.tpu
     if tpu is not None:
+        # Effective slice count is derived from the pods actually present,
+        # not the spec: the elastic clamp (reconciler._clamp_elastic) may
+        # have dropped whole slices below spec.tpu.slice_count.
+        wps = tpu.workers_per_slice()
+        eff_slices = (max(1, len(worker_hosts) // wps) if worker_hosts
+                      else tpu.slice_count)
         data["TPUJOB_ACCELERATOR"] = tpu.accelerator
         data["TPUJOB_TOPOLOGY"] = tpu.topology
-        data["TPUJOB_NUM_SLICES"] = str(tpu.slice_count)
-        data["TPUJOB_WORKERS_PER_SLICE"] = str(tpu.workers_per_slice())
-        if tpu.slice_count > 1 and worker_hosts:
+        data["TPUJOB_NUM_SLICES"] = str(eff_slices)
+        data["TPUJOB_WORKERS_PER_SLICE"] = str(wps)
+        if eff_slices > 1 and worker_hosts:
             # Multislice: DCN rendezvous via the megascale coordinator on
             # slice 0 worker 0 (successor of the Gloo HTTP endpoint on ps0,
             # reference helper.go:154-161).
             data["MEGASCALE_COORDINATOR_ADDRESS"] = (
                 f"{worker_hosts[0]}:{port + PORT_NUM - 2}"
             )
-            data["MEGASCALE_NUM_SLICES"] = str(tpu.slice_count)
+            data["MEGASCALE_NUM_SLICES"] = str(eff_slices)
             data["MEGASCALE_PORT"] = str(port + PORT_NUM - 2)
 
     if job.spec.mesh is not None:
-        data["TPUJOB_MESH"] = json.dumps(job.spec.mesh.to_dict() or {"dp": 1})
+        mesh_spec = job.spec.mesh
+        if tpu is not None and eff_slices != tpu.slice_count:
+            # Keep the contract internally consistent after an elastic
+            # slice drop: the spec mesh was validated against
+            # slice_count×chips and would over-ask for devices.  The dp
+            # axis is the across-slice axis by convention (parallel/mesh.py)
+            # — shrink it proportionally when possible, else fall back to
+            # pure data parallel over the remaining chips.
+            import dataclasses as _dc
+
+            num = mesh_spec.dp * eff_slices
+            if num % tpu.slice_count == 0 and num // tpu.slice_count >= 1:
+                mesh_spec = _dc.replace(mesh_spec,
+                                        dp=num // tpu.slice_count)
+            else:
+                from paddle_operator_tpu.api.types import MeshSpec
+
+                mesh_spec = MeshSpec(
+                    dp=tpu.chips_per_slice() * eff_slices)
+        data["TPUJOB_MESH"] = json.dumps(mesh_spec.to_dict() or {"dp": 1})
 
     if job.spec.checkpoint_path:
         data["TPUJOB_CHECKPOINT_PATH"] = job.spec.checkpoint_path

@@ -38,6 +38,7 @@ from paddle_operator_tpu.api.types import (
     CleanPodPolicy,
     ElasticStatus,
     Intranet,
+    JobMode,
     Phase,
     ResourceStatus,
     TPUJob,
@@ -82,6 +83,9 @@ class TPUJobReconciler:
         self.allocator = allocator or make_allocator()
         # job key -> adopted host-port block base (collision detection)
         self._adopted: Dict[str, int] = {}
+        # job key -> generation whose InvalidSpec event was already emitted
+        # (dedupe; re-emitted once after controller restart, which is fine)
+        self._invalid_warned: Dict[str, int] = {}
 
     # ------------------------------------------------------------------ API
 
@@ -94,6 +98,20 @@ class TPUJobReconciler:
 
         if self._finalize(job):
             return Result(requeue_after=1.0)
+
+        # -- spec validation gate (the reference leans on its 8.7k-line CRD
+        #    schema, config/crd/bases/batch.paddlepaddle.org_paddlejobs.yaml;
+        #    ours is thinner, so cross-field checks run in-controller): an
+        #    invalid job is HELD — warned once per generation, no pods
+        #    created or deleted until the spec is fixed -------------------
+        errs = job.validate()
+        if errs:
+            key = f"{namespace}/{name}"
+            if self._invalid_warned.get(key) != job.generation:
+                self._invalid_warned[key] = job.generation
+                self.api.record_event(raw, "Warning", "InvalidSpec",
+                                      "; ".join(errs))
+            return Result()
 
         child_pods = self.api.list_owned(KIND_POD, namespace, name)
 
@@ -120,7 +138,32 @@ class TPUJobReconciler:
         if job.status.phase == Phase.RESTARTING:
             return self._restart(job, child_pods)
 
-        # -- scale-down: drop pods beyond spec replicas
+        # -- gang rescale (improvement 2 done right): an XLA collective
+        #    world cannot resize, and running containers resolved their
+        #    envFrom ConfigMap at start — so a replica change on a RUNNING
+        #    collective job must tear the whole gang down and recreate it
+        #    at the new world size (resuming from the checkpoint path),
+        #    not prune pods around a live world.  Realizes the reference's
+        #    design doc (docs/design-fault-tolerant.md:17-54); its code
+        #    merely deletes/creates pods one per pass (controller.go:114-122,
+        #    176-208) and leaves the ConfigMap stale (SURVEY.md §3.4). ------
+        if job.status.phase == Phase.SCALING:
+            return self._rescale(job, child_pods)
+        gap = (self._scale_mismatch(job, child_pods)
+               if (job.status.phase == Phase.RUNNING
+                   and job.status.mode == JobMode.COLLECTIVE) else "")
+        if gap:
+            job.status.phase = Phase.SCALING
+            self.api.record_event(raw, "Normal", "Scaling", gap)
+            try:
+                self.api.update_status(KIND_JOB, job.to_dict())
+            except (Conflict, NotFound):
+                pass
+            return Result(requeue_after=1.0)
+
+        # -- scale-down: drop pods beyond spec replicas (PS-mode and
+        #    not-yet-running jobs; RUNNING collective jobs take the gang
+        #    rescale path above)
         #    (reference controller.go:114-122; also prunes the pod's
         #    headless Service, which the reference leaks) ------------------
         scaled_down = False
@@ -353,6 +396,54 @@ class TPUJobReconciler:
             pass
         return Result(requeue_after=1.0)
 
+    def _scale_mismatch(self, job: TPUJob,
+                        child_pods: List[Dict[str, Any]]) -> str:
+        """Human-readable description of any per-role gap between effective
+        (clamped) replicas and observed pods, or "" when in sync."""
+        have: Dict[str, int] = {}
+        for pod in child_pods:
+            res_type, _ = builders.extract_name_index(pod["metadata"]["name"])
+            have[res_type] = have.get(res_type, 0) + 1
+        gaps = []
+        for res_type, role in ((RESOURCE_PS, job.spec.ps),
+                               (RESOURCE_WORKER, job.spec.worker),
+                               (RESOURCE_HETER, job.spec.heter)):
+            want = role.replicas if role else 0
+            got = have.get(res_type, 0)
+            if want != got:
+                gaps.append(f"{res_type} {got}->{want}")
+        return ", ".join(gaps)
+
+    def _rescale(self, job: TPUJob, child_pods: List[Dict[str, Any]]) -> Result:
+        """Gang teardown for a replica change: like :meth:`_restart` (the
+        world size is changing, so the XLA world must re-form and resume
+        from the checkpoint) but WITHOUT consuming the failure-restart
+        budget — scaling is user intent, not a fault.  Per-pod services go
+        too (the new gang recreates its own; keeping stale ones would leak
+        them, as the reference does on scale-down)."""
+        if child_pods:
+            for pod in child_pods:
+                self._delete_child(job, KIND_POD, pod)
+            for svc in self.api.list_owned(KIND_SVC, job.namespace, job.name):
+                try:
+                    self.api.delete(KIND_SVC, job.namespace,
+                                    svc["metadata"]["name"])
+                except NotFound:
+                    pass
+            try:
+                self.api.delete(KIND_CM, job.namespace, job.name)
+            except NotFound:
+                pass
+            return Result(requeue_after=1.0)
+        job.status.phase = Phase.PENDING
+        self.api.record_event(job.to_dict(), "Normal", "Scaled",
+                              "gang recreated at new world size")
+        try:
+            self.api.update_status(KIND_JOB, job.to_dict())
+        except (Conflict, NotFound):
+            pass
+        return Result(requeue_after=1.0)
+
     def _clamp_elastic(self, job: TPUJob) -> bool:
         """Clamp each role's replicas into [requests, limits] on the
         in-memory job so every later computation (status, gang size,
@@ -370,6 +461,18 @@ class TPUJobReconciler:
             lo = role.requests if role.requests is not None else 0
             hi = role.limits if role.limits is not None else role.replicas
             role.replicas = min(max(role.replicas, lo), hi)
+            # TPU slices are atomic: a clamped WORKER count must stay a
+            # whole number of slices or the gang would tear a slice apart
+            # (types.py workers_per_slice invariant).  Snap DOWN only — a
+            # bound tighter than one slice yields 0 workers (the job
+            # parks) rather than exceeding the user's declared limits.
+            if role is job.spec.worker and job.spec.tpu is not None:
+                try:
+                    wps = job.spec.tpu.workers_per_slice()
+                except ValueError:
+                    continue
+                if wps > 1 and role.replicas % wps:
+                    role.replicas -= role.replicas % wps
         return bounded
 
     def _alloc_host_port(self, job: TPUJob) -> bool:

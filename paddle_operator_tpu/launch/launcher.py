@@ -144,10 +144,14 @@ def initialize(env: Optional[JobEnv] = None, *, force: bool = False) -> JobEnv:
             num_processes=env.num_workers,
             process_id=env.rank,
         )
-        # Export the slice-local host list for the libtpu runtime.
+        # Export the slice-local host list for the libtpu runtime.  Set
+        # unconditionally: the job contract is authoritative for operator-
+        # managed pods — a default leaked by a base image or site hook
+        # (e.g. TPU_WORKER_HOSTNAMES=localhost) would silently break
+        # multi-host topology discovery.
         hosts = env.slice_local_hosts()
         if hosts:
-            os.environ.setdefault("TPU_WORKER_HOSTNAMES", ",".join(hosts))
+            os.environ["TPU_WORKER_HOSTNAMES"] = ",".join(hosts)
     return env
 
 
@@ -166,7 +170,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     **execs** the user command, replacing this process.  The child — not
     the shim — calls :func:`initialize`, so exactly one process per rank
     registers with the XLA coordinator (a parent that initialized and then
-    spawned a child would occupy the rank's coordinator slot)."""
+    spawned a child would occupy the rank's coordinator slot).
+
+    In a **PS pod** with no command, the shim runs the embedding parameter
+    server (ps/server.py) — the default PS-tier program, the way the
+    reference's PS pods run Paddle's pserver loop
+    (/root/reference/docs/design-arch.md:5-12)."""
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
@@ -175,8 +184,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     env = JobEnv.from_env()
     hosts = env.slice_local_hosts()
     if hosts:
-        os.environ.setdefault("TPU_WORKER_HOSTNAMES", ",".join(hosts))
+        # unconditional for the same reason as initialize(): the contract
+        # outranks any pre-set default
+        os.environ["TPU_WORKER_HOSTNAMES"] = ",".join(hosts)
     if not argv:
+        if env.res_type == "ps":
+            from paddle_operator_tpu.ps import server as ps_server
+
+            return ps_server.main()
         print(json.dumps({
             "rank": env.rank, "num_workers": env.num_workers,
             "coordinator": env.coordinator_address,

@@ -78,6 +78,35 @@ class WideDeep(nn.Module):
         return wide + deep
 
 
+class WideDeepDense(nn.Module):
+    """The dense tail of :class:`WideDeep` for PS-mode training: embedding
+    rows arrive pre-gathered (pulled from the PS tier, ps/client.py) and
+    only the MLP/linear parameters live on the accelerator.  Same math as
+    WideDeep.__call__ after its Embed lookups, so the two paths train the
+    same model."""
+
+    cfg: WideDeepConfig
+
+    @nn.compact
+    def __call__(self, wide_rows: jax.Array, deep_rows: jax.Array,
+                 dense: jax.Array) -> jax.Array:
+        """wide_rows [B, F] (scalar weight per field), deep_rows [B, F, D],
+        dense [B, num_dense] -> [B] CTR logit."""
+        cfg = self.cfg
+        wide = wide_rows.sum(axis=1) + nn.Dense(
+            1, name="wide_dense", dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype)(dense)[:, 0]
+        b = deep_rows.shape[0]
+        h = jnp.concatenate(
+            [deep_rows.reshape(b, -1), dense.astype(cfg.dtype)], axis=-1)
+        for i, d in enumerate(cfg.mlp_dims):
+            h = nn.relu(nn.Dense(d, name=f"mlp_{i}", dtype=cfg.dtype,
+                                 param_dtype=cfg.param_dtype)(h))
+        deep = nn.Dense(1, name="deep_out", dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype)(h)[:, 0]
+        return wide + deep
+
+
 def partition_patterns(cfg: WideDeepConfig):
     """Embedding tables row-sharded over fsdp (the PS tier analogue);
     MLP small enough to replicate."""

@@ -1,0 +1,20 @@
+"""Parameter-server tier runtime.
+
+The reference's PS pods run Paddle's C++ parameter server (process model:
+/root/reference/docs/design-arch.md:5-12 — pserver processes hold parameter
+shards, trainers pull/push over ``PADDLE_PSERVERS_IP_PORT_LIST``).  This
+package is the TPU-native equivalent *runtime* for the PS tier the
+controller orchestrates:
+
+- :mod:`server` — the process a PS pod runs: range-sharded embedding
+  tables in host RAM behind a stdlib HTTP endpoint (pull rows / push row
+  gradients / per-row optimizer);
+- :mod:`client` — the worker-side consumer of ``TPUJOB_PS_ENDPOINTS``:
+  shards ids by row ownership, pulls rows for the jitted TPU step, pushes
+  gradients back;
+- :mod:`wide_deep` — the hybrid Wide&Deep train step (BASELINE config 1):
+  sparse tables on the PS tier, dense MLP on the XLA mesh.
+
+``parallel/ps.py`` remains the on-mesh alternative (tables sharded over
+ICI, lookup by psum) for jobs that fit embeddings in HBM.
+"""

@@ -1,0 +1,106 @@
+"""Worker-side parameter-server client.
+
+The consumer of ``TPUJOB_PS_ENDPOINTS`` (injected by the controller,
+controller/builders.py construct_configmap) — the TPU-native counterpart of
+Paddle trainers talking to pservers over ``PADDLE_PSERVERS_IP_PORT_LIST``
+(/root/reference/controllers/paddlejob_helper.go:146).
+
+Ids are partitioned by the same contiguous row-range split the servers use
+(ps/server.py shard_range); pull reassembles rows in request order, push
+routes each gradient row to its owner.  Transport: stdlib urllib over the
+pod network.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_operator_tpu.ps.server import shard_range
+
+
+def _post(url: str, body: bytes = b"", timeout: float = 30.0) -> bytes:
+    req = urllib.request.Request(url, data=body, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        out = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(f"{url}: {resp.status} {out[:200]!r}")
+        return out
+
+
+def _npz_bytes(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+class PSClient:
+    """Pull/push embedding rows against the PS tier."""
+
+    def __init__(self, endpoints: Sequence[str]) -> None:
+        if not endpoints:
+            raise ValueError("no PS endpoints")
+        self.endpoints = list(endpoints)
+        self._vocabs: Dict[str, int] = {}
+        self._dims: Dict[str, int] = {}
+
+    @classmethod
+    def from_env(cls, environ=None) -> "PSClient":
+        from paddle_operator_tpu.launch.launcher import JobEnv
+
+        return cls(JobEnv.from_env(environ).ps_endpoints)
+
+    # ------------------------------------------------------------------ ops
+
+    def ensure_table(self, name: str, vocab: int, dim: int,
+                     seed: int = 0) -> None:
+        """Create-if-absent on every shard (idempotent across workers)."""
+        for k, ep in enumerate(self.endpoints):
+            out = _post(f"http://{ep}/v1/init?table={name}&vocab={vocab}"
+                        f"&dim={dim}&seed={seed}")
+            info = json.loads(out)
+            lo, hi = shard_range(vocab, k, len(self.endpoints))
+            if (info["lo"], info["hi"]) != (lo, hi):
+                raise RuntimeError(
+                    f"shard {k} owns {info}, client expects [{lo},{hi})")
+        self._vocabs[name] = vocab
+        self._dims[name] = dim
+
+    def _owners(self, name: str, ids: np.ndarray) -> np.ndarray:
+        vocab = self._vocabs[name]
+        n = len(self.endpoints)
+        bounds = np.array([shard_range(vocab, k, n)[0] for k in range(n)]
+                          + [vocab])
+        return np.searchsorted(bounds, ids, side="right") - 1
+
+    def pull(self, name: str, ids: np.ndarray) -> np.ndarray:
+        """ids [N] -> rows [N, D], order preserved (N may be 0)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        out = np.zeros((len(ids), self._dims[name]), np.float32)
+        owners = self._owners(name, ids)
+        for k, ep in enumerate(self.endpoints):
+            sel = owners == k
+            if not sel.any():
+                continue
+            body = _post(f"http://{ep}/v1/pull?table={name}",
+                         _npz_bytes(ids=ids[sel]))
+            out[sel] = dict(np.load(io.BytesIO(body)))["rows"]
+        return out
+
+    def push(self, name: str, ids: np.ndarray, grads: np.ndarray,
+             lr: float = 0.01) -> None:
+        """Route each row gradient to its owning shard (server applies
+        Adagrad; duplicates accumulate server-side)."""
+        ids = np.asarray(ids, np.int64).ravel()
+        grads = np.asarray(grads)
+        owners = self._owners(name, ids)
+        for k, ep in enumerate(self.endpoints):
+            sel = owners == k
+            if not sel.any():
+                continue
+            _post(f"http://{ep}/v1/push?table={name}&lr={lr}",
+                  _npz_bytes(ids=ids[sel], grads=grads[sel]))

@@ -1,0 +1,193 @@
+"""Embedding parameter server — the program a PS pod runs.
+
+Replaces the reference's in-container Paddle pserver (the operator there
+only injects endpoints; the server itself ships with Paddle —
+/root/reference/docs/design-arch.md:5-12).  Design:
+
+- each server owns a contiguous **row range** of every table: server ``k``
+  of ``n`` holds rows ``[k·V//n, (k+1)·V//n)`` (the client computes the
+  same split, ps/client.py);
+- rows live in host RAM as float32 numpy arrays; per-row state for the
+  optimizer (Adagrad accumulator) sits alongside — sparse jobs want
+  per-coordinate step sizes and the PS tier is where that state is cheap;
+- transport is plain HTTP (stdlib ``ThreadingHTTPServer``) with ``.npz``
+  bodies — no extra dependencies inside pods, human-debuggable with curl;
+- init is deterministic from ``(seed, table, shard)`` so a restarted PS
+  pod regenerates identical *fresh* rows, and ``ensure``-style init is
+  idempotent for concurrently starting workers.
+
+Endpoints (all under ``/v1``):
+
+    POST /v1/init?table=T&vocab=V&dim=D[&seed=S]   create-if-absent
+    POST /v1/pull?table=T      body npz{ids}    -> npz{rows}
+    POST /v1/push?table=T&lr=L body npz{ids,grads}  apply row update
+    GET  /healthz
+
+Run in a PS pod via the launcher shim (launch/launcher.py dispatches PS
+pods here) or ``python -m paddle_operator_tpu.ps.server``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+
+def shard_range(vocab: int, shard: int, num_shards: int) -> Tuple[int, int]:
+    """Contiguous row range owned by `shard` (same formula in the client)."""
+    return shard * vocab // num_shards, (shard + 1) * vocab // num_shards
+
+
+class Table:
+    """One embedding table's local row range + Adagrad accumulator."""
+
+    def __init__(self, vocab: int, dim: int, lo: int, hi: int,
+                 seed: int) -> None:
+        self.vocab, self.dim, self.lo, self.hi = vocab, dim, lo, hi
+        rng = np.random.default_rng(seed)
+        self.rows = (rng.standard_normal((hi - lo, dim)) * 0.01).astype(
+            np.float32)
+        self.accum = np.zeros((hi - lo, dim), np.float32)
+        self.lock = threading.Lock()
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        local = ids - self.lo
+        if local.size and (local.min() < 0 or local.max() >= len(self.rows)):
+            raise ValueError(f"ids outside owned range [{self.lo},{self.hi})")
+        with self.lock:
+            return self.rows[local]
+
+    def push(self, ids: np.ndarray, grads: np.ndarray, lr: float) -> None:
+        """Adagrad row update; duplicate ids accumulate.  O(batch) work —
+        the scatter buffer is compact, never table-sized."""
+        local = ids - self.lo
+        if local.size and (local.min() < 0 or local.max() >= len(self.rows)):
+            raise ValueError(f"ids outside owned range [{self.lo},{self.hi})")
+        touched, inv = np.unique(local, return_inverse=True)
+        g = np.zeros((len(touched), self.dim), np.float32)
+        np.add.at(g, inv, grads.astype(np.float32))
+        with self.lock:
+            self.accum[touched] += g ** 2
+            denom = np.sqrt(self.accum[touched]) + 1e-8
+            self.rows[touched] -= lr * g / denom
+
+
+class EmbeddingStore:
+    def __init__(self, shard: int, num_shards: int) -> None:
+        self.shard, self.num_shards = shard, num_shards
+        self.tables: Dict[str, Table] = {}
+        self._lock = threading.Lock()
+
+    def ensure(self, name: str, vocab: int, dim: int, seed: int) -> Table:
+        with self._lock:
+            t = self.tables.get(name)
+            if t is None:
+                lo, hi = shard_range(vocab, self.shard, self.num_shards)
+                # per-(seed, table, shard) stream: crc32, NOT hash() —
+                # str hashing is salted per interpreter process, which
+                # would break restart determinism
+                tseed = zlib.crc32(f"{seed}:{name}:{self.shard}".encode())
+                t = Table(vocab, dim, lo, hi, tseed)
+                self.tables[name] = t
+            elif (t.vocab, t.dim) != (vocab, dim):
+                raise ValueError(
+                    f"table {name} exists with vocab={t.vocab} dim={t.dim}")
+            return t
+
+
+def _read_npz(body: bytes) -> Dict[str, np.ndarray]:
+    return dict(np.load(io.BytesIO(body)))
+
+
+def _npz_bytes(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: EmbeddingStore  # injected by make_server
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes = b"",
+              ctype: str = "application/octet-stream") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, e: Exception) -> None:
+        self._send(400, json.dumps({"error": str(e)}).encode(),
+                   "application/json")
+
+    def do_GET(self):
+        if urlparse(self.path).path == "/healthz":
+            self._send(200, b"ok", "text/plain")
+        else:
+            self._send(404)
+
+    def do_POST(self):
+        url = urlparse(self.path)
+        q = {k: v[0] for k, v in parse_qs(url.query).items()}
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n) if n else b""
+        try:
+            if url.path == "/v1/init":
+                t = self.store.ensure(q["table"], int(q["vocab"]),
+                                      int(q["dim"]), int(q.get("seed", 0)))
+                self._send(200, json.dumps(
+                    {"lo": t.lo, "hi": t.hi}).encode(), "application/json")
+            elif url.path == "/v1/pull":
+                t = self.store.tables[q["table"]]
+                ids = _read_npz(body)["ids"].astype(np.int64)
+                self._send(200, _npz_bytes(rows=t.pull(ids)))
+            elif url.path == "/v1/push":
+                t = self.store.tables[q["table"]]
+                d = _read_npz(body)
+                t.push(d["ids"].astype(np.int64), d["grads"],
+                       float(q.get("lr", 0.01)))
+                self._send(200, b"{}", "application/json")
+            else:
+                self._send(404)
+        except Exception as e:  # surface to the client, keep serving
+            self._error(e)
+
+
+def make_server(host: str, port: int, shard: int,
+                num_shards: int) -> ThreadingHTTPServer:
+    store = EmbeddingStore(shard, num_shards)
+    handler = type("Handler", (_Handler,), {"store": store})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(port: int, shard: int, num_shards: int,
+          host: str = "0.0.0.0") -> None:
+    srv = make_server(host, port, shard, num_shards)
+    print(f"ps server: shard {shard}/{num_shards} on {host}:{port}",
+          flush=True)
+    srv.serve_forever()
+
+
+def main() -> int:
+    """PS-pod entrypoint: shard index / world come from the same env
+    contract the launcher parses (TPUJOB_ROLE_RANK, TPUJOB_PS_ENDPOINTS)."""
+    from paddle_operator_tpu.launch.launcher import JobEnv
+
+    env = JobEnv.from_env()
+    num = max(1, len(env.ps_endpoints))
+    serve(env.port, env.role_rank, num)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

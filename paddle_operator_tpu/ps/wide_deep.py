@@ -1,0 +1,113 @@
+"""Hybrid PS-mode Wide&Deep training (BASELINE config 1).
+
+The reference trains Wide&Deep with sparse tables on CPU pservers and the
+dense net on trainers (deploy/examples/wide_and_deep.yaml + the process
+model in docs/design-arch.md:5-12).  Same split here, TPU-shaped:
+
+- sparse embedding tables live on the PS tier (ps/server.py), pulled and
+  pushed per step by :class:`ps.client.PSClient`;
+- the dense tail (models/wide_deep.py WideDeepDense) runs as ONE jitted
+  step on the accelerator; row gradients flow out of value_and_grad as
+  cotangents of the pulled-row *inputs* and are pushed back;
+- dense parameters update locally with optax — in a multi-worker job they
+  ride the XLA collective world (proven in
+  tests/test_rendezvous_multiproc.py), while PS pushes interleave
+  asynchronously, which is PS-mode's semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from paddle_operator_tpu.models.wide_deep import (
+    WideDeepConfig,
+    WideDeepDense,
+    bce_loss,
+)
+from paddle_operator_tpu.ps.client import PSClient
+
+
+def ensure_tables(client: PSClient, cfg: WideDeepConfig,
+                  seed: int = 0) -> None:
+    """Create (idempotently) one deep + one wide table per sparse field."""
+    for f, vocab in enumerate(cfg.field_vocabs):
+        client.ensure_table(f"embed_{f}", vocab, cfg.embed_dim, seed)
+        client.ensure_table(f"wide_{f}", vocab, 1, seed)
+
+
+class PSTrainer:
+    """Per-worker Wide&Deep trainer against the PS tier."""
+
+    def __init__(self, cfg: WideDeepConfig, client: PSClient,
+                 *, lr_dense: float = 1e-2, lr_rows: float = 0.1,
+                 seed: int = 0) -> None:
+        self.cfg, self.client, self.lr_rows = cfg, client, lr_rows
+        ensure_tables(client, cfg, seed)
+        self.model = WideDeepDense(cfg)
+        f = len(cfg.field_vocabs)
+        rng = jax.random.PRNGKey(seed)
+        self.params = self.model.init(
+            rng,
+            jnp.zeros((1, f), cfg.dtype),
+            jnp.zeros((1, f, cfg.embed_dim), cfg.dtype),
+            jnp.zeros((1, cfg.num_dense), cfg.dtype),
+        )["params"]
+        self.opt = optax.adam(lr_dense)
+        self.opt_state = self.opt.init(self.params)
+
+        def loss_fn(params, wide_rows, deep_rows, dense, labels):
+            logits = self.model.apply({"params": params},
+                                      wide_rows, deep_rows, dense)
+            return bce_loss(logits, labels)
+
+        # grads w.r.t. dense params AND the pulled rows (cotangents head
+        # back to the PS tier)
+        self._step = jax.jit(jax.value_and_grad(loss_fn, argnums=(0, 1, 2)))
+
+    def train_step(self, batch: Dict[str, np.ndarray]) -> float:
+        """batch: sparse_ids [B, F] int, dense [B, num_dense], labels [B]."""
+        cfg = self.cfg
+        ids = np.asarray(batch["sparse_ids"])
+        b, f = ids.shape
+
+        wide_rows = np.zeros((b, f), np.float32)
+        deep_rows = np.zeros((b, f, cfg.embed_dim), np.float32)
+        for j in range(f):
+            wide_rows[:, j] = self.client.pull(f"wide_{j}", ids[:, j])[:, 0]
+            deep_rows[:, j] = self.client.pull(f"embed_{j}", ids[:, j])
+
+        loss, (gp, g_wide, g_deep) = self._step(
+            self.params, jnp.asarray(wide_rows), jnp.asarray(deep_rows),
+            jnp.asarray(batch["dense"], jnp.float32),
+            jnp.asarray(batch["labels"], jnp.float32))
+
+        updates, self.opt_state = self.opt.update(gp, self.opt_state,
+                                                  self.params)
+        self.params = optax.apply_updates(self.params, updates)
+
+        g_wide, g_deep = np.asarray(g_wide), np.asarray(g_deep)
+        for j in range(f):
+            self.client.push(f"wide_{j}", ids[:, j],
+                             g_wide[:, j][:, None], lr=self.lr_rows)
+            self.client.push(f"embed_{j}", ids[:, j], g_deep[:, j],
+                             lr=self.lr_rows)
+        return float(loss)
+
+
+def synthetic_batch(cfg: WideDeepConfig, batch: int,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    """Learnable synthetic CTR data: the label correlates with the ids so
+    a training run can be asserted to reduce loss."""
+    rng = np.random.default_rng(seed)
+    f = len(cfg.field_vocabs)
+    ids = np.stack([rng.integers(0, v, size=batch)
+                    for v in cfg.field_vocabs], axis=1)
+    dense = rng.standard_normal((batch, cfg.num_dense)).astype(np.float32)
+    signal = sum(ids[:, j] % 2 for j in range(f)) + dense[:, 0]
+    labels = (signal > f / 2).astype(np.float32)
+    return {"sparse_ids": ids, "dense": dense, "labels": labels}

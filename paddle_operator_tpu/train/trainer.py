@@ -16,7 +16,7 @@ and TPU-shaped:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -99,25 +99,20 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
     return -(ll * mask).sum() / denom, denom
 
 
-def _jit_train_step(forward_loss, optimizer: optax.GradientTransformation,
-                    mesh: Mesh, state_sharding) -> Callable:
-    """Shared tail of every train step: value_and_grad around
-    ``forward_loss(params, inputs, targets, mask) -> (total_loss,
+def make_custom_train_step(batch_loss, optimizer: optax.GradientTransformation,
+                           mesh: Mesh, state_sharding) -> Callable:
+    """The generic jitted train step every task-specific step builds on:
+    value_and_grad around ``batch_loss(params, batch_dict) -> (total_loss,
     metrics_dict)`` (metrics must include "loss" and "tokens"), optimizer
-    update, metrics, and the jit with sharded/donated state.  Used by both
-    the plain-GSPMD and the pipeline-parallel steps so the update rule can
-    never diverge between them."""
-    data_sharding = batch_sharding(mesh, extra_dims=1)
+    update, and the jit with sharded/donated state.  The batch sharding is
+    a leading-dim prefix (batch dim over the data axes, everything else
+    replicated) so heterogeneous batch leaves — [B, S] tokens, [B, F, D]
+    rows, [B] labels — all shard the same way."""
+    data_sharding = batch_sharding(mesh, extra_dims=0)
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
-        tokens = batch["tokens"]
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        mask = batch.get("mask")
-        if mask is not None:
-            mask = mask[:, 1:]
-
         (_, aux), grads = jax.value_and_grad(
-            forward_loss, has_aux=True)(state.params, inputs, targets, mask)
+            batch_loss, has_aux=True)(state.params, batch)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -142,6 +137,24 @@ def _jit_train_step(forward_loss, optimizer: optax.GradientTransformation,
             out_shardings=out_shardings,
             donate_argnums=(0,),
         )
+
+
+def _jit_train_step(forward_loss, optimizer: optax.GradientTransformation,
+                    mesh: Mesh, state_sharding) -> Callable:
+    """Causal-LM adapter over :func:`make_custom_train_step`: slices the
+    next-token (inputs, targets) pair out of ``batch["tokens"]``.  Used by
+    both the plain-GSPMD and the pipeline-parallel steps so the update rule
+    can never diverge between them."""
+
+    def batch_loss(params, batch: Dict[str, jax.Array]):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        mask = batch.get("mask")
+        if mask is not None:
+            mask = mask[:, 1:]
+        return forward_loss(params, inputs, targets, mask)
+
+    return make_custom_train_step(batch_loss, optimizer, mesh, state_sharding)
 
 
 def make_train_step(model: nn.Module,
@@ -281,6 +294,105 @@ def make_step_for_mesh(model: nn.Module, cfg,
         return make_pp_train_step(cfg, optimizer, mesh, state_sharding,
                                   num_microbatches=num_microbatches)
     return make_train_step(model, optimizer, mesh, state_sharding)
+
+
+def make_ernie_train_step(model: nn.Module,
+                          optimizer: optax.GradientTransformation,
+                          mesh: Mesh, state_sharding=None) -> Callable:
+    """Masked-LM train step for the ERNIE family (BASELINE config 3; the
+    reference runs it as an in-container PaddleNLP workload).
+
+    batch: {"tokens": [B, S] inputs with mask tokens applied,
+            "targets": [B, S] original ids,
+            "mlm_mask": [B, S] 1 at predicted positions,
+            optional "token_types", "pad_mask"}.
+    """
+
+    def batch_loss(params, batch: Dict[str, jax.Array]):
+        logits = model.apply({"params": params}, batch["tokens"],
+                             batch.get("token_types"),
+                             batch.get("pad_mask"))
+        loss, denom = cross_entropy_loss(logits, batch["targets"],
+                                         batch["mlm_mask"])
+        return loss, {"loss": loss, "tokens": denom}
+
+    return make_custom_train_step(batch_loss, optimizer, mesh,
+                                  state_sharding)
+
+
+def make_wide_deep_train_step(model: nn.Module,
+                              optimizer: optax.GradientTransformation,
+                              mesh: Mesh, state_sharding=None) -> Callable:
+    """Binary-CTR train step for Wide&Deep on the mesh (BASELINE config 1,
+    collective flavor — tables sharded over fsdp via the model's partition
+    patterns; the PS-tier flavor lives in ps/wide_deep.py).
+
+    batch: {"sparse_ids": [B, F] int32, "dense": [B, num_dense],
+            "labels": [B] 0/1 float}.
+    """
+    from paddle_operator_tpu.models.wide_deep import bce_loss
+
+    def batch_loss(params, batch: Dict[str, jax.Array]):
+        logits = model.apply({"params": params}, batch["sparse_ids"],
+                             batch["dense"])
+        loss = bce_loss(logits, batch["labels"])
+        examples = jnp.float32(batch["labels"].shape[0])
+        return loss, {"loss": loss, "tokens": examples}
+
+    return make_custom_train_step(batch_loss, optimizer, mesh,
+                                  state_sharding)
+
+
+def mlm_synthetic_batch(batch_size: int, seq_len: int, vocab: int,
+                        *, mask_token: int = 1, mask_rate: float = 0.15,
+                        seed: int = 0) -> Dict[str, jax.Array]:
+    """Deterministic synthetic MLM batch (targets, masked inputs, mask)."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    targets = jax.random.randint(k1, (batch_size, seq_len), 2, vocab,
+                                 dtype=jnp.int32)
+    mlm_mask = jax.random.bernoulli(k2, mask_rate, (batch_size, seq_len))
+    tokens = jnp.where(mlm_mask, mask_token, targets)
+    return {"tokens": tokens, "targets": targets,
+            "mlm_mask": mlm_mask.astype(jnp.float32)}
+
+
+def fit(state: TrainState, step_fn: Callable, batches,
+        *, steps: int,
+        checkpoint=None,
+        timer=None,
+        logger=None,
+        log_every: int = 0) -> Tuple[TrainState, List[Dict[str, float]]]:
+    """The reusable training loop: drive `step_fn` over `batches` (any
+    iterator of device-ready batch dicts — typically a
+    :class:`train.data.DevicePrefetcher`), saving through a
+    :class:`train.checkpoint.CheckpointManager` and ticking a
+    :class:`utils.observability.StepTimer`.
+
+    Replaces the per-model ad-hoc loops; every BASELINE family (LLaMA,
+    ERNIE, Wide&Deep) trains through this one function.  Returns the final
+    state and the per-step float metrics history.
+    """
+    history: List[Dict[str, float]] = []
+    it = iter(batches)
+    for i in range(steps):
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        state, metrics = step_fn(state, batch)
+        if timer is not None:
+            timer.tick()
+        floats = {k: float(v) for k, v in metrics.items()}
+        history.append(floats)
+        step_no = int(state.step)
+        if checkpoint is not None and checkpoint.enabled:
+            checkpoint.save(step_no, state)
+        if logger is not None and log_every and (i + 1) % log_every == 0:
+            msg = f"step={step_no} loss={floats.get('loss', float('nan')):.4f}"
+            if timer is not None:
+                msg += " " + timer.report()
+            logger.info(msg)
+    return state, history
 
 
 def make_eval_step(model: nn.Module, mesh: Mesh,

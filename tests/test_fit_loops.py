@@ -1,0 +1,152 @@
+"""First-party train loops for every BASELINE family (VERDICT round-2
+item 6): ERNIE (MLM) and Wide&Deep (BCE) run through the SAME generalized
+trainer as LLaMA — make_custom_train_step + fit() — instead of ad-hoc
+closures, wired to DevicePrefetcher, CheckpointManager and StepTimer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.api.types import MeshSpec
+from paddle_operator_tpu.models import ernie as E
+from paddle_operator_tpu.models import wide_deep as W
+from paddle_operator_tpu.parallel.mesh import make_mesh
+from paddle_operator_tpu.train import trainer as T
+from paddle_operator_tpu.train.checkpoint import CheckpointManager
+from paddle_operator_tpu.train.data import DevicePrefetcher
+from paddle_operator_tpu.utils.observability import StepTimer
+
+BATCH, SEQ = 8, 16
+
+
+class TestErnieTrainStep:
+    def _setup(self, mesh):
+        model, cfg = E.make_model("tiny")
+        opt = T.make_optimizer(1e-3, warmup_steps=2, decay_steps=20)
+        pats = E.partition_patterns(cfg)
+        ex = (jnp.zeros((BATCH, SEQ), jnp.int32),)
+        sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
+        state = T.create_state(model, opt, mesh, pats, ex)
+        step = T.make_ernie_train_step(model, opt, mesh, sh)
+        return cfg, state, step
+
+    def test_mlm_loss_decreases_on_sharded_mesh(self):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+        cfg, state, step = self._setup(mesh)
+        batch = T.mlm_synthetic_batch(BATCH, SEQ, cfg.vocab_size, seed=3)
+        losses = []
+        for _ in range(6):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_loss_counts_only_masked_positions(self):
+        mesh = make_mesh(MeshSpec(dp=8))
+        cfg, state, step = self._setup(mesh)
+        batch = T.mlm_synthetic_batch(BATCH, SEQ, cfg.vocab_size, seed=0)
+        _, m = step(state, batch)
+        assert float(m["tokens"]) == float(batch["mlm_mask"].sum())
+
+
+class TestWideDeepTrainStep:
+    def test_bce_loss_decreases_with_fsdp_tables(self):
+        mesh = make_mesh(MeshSpec(dp=2, fsdp=4))
+        model, cfg = W.make_model("tiny")
+        opt = T.make_optimizer(1e-2, warmup_steps=1, decay_steps=50)
+        pats = W.partition_patterns(cfg)
+        f = len(cfg.field_vocabs)
+        ex = (jnp.zeros((BATCH, f), jnp.int32),
+              jnp.zeros((BATCH, cfg.num_dense), jnp.float32))
+        sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
+        state = T.create_state(model, opt, mesh, pats, ex)
+        step = T.make_wide_deep_train_step(model, opt, mesh, sh)
+
+        rng = np.random.default_rng(0)
+        ids = np.stack([rng.integers(0, v, BATCH) for v in cfg.field_vocabs],
+                       axis=1).astype(np.int32)
+        batch = {
+            "sparse_ids": jnp.asarray(ids),
+            "dense": jnp.asarray(
+                rng.standard_normal((BATCH, cfg.num_dense)), jnp.float32),
+            "labels": jnp.asarray(rng.integers(0, 2, BATCH), jnp.float32),
+        }
+        losses = []
+        for _ in range(10):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0]
+        # tables actually sharded (the PS-tier analogue on the mesh)
+        emb = state.params["embed_0"]["embedding"]
+        assert len(emb.sharding.device_set) > 1
+
+
+class TestFitLoop:
+    def _llama_setup(self, mesh):
+        from paddle_operator_tpu.models.llama import (
+            make_model, partition_patterns,
+        )
+
+        model, cfg = make_model("tiny")
+        opt = T.make_optimizer(1e-3, warmup_steps=2, decay_steps=20)
+        pats = partition_patterns(cfg)
+        ex = (jnp.zeros((BATCH, SEQ), jnp.int32),)
+        sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
+        state = T.create_state(model, opt, mesh, pats, ex)
+        step = T.make_train_step(model, opt, mesh, sh)
+        return cfg, state, step, sh
+
+    def test_fit_wires_prefetcher_timer_checkpoint(self, tmp_path):
+        from paddle_operator_tpu.train.data import synthetic_lm_batches
+
+        mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+        cfg, state, step, _ = self._llama_setup(mesh)
+        ckpt = CheckpointManager(str(tmp_path / "ckpt"),
+                                 save_interval_steps=2)
+        timer = StepTimer(tokens_per_step=BATCH * SEQ)
+        batches = DevicePrefetcher(
+            synthetic_lm_batches(BATCH, SEQ + 1, cfg.vocab_size), mesh)
+        state, history = T.fit(state, step, batches, steps=5,
+                               checkpoint=ckpt, timer=timer)
+        assert len(history) == 5
+        assert all(np.isfinite(h["loss"]) for h in history)
+        assert int(state.step) == 5
+        assert timer.step_time > 0
+        ckpt.wait()
+        assert ckpt.latest_step() is not None
+
+    def test_fit_resumes_from_checkpoint(self, tmp_path):
+        from paddle_operator_tpu.train.checkpoint import resume_or_init
+        from paddle_operator_tpu.train.data import synthetic_lm_batches
+
+        mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+        cfg, state, step, _ = self._llama_setup(mesh)
+        path = str(tmp_path / "ckpt")
+        ckpt = CheckpointManager(path, save_interval_steps=1)
+        batches = DevicePrefetcher(
+            synthetic_lm_batches(BATCH, SEQ + 1, cfg.vocab_size), mesh)
+        state, _ = T.fit(state, step, batches, steps=3, checkpoint=ckpt)
+        ckpt.wait()
+        ckpt.close()
+
+        # "restarted pod": fresh state, resume_or_init finds step 3
+        cfg2, fresh, step2, _ = self._llama_setup(mesh)
+        ckpt2 = CheckpointManager(path)
+        restored, resumed = resume_or_init(ckpt2, lambda: fresh)
+        assert resumed and int(restored.step) == 3
+        batches2 = DevicePrefetcher(
+            synthetic_lm_batches(BATCH, SEQ + 1, cfg2.vocab_size), mesh)
+        restored, history = T.fit(restored, step2, batches2, steps=2)
+        assert int(restored.step) == 5
+        assert all(np.isfinite(h["loss"]) for h in history)
+
+    def test_fit_stops_on_exhausted_iterator(self):
+        mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+        cfg, state, step, _ = self._llama_setup(mesh)
+        two = iter([T.synthetic_batch(BATCH, SEQ + 1, cfg.vocab_size, seed=s)
+                    for s in range(2)])
+        state, history = T.fit(state, step, two, steps=10)
+        assert len(history) == 2

@@ -48,17 +48,19 @@ class TestErnie:
         np.testing.assert_allclose(l1[0, :12], l2[0, :12], atol=1e-4)
 
     def test_sharded_step(self):
+        # through the first-party MLM trainer (train/trainer.py
+        # make_ernie_train_step), not an ad-hoc causal-LM shim
         from paddle_operator_tpu.train import trainer as T
 
         model, cfg = E.make_model("tiny")
         mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
         opt = T.make_optimizer(1e-3, warmup_steps=1, decay_steps=10)
         pats = E.partition_patterns(cfg)
-        ex = (jnp.zeros((8, 33), jnp.int32),)
+        ex = (jnp.zeros((8, 32), jnp.int32),)
         sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
         state = T.create_state(model, opt, mesh, pats, ex)
-        step = T.make_train_step(model, opt, mesh, sh)
-        b = T.synthetic_batch(8, 33, cfg.vocab_size)
+        step = T.make_ernie_train_step(model, opt, mesh, sh)
+        b = T.mlm_synthetic_batch(8, 32, cfg.vocab_size)
         state, m = step(state, b)
         assert np.isfinite(float(m["loss"]))
         wq = state.params["layers"]["wq"]["kernel"]
@@ -107,26 +109,24 @@ class TestWideDeep:
         assert logits.shape == (16,)
 
     def test_learns(self):
+        # through the first-party trainer (train/trainer.py
+        # make_wide_deep_train_step) rather than an ad-hoc optax closure
+        from paddle_operator_tpu.train import trainer as T
+
         model, cfg = W.make_model("tiny")
         ids, dense, labels = self.batch(cfg)
-        params = model.init(jax.random.PRNGKey(0), ids, dense)["params"]
-        opt = optax.adam(1e-2)
-        opt_state = opt.init(params)
-
-        @jax.jit
-        def step(params, opt_state):
-            loss, grads = jax.value_and_grad(
-                lambda p: W.bce_loss(
-                    model.apply({"params": p}, ids, dense), labels)
-            )(params)
-            updates, opt_state = opt.update(grads, opt_state)
-            return optax.apply_updates(params, updates), opt_state, loss
-
+        mesh = make_mesh(MeshSpec(dp=8))
+        opt = T.make_optimizer(1e-2, warmup_steps=1, decay_steps=100,
+                               weight_decay=0.0)
+        state = T.create_state(model, opt, mesh, W.partition_patterns(cfg),
+                               (ids, dense))
+        step = T.make_wide_deep_train_step(model, opt, mesh)
+        batch = {"sparse_ids": ids, "dense": dense, "labels": labels}
         first = last = None
         for _ in range(30):
-            params, opt_state, loss = step(params, opt_state)
-            first = first if first is not None else float(loss)
-            last = float(loss)
+            state, m = step(state, batch)
+            first = first if first is not None else float(m["loss"])
+            last = float(m["loss"])
         assert last < first * 0.8
 
     def test_embeddings_shard_over_fsdp(self):

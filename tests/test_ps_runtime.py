@@ -1,0 +1,228 @@
+"""PS-tier runtime: embedding server, worker client, hybrid Wide&Deep.
+
+VERDICT round-2 item 5: PS pods previously had endpoints but no program.
+Now ps/server.py is the program, ps/client.py the consumer of
+``TPUJOB_PS_ENDPOINTS``, and the multiprocess test at the bottom is the
+proof: 1 PS pod + 2 worker pods (real OS processes, env from the builders)
+train Wide&Deep with the tables held on the PS and the loss decreases.
+Reference process model being matched: docs/design-arch.md:5-12.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_operator_tpu.ps.client import PSClient
+from paddle_operator_tpu.ps.server import make_server, shard_range
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def ps_pair():
+    """Two in-process PS shards + a client over both."""
+    servers, threads, eps = [], [], []
+    for k in range(2):
+        port = _free_port()
+        srv = make_server("127.0.0.1", port, k, 2)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        servers.append(srv)
+        threads.append(t)
+        eps.append(f"127.0.0.1:{port}")
+    yield PSClient(eps)
+    for srv in servers:
+        srv.shutdown()
+
+
+class TestServerClient:
+    def test_shard_range_covers_vocab(self):
+        for vocab in (7, 32, 100):
+            for n in (1, 2, 3):
+                spans = [shard_range(vocab, k, n) for k in range(n)]
+                assert spans[0][0] == 0 and spans[-1][1] == vocab
+                for (a, b), (c, d) in zip(spans, spans[1:]):
+                    assert b == c
+
+    def test_pull_is_deterministic_and_sharded(self, ps_pair):
+        client = ps_pair
+        client.ensure_table("t", 10, 4, seed=7)
+        ids = np.array([0, 4, 5, 9, 5])       # spans both shards + dup
+        rows = client.pull("t", ids)
+        assert rows.shape == (5, 4)
+        np.testing.assert_array_equal(rows[2], rows[4])   # same id same row
+        again = client.pull("t", ids)
+        np.testing.assert_array_equal(rows, again)
+
+    def test_push_applies_and_duplicates_accumulate(self, ps_pair):
+        client = ps_pair
+        client.ensure_table("t", 10, 2, seed=1)
+        before = client.pull("t", np.array([3]))
+        g = np.ones((2, 2), np.float32)
+        client.push("t", np.array([3, 3]), g, lr=0.5)
+        after = client.pull("t", np.array([3]))
+        # Adagrad with duplicate accumulation: g_row=2, accum=4,
+        # step = 0.5 * 2/sqrt(4) = 0.5
+        np.testing.assert_allclose(before - after, 0.5, atol=1e-5)
+
+    def test_ensure_is_idempotent_and_checks_shape(self, ps_pair):
+        client = ps_pair
+        client.ensure_table("t", 10, 4)
+        client.ensure_table("t", 10, 4)       # same spec: fine
+        with pytest.raises(Exception):
+            client.ensure_table("t", 10, 8)   # conflicting dim: rejected
+
+    def test_untrained_rows_unchanged_by_push_elsewhere(self, ps_pair):
+        client = ps_pair
+        client.ensure_table("t", 10, 2)
+        keep = client.pull("t", np.array([1]))
+        client.push("t", np.array([8]), np.ones((1, 2), np.float32))
+        np.testing.assert_array_equal(keep, client.pull("t", np.array([1])))
+
+
+class TestDenseTailEquivalence:
+    def test_widedeep_dense_matches_full_model(self):
+        """WideDeepDense(pulled rows) must equal WideDeep(ids) when the
+        rows come from the full model's own embedding tables."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_operator_tpu.models.wide_deep import (
+            WideDeep, WideDeepDense, make_model,
+        )
+
+        model, cfg = make_model("tiny")
+        rng = jax.random.PRNGKey(0)
+        b, f = 4, len(cfg.field_vocabs)
+        ids = jax.random.randint(rng, (b, f), 0, min(cfg.field_vocabs))
+        dense = jax.random.normal(rng, (b, cfg.num_dense))
+        params = model.init(rng, ids, dense)["params"]
+
+        full = model.apply({"params": params}, ids, dense)
+
+        wide_rows = jnp.stack(
+            [params[f"wide_{j}"]["embedding"][ids[:, j], 0]
+             for j in range(f)], axis=1)
+        deep_rows = jnp.stack(
+            [params[f"embed_{j}"]["embedding"][ids[:, j]]
+             for j in range(f)], axis=1)
+        dense_params = {k: v for k, v in params.items()
+                        if not k.startswith(("wide_", "embed_"))
+                        or k == "wide_dense"}
+        tail = WideDeepDense(cfg).apply({"params": dense_params},
+                                        wide_rows, deep_rows, dense)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(tail),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestPSTrainerInProcess:
+    def test_loss_decreases(self, ps_pair):
+        from paddle_operator_tpu.models.wide_deep import make_model
+        from paddle_operator_tpu.ps.wide_deep import PSTrainer, synthetic_batch
+
+        _, cfg = make_model("tiny")
+        tr = PSTrainer(cfg, ps_pair, seed=0)
+        batch = synthetic_batch(cfg, 64, seed=0)
+        losses = [tr.train_step(batch) for _ in range(8)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0], losses
+
+
+# --------------------------------------------------------------------------
+# The multiprocess proof (VERDICT item 5 "done" condition)
+# --------------------------------------------------------------------------
+
+WORKER_CHILD = """
+import os
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_operator_tpu.launch import launcher
+from paddle_operator_tpu.models.wide_deep import make_model
+from paddle_operator_tpu.ps.client import PSClient
+from paddle_operator_tpu.ps.wide_deep import PSTrainer, synthetic_batch
+
+env = launcher.JobEnv.from_env()
+assert env.ps_endpoints, "no PS endpoints injected"
+client = PSClient.from_env()
+_, cfg = make_model("tiny")
+tr = PSTrainer(cfg, client, seed=0)
+batch = synthetic_batch(cfg, 64, seed=env.role_rank)   # distinct data
+losses = [tr.train_step(batch) for _ in range(6)]
+assert all(np.isfinite(l) for l in losses), losses
+assert losses[-1] < losses[0], losses
+print("WORKER_OK", env.role_rank, round(losses[0], 4), round(losses[-1], 4))
+"""
+
+
+def test_one_ps_two_workers_train_wide_deep():
+    """1 PS + 2 workers as real processes: PS pod runs the launcher shim
+    (which starts ps/server.py), workers read TPUJOB_PS_ENDPOINTS from the
+    builder-generated ConfigMap, train concurrently, loss decreases."""
+    from paddle_operator_tpu.api import ResourceSpec, TPUJob, TPUJobSpec
+    from paddle_operator_tpu.api.types import HOSTPORT_ANNOTATION, Intranet
+    from paddle_operator_tpu.controller import builders as B
+
+    port = _free_port()
+    tmpl = {"spec": {"containers": [{"name": "m", "image": "i"}]}}
+    job = TPUJob(name="psrt", spec=TPUJobSpec(
+        intranet=Intranet.HOST,
+        worker=ResourceSpec(replicas=2, template=tmpl),
+        ps=ResourceSpec(replicas=1, template=tmpl),
+    ))
+    job.annotations[HOSTPORT_ANNOTATION] = str(port)
+
+    pods = []
+    for res, n in (("ps", 1), ("worker", 2)):
+        for i in range(n):
+            pod = B.construct_pod(job, res, i)
+            pod["status"] = {"podIP": "127.0.0.1"}
+            pods.append(pod)
+    cm = B.construct_configmap(job, pods)
+    assert cm["data"]["TPUJOB_PS_ENDPOINTS"] == f"127.0.0.1:{port}"
+
+    def pod_env(pod):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("TPU_", "TPUJOB_", "MEGASCALE_"))}
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(cm["data"])
+        for e in pod["spec"]["containers"][0]["env"]:
+            if "value" in e:
+                env[e["name"]] = e["value"]
+        return env
+
+    ps_pod = pods[0]
+    ps_proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_operator_tpu.launch.launcher"],
+        env=pod_env(ps_pod), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        workers = [
+            subprocess.Popen([sys.executable, "-c", WORKER_CHILD],
+                             env=pod_env(pod), cwd=REPO,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+            for pod in pods[1:]
+        ]
+        for i, p in enumerate(workers):
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker {i} failed:\n{err}"
+            assert "WORKER_OK" in out, out
+    finally:
+        ps_proc.kill()
+        ps_proc.wait()

@@ -312,6 +312,127 @@ class TestElasticCompletion:
         assert job_status(api).phase == Phase.COMPLETED
 
 
+class TestGangRescale:
+    """VERDICT round-2 item 3: scaling a RUNNING collective job must be a
+    whole-gang restart (new world size, fresh ConfigMap, checkpoint
+    resume) — an XLA world cannot resize and running containers hold the
+    env they started with."""
+
+    def test_scale_down_mid_running_restarts_gang(self, env):
+        api, rec, fleet = env
+        submit(api, workers=4)
+        drive(api, rec, fleet)
+        assert job_status(api).phase == Phase.RUNNING
+        old_uids = {p["metadata"]["uid"]
+                    for p in api.list_owned(KIND_POD, NS, "tj")}
+
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 2
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+
+        st = job_status(api)
+        assert st.phase == Phase.RUNNING
+        assert st.restart_count == 0          # scaling burns no fault budget
+        pods = api.list_owned(KIND_POD, NS, "tj")
+        assert sorted(p["metadata"]["name"] for p in pods) == [
+            "tj-worker-0", "tj-worker-1"]
+        # EVERY pod was recreated (not just the two extras pruned): the
+        # survivors' uids must differ
+        assert old_uids.isdisjoint(p["metadata"]["uid"] for p in pods)
+        cm = api.get(KIND_CM, NS, "tj")
+        assert cm["data"]["TPUJOB_NUM_WORKERS"] == "2"
+        reasons = [e["reason"] for e in api.events]
+        assert "Scaling" in reasons and "Scaled" in reasons
+
+    def test_scale_up_mid_running_restarts_gang(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2)
+        drive(api, rec, fleet)
+        old_uids = {p["metadata"]["uid"]
+                    for p in api.list_owned(KIND_POD, NS, "tj")}
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 4
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        pods = api.list_owned(KIND_POD, NS, "tj")
+        assert len(pods) == 4
+        assert old_uids.isdisjoint(p["metadata"]["uid"] for p in pods)
+        assert api.get(KIND_CM, NS, "tj")["data"]["TPUJOB_NUM_WORKERS"] == "4"
+
+    def test_pending_job_scales_without_restart(self, env):
+        # before the job is Running there is no world to protect: the gang
+        # path must not trigger (no Scaling event), pods are just created
+        # at the new count
+        api, rec, fleet = env
+        submit(api, workers=2)
+        run_to_settled(rec, NS, "tj")          # pods exist, no IPs yet
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 3
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        assert len(api.list_owned(KIND_POD, NS, "tj")) == 3
+        assert "Scaling" not in {e["reason"] for e in api.events}
+
+
+class TestValidationGate:
+    """VERDICT round-2 item 4: reconcile() must enforce TPUJob.validate()
+    — parity with the reference's CRD schema gate
+    (config/crd/bases/batch.paddlepaddle.org_paddlejobs.yaml)."""
+
+    def test_invalid_mesh_product_holds_job(self, env):
+        from paddle_operator_tpu.api import MeshSpec
+        api, rec, fleet = env
+        submit(api, workers=2,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4),
+               mesh=MeshSpec(dp=16))           # 16 != 8 chips
+        run_to_settled(rec, NS, "tj")
+        assert api.list_owned(KIND_POD, NS, "tj") == []
+        events = [e for e in api.events if e["reason"] == "InvalidSpec"]
+        assert events and "mesh axes product" in events[0]["message"]
+
+    def test_invalid_worker_count_holds_job(self, env):
+        api, rec, fleet = env
+        submit(api, workers=3,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4))  # wants 2
+        run_to_settled(rec, NS, "tj")
+        assert api.list_owned(KIND_POD, NS, "tj") == []
+        assert any(e["reason"] == "InvalidSpec" for e in api.events)
+
+    def test_warning_deduped_then_recovers_on_fix(self, env):
+        api, rec, fleet = env
+        submit(api, workers=3,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4))
+        run_to_settled(rec, NS, "tj")
+        run_to_settled(rec, NS, "tj")
+        assert sum(e["reason"] == "InvalidSpec" for e in api.events) == 1
+        # fix the spec (generation bumps) → job reconciles normally
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 2
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        assert job_status(api).phase == Phase.RUNNING
+        assert len(api.list_owned(KIND_POD, NS, "tj")) == 2
+
+
+class TestSliceAtomicClamp:
+    def test_elastic_clamp_snaps_to_whole_slices(self, env):
+        # 2x4 topology, 4 chips/worker → 2 workers per slice; limits=3
+        # would strand half a slice — the clamp must snap down to 2
+        api, rec, fleet = env
+        submit(api, workers=4,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4, slice_count=2))
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["limits"] = 3
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        pods = api.list_owned(KIND_POD, NS, "tj")
+        assert len(pods) == 2
+        # effective slice count in the rendezvous contract follows suit
+        cm = api.get(KIND_CM, NS, "tj")
+        assert cm["data"]["TPUJOB_NUM_SLICES"] == "1"
+
+
 class TestScaleDownServices:
     def test_services_pruned_with_pods(self, env):
         api, rec, fleet = env

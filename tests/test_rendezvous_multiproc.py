@@ -20,7 +20,11 @@ import subprocess
 import sys
 
 from paddle_operator_tpu.api import ResourceSpec, TPUJob, TPUJobSpec
-from paddle_operator_tpu.api.types import HOSTPORT_ANNOTATION, Intranet
+from paddle_operator_tpu.api.types import (
+    HOSTPORT_ANNOTATION,
+    Intranet,
+    TPUSpec,
+)
 from paddle_operator_tpu.controller import builders as B
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -60,7 +64,11 @@ def _free_port() -> int:
 
 def _pod_env(cm, pod):
     """The env one container sees: ConfigMap (envFrom) + per-pod vars."""
-    env = dict(os.environ)
+    env = {k: v for k, v in os.environ.items()
+           # a TPU-attached parent leaks its own runtime contract
+           # (TPU_WORKER_HOSTNAMES=localhost etc.) — children must see
+           # only what the builders inject
+           if not k.startswith(("TPU_", "TPUJOB_", "MEGASCALE_"))}
     env.pop("XLA_FLAGS", None)           # children get 1 CPU device each
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -113,6 +121,80 @@ def test_two_worker_processes_form_cluster():
         out, err = p.communicate(timeout=180)
         assert p.returncode == 0, f"worker failed:\n{err}"
         assert "RANKS [0, 1]" in out, out
+
+
+MULTISLICE_CHILD = """
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_operator_tpu.launch import launcher
+env = launcher.initialize()
+assert env.num_slices == 2, env.num_slices
+assert env.workers_per_slice == 2, env.workers_per_slice
+# the MEGASCALE_* DCN bootstrap env must be present and agree
+assert int(os.environ["MEGASCALE_NUM_SLICES"]) == env.num_slices
+assert "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+assert env.slice_id == int(os.environ["MEGASCALE_SLICE_ID"])
+assert jax.process_count() == env.num_workers == 4, jax.process_count()
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+ranks = multihost_utils.process_allgather(jnp.array([env.rank]))
+print("RANKS", sorted(int(r) for r in ranks.ravel()))
+print("SLICE", env.slice_id, "HOSTS", os.environ["TPU_WORKER_HOSTNAMES"])
+"""
+
+
+def test_two_slice_job_rendezvous_across_dcn_contract():
+    """A slice_count=2 job (2 workers/slice → 4 processes) assembles ONE
+    XLA world spanning both slices: MEGASCALE_* consumed, per-slice
+    TPU_WORKER_HOSTNAMES disjoint, cross-slice allgather sees every rank.
+    The reference's analogous (Gloo HTTP endpoint) contract:
+    /root/reference/controllers/paddlejob_helper.go:154-161."""
+    port = _free_port()
+    tmpl = {"spec": {"containers": [{"name": "m", "image": "i"}]}}
+    job = TPUJob(name="ms", spec=TPUJobSpec(
+        intranet=Intranet.HOST,
+        worker=ResourceSpec(replicas=4, template=tmpl),
+        tpu=TPUSpec(topology="2x4", slice_count=2, chips_per_worker=4),
+    ))
+    job.annotations[HOSTPORT_ANNOTATION] = str(port)
+    job.validate()
+
+    # distinct loopback IPs so the two slices' host lists are disjoint
+    # (slice 0 → .1,.2; slice 1 → .3,.4); the coordinator (worker 0,
+    # 127.0.0.1) is the only address that must accept connections on CPU.
+    pods = []
+    for i in range(4):
+        pod = B.construct_pod(job, "worker", i)
+        pod["status"] = {"podIP": f"127.0.0.{i + 1}"}
+        pods.append(pod)
+    cm = B.construct_configmap(job, pods)
+    assert cm is not None
+    assert cm["data"]["MEGASCALE_NUM_SLICES"] == "2"
+
+    procs = [
+        subprocess.Popen([sys.executable, "-c", MULTISLICE_CHILD],
+                         env=_pod_env(cm, pod), cwd=REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True)
+        for pod in pods
+    ]
+    slice_hosts = {}
+    for i, p in enumerate(procs):
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker {i} failed:\n{err}"
+        assert "RANKS [0, 1, 2, 3]" in out, out
+        for line in out.splitlines():
+            if line.startswith("SLICE"):
+                _, sid, _, hosts = line.split()
+                slice_hosts.setdefault(int(sid), set()).add(hosts)
+    # both slices present; each agrees internally on its host list; the
+    # two lists are disjoint
+    assert set(slice_hosts) == {0, 1}, slice_hosts
+    assert all(len(v) == 1 for v in slice_hosts.values()), slice_hosts
+    h0, h1 = (next(iter(slice_hosts[s])) for s in (0, 1))
+    assert h0 == "127.0.0.1,127.0.0.2" and h1 == "127.0.0.3,127.0.0.4", (
+        h0, h1)
 
 
 def test_ps_pod_stays_out_of_xla_world():
