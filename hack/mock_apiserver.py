@@ -84,14 +84,18 @@ def make_handler(api: FakeAPI):
 
         def _watch(self, ns, kind, query):
             """``?watch=true``: newline-delimited JSON event stream (the
-            k8s watch dialect).  Starts with ADDED for existing objects;
-            blank-line heartbeats let us detect client disconnect.  Honors
-            ``labelSelector`` like the plain list path."""
+            k8s watch dialect).  Without ``resourceVersion`` starts with
+            ADDED for existing objects; with it, replays only history past
+            that rv (watch resume) or answers a 410-Gone ERROR event when
+            the history was compacted.  Blank-line heartbeats let us detect
+            client disconnect.  Honors ``labelSelector`` like the plain
+            list path."""
             import copy as _copy
             import queue as _queue
 
             sel = query.get("labelSelector", [None])[0]
             sel_key, _, sel_val = (sel or "").partition("=")
+            rv_param = query.get("resourceVersion", [None])[0]
 
             def matches(obj):
                 if not sel:
@@ -99,22 +103,39 @@ def make_handler(api: FakeAPI):
                 labels = obj.get("metadata", {}).get("labels", {}) or {}
                 return labels.get(sel_key) == sel_val
 
+            backlog, gone = [], False
             with lock:
                 sub = api.subscribe(kind)
-                # deepcopy under the lock: handler threads must not
-                # serialize live store dicts while others mutate them
-                existing = [_copy.deepcopy(o)
-                            for (k, n2, _), o in sorted(api.store.items())
-                            if k == kind and n2 == ns]
+                if rv_param:
+                    replay, ok = api.events_since(kind, ns, int(rv_param))
+                    if ok:
+                        backlog = replay
+                    else:
+                        gone = True
+                else:
+                    # deepcopy under the lock: handler threads must not
+                    # serialize live store dicts while others mutate them
+                    backlog = [{"type": "ADDED", "object": _copy.deepcopy(o)}
+                               for (k, n2, _), o in sorted(api.store.items())
+                               if k == kind and n2 == ns]
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.end_headers()
             try:
-                for obj in existing:
-                    if matches(obj):
-                        self.wfile.write(
-                            json.dumps({"type": "ADDED",
-                                        "object": obj}).encode() + b"\n")
+                if gone:
+                    # k8s sends the 410 as an in-stream ERROR Status event
+                    self.wfile.write(json.dumps({
+                        "type": "ERROR",
+                        "object": {"kind": "Status", "apiVersion": "v1",
+                                   "status": "Failure", "reason": "Expired",
+                                   "code": 410},
+                    }).encode() + b"\n")
+                    self.wfile.flush()
+                    api.unsubscribe(sub)
+                    return
+                for evt in backlog:
+                    if matches(evt["object"]):
+                        self.wfile.write(json.dumps(evt).encode() + b"\n")
                 self.wfile.flush()
                 while True:
                     try:

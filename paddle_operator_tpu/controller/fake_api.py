@@ -34,6 +34,12 @@ class FakeAPI(APIClient):
         # {"type": ADDED|MODIFIED|DELETED, "object": ...} event (the k8s
         # watch dialect, mirroring the reference's informer feed)
         self._subs: List[Tuple[str, "queue.Queue"]] = []
+        # bounded event history keyed by resourceVersion, so a watch can
+        # resume from ``?resourceVersion=N`` like etcd's revision log; when
+        # trimmed past a requested rv the server answers 410 Gone
+        self._history: List[Tuple[int, str, Dict[str, Any]]] = []
+        self._history_limit = 2048
+        self._compacted_rv = 0
         # The watch-driven manager makes this store multi-threaded (pump /
         # resync / worker threads); RLock because delete() cascades.
         self._lock = threading.RLock()
@@ -48,9 +54,30 @@ class FakeAPI(APIClient):
         obj["metadata"]["resourceVersion"] = str(next(self._rv))
 
     def _notify(self, kind: str, etype: str, obj: Dict[str, Any]) -> None:
+        evt = {"type": etype, "object": copy.deepcopy(obj)}
+        rv = int(obj["metadata"].get("resourceVersion") or 0)
+        self._history.append((rv, kind, evt))
+        if len(self._history) > self._history_limit:
+            dropped = self._history[: -self._history_limit]
+            self._compacted_rv = dropped[-1][0]
+            self._history = self._history[-self._history_limit:]
         for k, q in list(self._subs):
             if k == kind:
-                q.put({"type": etype, "object": copy.deepcopy(obj)})
+                q.put(copy.deepcopy(evt))
+
+    def events_since(self, kind: str, namespace: str,
+                     since_rv: int) -> Tuple[List[Dict[str, Any]], bool]:
+        """Replay events with resourceVersion > ``since_rv`` (watch resume).
+        Returns ``(events, ok)``; ok=False means the history was compacted
+        past since_rv and the caller must re-list (k8s 410 Gone)."""
+        with self._lock:
+            if since_rv < self._compacted_rv:
+                return [], False
+            out = [copy.deepcopy(evt) for rv, k, evt in self._history
+                   if rv > since_rv and k == kind
+                   and evt["object"].get("metadata", {}).get(
+                       "namespace", "default") == namespace]
+            return out, True
 
     # -- watch -------------------------------------------------------------
 
@@ -138,6 +165,7 @@ class FakeAPI(APIClient):
                     self._notify(kind, "MODIFIED", obj)
                 return
             del self.store[key]
+            self._bump(obj)   # watch DELETED events carry a fresh rv (k8s)
             self._notify(kind, "DELETED", obj)
             self._cascade(namespace, name)
 
@@ -149,6 +177,7 @@ class FakeAPI(APIClient):
             obj = self.store[key]
             if not obj["metadata"].get("finalizers"):
                 del self.store[key]
+                self._bump(obj)
                 self._notify(key[0], "DELETED", obj)
 
     def update(self, kind: str, obj: Dict[str, Any]) -> Dict[str, Any]:
@@ -168,6 +197,7 @@ class FakeAPI(APIClient):
                 obj["metadata"]["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
                 if not obj["metadata"].get("finalizers"):
                     del self.store[key]
+                    self._bump(obj)
                     self._notify(kind, "DELETED", obj)
                     self._cascade(key[1], key[2])
                     return obj

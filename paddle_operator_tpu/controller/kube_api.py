@@ -105,13 +105,22 @@ class KubeAPI(APIClient):
               read_timeout: float = 30.0):
         """Stream k8s watch events (``?watch=true`` newline-delimited JSON,
         the reference's informer transport).  Reconnects internally until
-        `stop` (threading.Event) is set; yields {"type", "object"} dicts."""
+        `stop` (threading.Event) is set; yields {"type", "object"} dicts.
+
+        Tracks the last seen ``metadata.resourceVersion`` and resumes from
+        it on reconnect, so a dropped stream replays only missed events
+        instead of re-listing every object; a 410-Gone ERROR event (history
+        compacted server-side) clears the marker and falls back to a full
+        list+watch."""
         import socket
 
+        rv: Optional[str] = None
         while stop is None or not stop.is_set():
             params = {"watch": "true"}
             if label_selector:
                 params["labelSelector"] = label_selector
+            if rv:
+                params["resourceVersion"] = rv
             url = self._url(kind, namespace,
                             query=urllib.parse.urlencode(params))
             req = urllib.request.Request(url, method="GET")
@@ -126,15 +135,30 @@ class KubeAPI(APIClient):
                         if stop is not None and stop.is_set():
                             return
                         line = line.strip()
-                        if line:   # blank lines are server heartbeats
-                            yield json.loads(line)
+                        if not line:   # blank lines are server heartbeats
+                            continue
+                        evt = json.loads(line)
+                        if evt.get("type") == "ERROR":
+                            # 410 Gone (or other server error): restart the
+                            # watch from scratch (full ADDED replay)
+                            rv = None
+                            break
+                        new_rv = (evt.get("object", {}).get("metadata", {})
+                                  .get("resourceVersion"))
+                        if new_rv:
+                            rv = new_rv
+                        yield evt
             except (urllib.error.URLError, socket.timeout, OSError,
-                    json.JSONDecodeError):
+                    json.JSONDecodeError) as e:
+                # apiserver may reject a too-old rv with HTTP 410 instead
+                # of an in-stream ERROR event: fall back to a fresh watch
+                if isinstance(e, urllib.error.HTTPError) and e.code == 410:
+                    rv = None
                 if stop is not None:
                     stop.wait(0.5)
                 else:
                     return
-            # stream closed: reconnect (list+watch resume)
+            # stream closed: reconnect, resuming at rv when we have one
 
     def list_owned(self, kind: str, namespace: str, owner_name: str) -> List[Dict[str, Any]]:
         q = urllib.parse.urlencode(

@@ -18,8 +18,9 @@ Differences, by design:
   uses controller-runtime's Lease-based election with ID
   ``b2a304f2.paddlepaddle.org``, main.go:78); a ConfigMap carries the same
   fencing-by-resourceVersion property and needs no coordination.k8s.io
-  RBAC.  Expiry compares wall clocks across replicas, so it assumes
-  cluster-node clock skew well under ``lease_seconds``.
+  RBAC.  Expiry is decided on each candidate's own monotonic clock (the
+  client-go observedRenewTime scheme), so cross-replica clock skew cannot
+  elect two leaders.
 - **Metrics** are Prometheus text format served from the process
   (controller-runtime binds :8080, main.go:57,75).
 """
@@ -142,19 +143,38 @@ def _serve(port: int, metrics: Metrics, ready_fn) -> threading.Thread:
 
 class LeaderElector:
     """ConfigMap-CAS leader election (parity: manager leaderElection,
-    main.go:77-79).  The holder/renewed pair lives in a ConfigMap; updates
-    go through the apiserver's optimistic concurrency, and lease expiry is
-    wall-clock based (assumes clock skew << lease_seconds)."""
+    main.go:77-79), clock-skew free.
+
+    The lease record is ``{holder, renewals}`` where ``renewals`` is a
+    fencing counter the holder bumps via compare-and-swap (the apiserver's
+    resourceVersion optimistic concurrency IS the fence — a stale holder's
+    renewal loses the CAS and is demoted).  Expiry never compares wall
+    clocks across replicas: each candidate watches the (holder, renewals)
+    pair and takes over only after it has stayed unchanged for
+    ``lease_seconds`` on the candidate's OWN monotonic clock — the same
+    observedRenewTime scheme as client-go's leaderelection package.
+
+    The holder renews at most every ``lease_seconds/3`` and otherwise
+    returns cached leadership, so an idle leader does not rewrite the
+    ConfigMap (and fan out MODIFIED events to its watchers) on every loop
+    iteration."""
 
     def __init__(self, api, identity: str, namespace: str,
-                 lease_seconds: int = 15) -> None:
+                 lease_seconds: float = 15, clock=time.monotonic) -> None:
         self.api = api
         self.identity = identity
         self.namespace = namespace
         self.lease_seconds = lease_seconds
+        self._clock = clock               # injectable for skew tests
+        self._is_leader = False
+        self._last_renew = 0.0            # local monotonic, ours
+        self._observed = None             # (holder, renewals) last seen
+        self._observed_at = 0.0           # local monotonic at last change
 
     def try_acquire(self) -> bool:
-        now = time.time()
+        now = self._clock()
+        if self._is_leader and now - self._last_renew < self.lease_seconds / 3:
+            return True                   # cached: no API traffic
         try:
             lease = self.api.get("ConfigMap", self.namespace, LEASE_NAME)
         except NotFound:
@@ -166,18 +186,40 @@ class LeaderElector:
             try:
                 lease = self.api.create("ConfigMap", lease)
             except Exception:
+                self._is_leader = False
                 return False
         data = lease.get("data") or {}
         holder = data.get("holder")
-        renewed = float(data.get("renewed", 0) or 0)
-        if holder not in (None, "", self.identity) and \
-                now - renewed < self.lease_seconds:
-            return False
-        lease["data"] = {"holder": self.identity, "renewed": str(now)}
+        # the record includes resourceVersion so ANY write to the lease —
+        # even one by a replica running a different record format (e.g.
+        # during a rolling update) — resets the takeover timer
+        record = (holder, data.get("renewals"),
+                  lease.get("metadata", {}).get("resourceVersion"))
+        if record != self._observed:
+            self._observed = record
+            self._observed_at = now
+        if holder not in (None, "", self.identity):
+            # someone else holds it: take over only once the record has
+            # been still for a full lease on OUR clock
+            if now - self._observed_at < self.lease_seconds:
+                self._is_leader = False
+                return False
+        lease["data"] = {
+            "holder": self.identity,
+            "renewals": str(int(data.get("renewals") or 0) + 1),
+        }
         try:
-            self.api.update("ConfigMap", lease)
+            updated = self.api.update("ConfigMap", lease)
+            self._is_leader = True
+            self._last_renew = now
+            self._observed = (self.identity, lease["data"]["renewals"],
+                              updated.get("metadata", {})
+                              .get("resourceVersion"))
+            self._observed_at = now
             return True
         except Exception:
+            # lost the CAS: someone renewed/acquired under us (fencing)
+            self._is_leader = False
             return False
 
 

@@ -149,17 +149,42 @@ class TPUJobReconciler:
         #    176-208) and leaves the ConfigMap stale (SURVEY.md §3.4). ------
         if job.status.phase == Phase.SCALING:
             return self._rescale(job, child_pods)
-        gap = (self._scale_mismatch(job, child_pods)
-               if (job.status.phase == Phase.RUNNING
-                   and job.status.mode == JobMode.COLLECTIVE) else "")
-        if gap:
-            job.status.phase = Phase.SCALING
-            self.api.record_event(raw, "Normal", "Scaling", gap)
-            try:
-                self.api.update_status(KIND_JOB, job.to_dict())
-            except (Conflict, NotFound):
-                pass
-            return Result(requeue_after=1.0)
+        # -- gang integrity: once the rendezvous ConfigMap exists, world
+        #    membership is sealed.  A replica gap then means either (a) the
+        #    user changed the spec → gang rescale, or (b) pod OBJECTS were
+        #    deleted out from under the job (preemption / node reclaim —
+        #    distinct from pod *failure*, which the restart path catches
+        #    via status) → gang restart, consuming the restart budget.
+        #    Recreating pods one by one against the old ConfigMap would let
+        #    kubelet resolve envFrom to the dead world's endpoints the
+        #    moment the container starts; the post-hoc CM data regen can't
+        #    reach started containers.  The sealed world's worker count
+        #    (TPUJOB_NUM_WORKERS) tells (a) and (b) apart.
+        if (job.status.mode == JobMode.COLLECTIVE
+                and job.status.phase in (Phase.RUNNING, Phase.STARTING,
+                                         Phase.PENDING)):
+            gap = self._scale_mismatch(job, child_pods)
+            if gap:
+                cm_cur = None
+                try:
+                    cm_cur = self.api.get(KIND_CM, namespace, name)
+                except NotFound:
+                    pass   # pre-barrier: normal gang bring-up
+                if cm_cur is not None:
+                    recorded = int(
+                        cm_cur.get("data", {}).get("TPUJOB_NUM_WORKERS")
+                        or -1)
+                    want = job.spec.worker.replicas if job.spec.worker else 0
+                    if recorded == want:
+                        return self._gang_broken(job, raw, gap)
+                if cm_cur is not None or job.status.phase == Phase.RUNNING:
+                    job.status.phase = Phase.SCALING
+                    self.api.record_event(raw, "Normal", "Scaling", gap)
+                    try:
+                        self.api.update_status(KIND_JOB, job.to_dict())
+                    except (Conflict, NotFound):
+                        pass
+                    return Result(requeue_after=1.0)
 
         # -- scale-down: drop pods beyond spec replicas (PS-mode and
         #    not-yet-running jobs; RUNNING collective jobs take the gang
@@ -282,7 +307,8 @@ class TPUJobReconciler:
             port = job.annotations.get(HOSTPORT_ANNOTATION)
             if port:
                 self.allocator.release(int(port))
-                self._adopted.pop(f"{job.namespace}/{job.name}", None)
+            self._adopted.pop(f"{job.namespace}/{job.name}", None)
+            self._invalid_warned.pop(f"{job.namespace}/{job.name}", None)
             job.finalizers.remove(FINALIZER)
             try:
                 self.api.update(KIND_JOB, job.to_dict())
@@ -372,17 +398,60 @@ class TPUJobReconciler:
         status.completion_time = builders.get_completion_time(probe, now)
         return status
 
+    def _teardown_gang(self, job: TPUJob,
+                       child_pods: List[Dict[str, Any]]) -> bool:
+        """Delete the gang's pods, per-pod services, and the rendezvous
+        ConfigMap.  Returns True when anything was deleted (the caller
+        requeues and finishes the restart/rescale on a later pass).  The
+        ConfigMap must go even when no pods remain (e.g. node reclaim
+        deleted every pod object): recreated pods would otherwise resolve
+        ``envFrom`` against the OLD world's endpoints the instant kubelet
+        starts them — the data update alone can't reach started containers.
+        """
+        deleted = bool(child_pods)
+        for pod in child_pods:
+            self._delete_child(job, KIND_POD, pod)
+        for svc in self.api.list_owned(KIND_SVC, job.namespace, job.name):
+            try:
+                self.api.delete(KIND_SVC, job.namespace,
+                                svc["metadata"]["name"])
+                deleted = True
+            except NotFound:
+                pass
+        try:
+            self.api.delete(KIND_CM, job.namespace, job.name)
+            deleted = True
+        except NotFound:
+            pass
+        return deleted
+
+    def _gang_broken(self, job: TPUJob, raw: Dict[str, Any],
+                     gap: str) -> Result:
+        """Pod objects vanished after rendezvous was sealed (preemption /
+        node reclaim): re-form the world through the restart path — which
+        consumes ``spec.maxRestarts`` like a pod failure (BASELINE config 5
+        preemption-recovery semantics) — instead of scaling for free."""
+        if job.status.restart_count < job.spec.max_restarts:
+            job.status.phase = Phase.RESTARTING
+            self.api.record_event(
+                raw, "Warning", "GangBroken",
+                f"pod lost after rendezvous sealed ({gap}); restarting gang")
+        else:
+            job.status.phase = Phase.FAILED
+            self.api.record_event(
+                raw, "Warning", "GangBroken",
+                f"pod lost ({gap}); restart budget exhausted")
+        try:
+            self.api.update_status(KIND_JOB, job.to_dict())
+        except (Conflict, NotFound):
+            pass
+        return Result(requeue_after=1.0)
+
     def _restart(self, job: TPUJob, child_pods: List[Dict[str, Any]]) -> Result:
         """Tear down the whole gang and bump restartCount; next passes
         recreate every pod with identical ranks so the XLA coordinator
         re-forms and training resumes from the checkpoint path."""
-        if child_pods:
-            for pod in child_pods:
-                self._delete_child(job, KIND_POD, pod)
-            try:
-                self.api.delete(KIND_CM, job.namespace, job.name)
-            except NotFound:
-                pass
+        if self._teardown_gang(job, child_pods):
             return Result(requeue_after=1.0)
         job.status.restart_count += 1
         job.status.phase = Phase.PENDING
@@ -421,19 +490,7 @@ class TPUJobReconciler:
         budget — scaling is user intent, not a fault.  Per-pod services go
         too (the new gang recreates its own; keeping stale ones would leak
         them, as the reference does on scale-down)."""
-        if child_pods:
-            for pod in child_pods:
-                self._delete_child(job, KIND_POD, pod)
-            for svc in self.api.list_owned(KIND_SVC, job.namespace, job.name):
-                try:
-                    self.api.delete(KIND_SVC, job.namespace,
-                                    svc["metadata"]["name"])
-                except NotFound:
-                    pass
-            try:
-                self.api.delete(KIND_CM, job.namespace, job.name)
-            except NotFound:
-                pass
+        if self._teardown_gang(job, child_pods):
             return Result(requeue_after=1.0)
         job.status.phase = Phase.PENDING
         self.api.record_event(job.to_dict(), "Normal", "Scaled",

@@ -39,6 +39,28 @@ CONFIGS = {
 }
 
 
+def _dense_tail(cfg: WideDeepConfig, wide_rows: jax.Array,
+                deep_rows: jax.Array, dense: jax.Array) -> jax.Array:
+    """The shared wide/deep tail after embedding lookup: wide_rows [B, F]
+    (scalar weight per field), deep_rows [B, F, D], dense [B, num_dense]
+    -> [B] CTR logit.  Must run inside an ``nn.compact`` __call__ — the
+    Dense layers land in the calling module's top-level scope, which is
+    what keeps the collective (WideDeep) and PS (WideDeepDense) parameter
+    trees aligned on {wide_dense, mlp_i, deep_out}."""
+    wide = wide_rows.sum(axis=1) + nn.Dense(
+        1, name="wide_dense", dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype)(dense)[:, 0]
+    b = deep_rows.shape[0]
+    h = jnp.concatenate(
+        [deep_rows.reshape(b, -1), dense.astype(cfg.dtype)], axis=-1)
+    for i, d in enumerate(cfg.mlp_dims):
+        h = nn.relu(nn.Dense(d, name=f"mlp_{i}", dtype=cfg.dtype,
+                             param_dtype=cfg.param_dtype)(h))
+    deep = nn.Dense(1, name="deep_out", dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype)(h)[:, 0]
+    return wide + deep
+
+
 class WideDeep(nn.Module):
     cfg: WideDeepConfig
 
@@ -65,25 +87,16 @@ class WideDeep(nn.Module):
                          **embed_kw)(ids)
             deep_terms.append(e)
 
-        wide = sum(wide_terms) + nn.Dense(
-            1, name="wide_dense", dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype)(dense)[:, 0]
-
-        h = jnp.concatenate(deep_terms + [dense.astype(cfg.dtype)], axis=-1)
-        for i, d in enumerate(cfg.mlp_dims):
-            h = nn.relu(nn.Dense(d, name=f"mlp_{i}", dtype=cfg.dtype,
-                                 param_dtype=cfg.param_dtype)(h))
-        deep = nn.Dense(1, name="deep_out", dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype)(h)[:, 0]
-        return wide + deep
+        return _dense_tail(cfg, jnp.stack(wide_terms, axis=1),
+                           jnp.stack(deep_terms, axis=1), dense)
 
 
 class WideDeepDense(nn.Module):
     """The dense tail of :class:`WideDeep` for PS-mode training: embedding
     rows arrive pre-gathered (pulled from the PS tier, ps/client.py) and
-    only the MLP/linear parameters live on the accelerator.  Same math as
-    WideDeep.__call__ after its Embed lookups, so the two paths train the
-    same model."""
+    only the MLP/linear parameters live on the accelerator.  Shares
+    :func:`_dense_tail` with WideDeep.__call__, so the two paths train the
+    same model by construction."""
 
     cfg: WideDeepConfig
 
@@ -92,19 +105,7 @@ class WideDeepDense(nn.Module):
                  dense: jax.Array) -> jax.Array:
         """wide_rows [B, F] (scalar weight per field), deep_rows [B, F, D],
         dense [B, num_dense] -> [B] CTR logit."""
-        cfg = self.cfg
-        wide = wide_rows.sum(axis=1) + nn.Dense(
-            1, name="wide_dense", dtype=cfg.dtype,
-            param_dtype=cfg.param_dtype)(dense)[:, 0]
-        b = deep_rows.shape[0]
-        h = jnp.concatenate(
-            [deep_rows.reshape(b, -1), dense.astype(cfg.dtype)], axis=-1)
-        for i, d in enumerate(cfg.mlp_dims):
-            h = nn.relu(nn.Dense(d, name=f"mlp_{i}", dtype=cfg.dtype,
-                                 param_dtype=cfg.param_dtype)(h))
-        deep = nn.Dense(1, name="deep_out", dtype=cfg.dtype,
-                        param_dtype=cfg.param_dtype)(h)[:, 0]
-        return wide + deep
+        return _dense_tail(self.cfg, wide_rows, deep_rows, dense)
 
 
 def partition_patterns(cfg: WideDeepConfig):

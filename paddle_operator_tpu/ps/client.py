@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import io
 import json
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
@@ -25,11 +26,13 @@ from paddle_operator_tpu.ps.server import shard_range
 
 def _post(url: str, body: bytes = b"", timeout: float = 30.0) -> bytes:
     req = urllib.request.Request(url, data=body, method="POST")
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        out = resp.read()
-        if resp.status != 200:
-            raise RuntimeError(f"{url}: {resp.status} {out[:200]!r}")
-        return out
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        # surface the server's JSON error detail, not just the status line
+        detail = e.read()[:200]
+        raise RuntimeError(f"{url}: HTTP {e.code} {detail!r}") from None
 
 
 def _npz_bytes(**arrays) -> bytes:
@@ -72,6 +75,11 @@ class PSClient:
 
     def _owners(self, name: str, ids: np.ndarray) -> np.ndarray:
         vocab = self._vocabs[name]
+        bad = ids[(ids < 0) | (ids >= vocab)]
+        if bad.size:
+            raise ValueError(
+                f"table {name}: ids outside [0, {vocab}): "
+                f"{bad[:8].tolist()}{'...' if bad.size > 8 else ''}")
         n = len(self.endpoints)
         bounds = np.array([shard_range(vocab, k, n)[0] for k in range(n)]
                           + [vocab])

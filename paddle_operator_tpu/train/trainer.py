@@ -372,7 +372,10 @@ def fit(state: TrainState, step_fn: Callable, batches,
     ERNIE, Wide&Deep) trains through this one function.  Returns the final
     state and the per-step float metrics history.
     """
-    history: List[Dict[str, float]] = []
+    raw_history: List[Dict[str, Any]] = []
+    # One sync up front; per-step host conversion would block on every
+    # step's completion and defeat async dispatch + prefetch overlap.
+    start_step = int(state.step)
     it = iter(batches)
     for i in range(steps):
         try:
@@ -382,16 +385,17 @@ def fit(state: TrainState, step_fn: Callable, batches,
         state, metrics = step_fn(state, batch)
         if timer is not None:
             timer.tick()
-        floats = {k: float(v) for k, v in metrics.items()}
-        history.append(floats)
-        step_no = int(state.step)
+        raw_history.append(metrics)   # device scalars: no host sync
+        step_no = start_step + i + 1
         if checkpoint is not None and checkpoint.enabled:
             checkpoint.save(step_no, state)
         if logger is not None and log_every and (i + 1) % log_every == 0:
-            msg = f"step={step_no} loss={floats.get('loss', float('nan')):.4f}"
+            msg = (f"step={step_no} "
+                   f"loss={float(metrics.get('loss', float('nan'))):.4f}")
             if timer is not None:
                 msg += " " + timer.report()
             logger.info(msg)
+    history = [{k: float(v) for k, v in m.items()} for m in raw_history]
     return state, history
 
 

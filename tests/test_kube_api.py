@@ -157,6 +157,75 @@ class TestKubeAPIWatch:
         assert names == {"first", "second"}
 
 
+class TestKubeAPIWatchResume:
+    def test_reconnect_does_not_replay(self, server):
+        """ADVICE/VERDICT r2: a dropped stream must resume from the last
+        seen resourceVersion — reconnects must NOT re-deliver ADDED for
+        every existing object.  read_timeout is set below the server's
+        heartbeat interval so the stream drops and reconnects repeatedly
+        while we watch."""
+        client, _, _ = server
+        client.create("TPUJob", _job("first").to_dict())
+        got, stop = [], threading.Event()
+
+        def pump():
+            for evt in client.watch("TPUJob", "default", stop=stop,
+                                    read_timeout=0.4):
+                got.append(evt)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        time.sleep(2.5)          # several timeout→reconnect cycles
+        client.create("TPUJob", _job("second").to_dict())
+        deadline = time.monotonic() + 5
+        while len(got) < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=5)
+        names = [e["object"]["metadata"]["name"] for e in got]
+        assert names.count("first") == 1, f"replayed ADDED: {names}"
+        assert names.count("second") == 1
+
+    def test_compacted_history_falls_back_to_full_watch(self, server):
+        """When the server compacted past our rv (410 Gone) the client must
+        restart a fresh watch (full ADDED replay) and keep delivering new
+        events, not spin on the error."""
+        client, api, lock = server
+        with lock:
+            api._history_limit = 4   # force aggressive compaction
+        client.create("TPUJob", _job("first").to_dict())
+        got, stop = [], threading.Event()
+
+        def pump():
+            for evt in client.watch("TPUJob", "default", stop=stop,
+                                    read_timeout=0.4):
+                got.append(evt)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)     # saw "first"; client now holds its rv
+        # churn another kind so the global history trims past that rv
+        for i in range(12):
+            client.create("ConfigMap", {
+                "apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": f"churn-{i}", "namespace": "default"},
+                "data": {}})
+        time.sleep(1.0)          # let the stream drop and hit the 410
+        client.create("TPUJob", _job("second").to_dict())
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if any(e["object"]["metadata"]["name"] == "second" for e in got):
+                break
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=5)
+        names = [e["object"]["metadata"]["name"] for e in got]
+        assert "second" in names, f"watch died after compaction: {names}"
+        assert all(e["type"] != "ERROR" for e in got)   # 410 not surfaced
+
+
 class TestManagerOverHTTP:
     def test_e2e_submit_to_running(self, server):
         """Full loop over the wire: KubeAPI client + watch-driven manager

@@ -1,0 +1,126 @@
+"""Leader election: fencing + monotonic-clock expiry (VERDICT r2 weak #3).
+
+The reference gets Lease-based election from controller-runtime
+(main.go:77-79); ours is a ConfigMap CAS.  These tests prove the two
+properties that make it safe:
+
+- no dual leadership under arbitrary wall-clock skew — expiry is judged on
+  each candidate's own monotonic clock (client-go observedRenewTime
+  scheme), never by comparing timestamps written by another node;
+- fencing — a deposed leader's next renewal loses the resourceVersion CAS
+  and demotes itself.
+
+Plus the ADVICE r2 finding: an idle leader renews at most every
+lease_seconds/3 instead of rewriting the ConfigMap on every loop pass.
+"""
+
+from paddle_operator_tpu.controller.fake_api import FakeAPI
+from paddle_operator_tpu.controller.manager import LEASE_NAME, LeaderElector
+
+
+class Clock:
+    """Injectable monotonic clock, one per candidate (simulates replicas
+    whose clocks tick independently — rate/offset skew is irrelevant
+    because no timestamp ever crosses replicas)."""
+
+    def __init__(self, t0: float = 0.0) -> None:
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _pair(api, lease=15.0):
+    ca, cb = Clock(), Clock(1e6)   # wildly offset clocks
+    a = LeaderElector(api, "rep-a", "default", lease_seconds=lease, clock=ca)
+    b = LeaderElector(api, "rep-b", "default", lease_seconds=lease, clock=cb)
+    return a, ca, b, cb
+
+
+class TestLeaderElection:
+    def test_single_candidate_acquires(self):
+        api = FakeAPI()
+        a, ca, _, _ = _pair(api)
+        assert a.try_acquire()
+        data = api.get("ConfigMap", "default", LEASE_NAME)["data"]
+        assert data["holder"] == "rep-a"
+
+    def test_no_dual_leadership_under_skew(self):
+        """B's clock is offset by 1e6 s and even jumps forward a full
+        lease: while A keeps renewing, B must never become leader."""
+        api = FakeAPI()
+        a, ca, b, cb = _pair(api)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        for _ in range(5):
+            ca.advance(6.0)        # past lease/3: A renews for real
+            cb.advance(6.0)
+            assert a.try_acquire()
+            assert not b.try_acquire()   # renewals counter keeps moving
+        # B observing an unchanged record for < lease on ITS clock: still no
+        cb.advance(10.0)
+        assert not b.try_acquire()
+
+    def test_takeover_after_holder_stops_renewing(self):
+        api = FakeAPI()
+        a, ca, b, cb = _pair(api)
+        assert a.try_acquire()
+        assert not b.try_acquire()       # observes (rep-a, 1)
+        cb.advance(15.0)                 # full lease with no renewal seen
+        assert b.try_acquire()
+        data = api.get("ConfigMap", "default", LEASE_NAME)["data"]
+        assert data["holder"] == "rep-b"
+
+    def test_fencing_demotes_stale_leader(self):
+        """A (paused, e.g. long GC) comes back after B took over: A's
+        renewal must lose the CAS and A must not think it leads."""
+        api = FakeAPI()
+        a, ca, b, cb = _pair(api)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        cb.advance(15.0)
+        assert b.try_acquire()           # B is leader now
+        ca.advance(100.0)                # A wakes up, tries to renew
+        assert not a.try_acquire()
+        assert not a._is_leader
+        data = api.get("ConfigMap", "default", LEASE_NAME)["data"]
+        assert data["holder"] == "rep-b"
+
+    def test_idle_leader_does_not_rewrite_configmap(self):
+        """ADVICE r2: try_acquire inside the lease/3 window is cached —
+        no ConfigMap write, no MODIFIED fan-out to watchers."""
+        api = FakeAPI()
+        a, ca, _, _ = _pair(api)
+        assert a.try_acquire()
+        rv0 = api.get("ConfigMap", "default", LEASE_NAME)["metadata"][
+            "resourceVersion"]
+        for _ in range(20):
+            ca.advance(0.2)              # the manager loop's cadence
+            assert a.try_acquire()
+        rv1 = api.get("ConfigMap", "default", LEASE_NAME)["metadata"][
+            "resourceVersion"]
+        assert rv0 == rv1                # zero writes while cached
+        ca.advance(5.0)                  # past lease/3: one real renewal
+        assert a.try_acquire()
+        rv2 = api.get("ConfigMap", "default", LEASE_NAME)["metadata"][
+            "resourceVersion"]
+        assert rv2 != rv1
+
+    def test_observed_change_resets_takeover_timer(self):
+        """A renews once mid-way through B's wait: B's takeover clock must
+        restart from the observed change."""
+        api = FakeAPI()
+        a, ca, b, cb = _pair(api)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        cb.advance(10.0)
+        ca.advance(6.0)
+        assert a.try_acquire()           # real renewal (past lease/3)
+        assert not b.try_acquire()       # sees new counter → timer resets
+        cb.advance(10.0)                 # only 10s since the reset
+        assert not b.try_acquire()
+        cb.advance(6.0)                  # now 16s > lease
+        assert b.try_acquire()

@@ -458,3 +458,55 @@ class TestPortExhaustion:
         rec.reconcile(NS, "b")  # allocator empty -> event, no crash
         reasons = {e["reason"] for e in api.events}
         assert "PortExhausted" in reasons
+
+
+class TestGangIntegrity:
+    """Pod OBJECTS deleted out from under a sealed world (preemption / node
+    reclaim — distinct from pod *failure*): the gang must re-form through
+    the restart path so recreated pods never envFrom the dead world's
+    ConfigMap, and the restart budget is consumed (BASELINE config 5)."""
+
+    def test_all_pods_lost_restarts_gang_and_regenerates_cm(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, max_restarts=2)
+        drive(api, rec, fleet)
+        rv0 = api.get(KIND_CM, NS, "tj")["metadata"]["resourceVersion"]
+        for n in ("tj-worker-0", "tj-worker-1"):
+            del api.store[(KIND_POD, NS, n)]
+        drive(api, rec, fleet)
+        st = job_status(api)
+        assert st.phase == Phase.RUNNING
+        assert st.restart_count == 1
+        assert api.get(KIND_CM, NS, "tj")["metadata"]["resourceVersion"] != rv0
+        assert any(e["reason"] == "GangBroken" for e in api.events)
+
+    def test_one_pod_lost_consumes_budget_not_scaling(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, max_restarts=2)
+        drive(api, rec, fleet)
+        del api.store[(KIND_POD, NS, "tj-worker-1")]
+        drive(api, rec, fleet)
+        st = job_status(api)
+        assert st.restart_count == 1
+        assert sorted(k[2] for k in api.store if k[0] == KIND_POD) == [
+            "tj-worker-0", "tj-worker-1"]
+
+    def test_pod_lost_with_no_budget_fails_job(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, max_restarts=0)
+        drive(api, rec, fleet)
+        del api.store[(KIND_POD, NS, "tj-worker-0")]
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.FAILED
+
+    def test_spec_change_still_scales_without_budget(self, env):
+        api, rec, fleet = env
+        submit(api, workers=2, max_restarts=2)
+        drive(api, rec, fleet)
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["replicas"] = 3
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        st = job_status(api)
+        assert st.restart_count == 0
+        assert api.get(KIND_CM, NS, "tj")["data"]["TPUJOB_NUM_WORKERS"] == "3"
