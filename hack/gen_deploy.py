@@ -22,13 +22,105 @@ from paddle_operator_tpu.api.crd import generate_crd, generate_crd_v1beta1  # no
 
 NAMESPACE = "tpujob-system"
 IMAGE = "tpujob/controller:latest"
+RBAC_PROXY_IMAGE = "gcr.io/kubebuilder/kube-rbac-proxy:v0.8.0"
+
+# The ControllerManagerConfig tier (reference:
+# config/manager/controller_manager_config.yaml, mounted into the manager
+# and passed via --config; CLI flags override file values).
+MANAGER_CONFIG = {
+    "metricsBindAddress": "127.0.0.1:8080",   # fronted by kube-rbac-proxy
+    "healthProbeBindAddress": ":8081",
+    "leaderElect": True,
+    "portRange": "35000,65000",
+    "syncPeriod": 2.0,
+}
+
+
+def observability_manifests(namespace: str = NAMESPACE):
+    """Metrics Service + ServiceMonitor + auth-proxy / editor / viewer RBAC
+    (reference: config/prometheus/monitor.yaml:1-16,
+    config/rbac/auth_proxy_{role,role_binding,service,client_clusterrole}.yaml,
+    config/rbac/paddlejob_{editor,viewer}_role.yaml)."""
+    sa = "tpujob-controller"
+    return [
+        # https metrics Service the ServiceMonitor scrapes (auth enforced
+        # by the kube-rbac-proxy sidecar in the Deployment)
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": "tpujob-controller-metrics-service",
+                      "namespace": namespace,
+                      "labels": {"control-plane": "tpujob-controller"}},
+         "spec": {"ports": [{"name": "https", "port": 8443,
+                             "targetPort": "https"}],
+                  "selector": {"control-plane": "tpujob-controller"}}},
+        {"apiVersion": "monitoring.coreos.com/v1", "kind": "ServiceMonitor",
+         "metadata": {"name": "tpujob-controller-metrics-monitor",
+                      "namespace": namespace,
+                      "labels": {"control-plane": "tpujob-controller"}},
+         "spec": {
+             "endpoints": [{
+                 "path": "/metrics", "port": "https", "scheme": "https",
+                 "bearerTokenFile":
+                     "/var/run/secrets/kubernetes.io/serviceaccount/token",
+                 "tlsConfig": {"insecureSkipVerify": True},
+             }],
+             "selector": {"matchLabels":
+                          {"control-plane": "tpujob-controller"}}}},
+        # metrics-reader: granted to whoever should scrape through the proxy
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "tpujob-metrics-reader"},
+         "rules": [{"nonResourceURLs": ["/metrics"], "verbs": ["get"]}]},
+        # the proxy itself needs TokenReview/SubjectAccessReview
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "tpujob-proxy-role"},
+         "rules": [
+             {"apiGroups": ["authentication.k8s.io"],
+              "resources": ["tokenreviews"], "verbs": ["create"]},
+             {"apiGroups": ["authorization.k8s.io"],
+              "resources": ["subjectaccessreviews"], "verbs": ["create"]},
+         ]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": {"name": "tpujob-proxy-rolebinding"},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole", "name": "tpujob-proxy-role"},
+         "subjects": [{"kind": "ServiceAccount", "name": sa,
+                       "namespace": namespace}]},
+        # end-user aggregation roles for the TPUJob kind
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "tpujob-editor-role"},
+         "rules": [
+             {"apiGroups": [GROUP], "resources": [PLURAL],
+              "verbs": ["create", "delete", "get", "list", "patch",
+                        "update", "watch"]},
+             {"apiGroups": [GROUP], "resources": [f"{PLURAL}/status"],
+              "verbs": ["get"]},
+         ]},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "tpujob-viewer-role"},
+         "rules": [
+             {"apiGroups": [GROUP], "resources": [PLURAL],
+              "verbs": ["get", "list", "watch"]},
+             {"apiGroups": [GROUP], "resources": [f"{PLURAL}/status"],
+              "verbs": ["get"]},
+         ]},
+    ]
+
+
+def manager_configmap(namespace: str = NAMESPACE):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": "tpujob-manager-config",
+                         "namespace": namespace},
+            "data": {"controller_manager_config.yaml":
+                     yaml.safe_dump(MANAGER_CONFIG, sort_keys=False)}}
 
 
 def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
                        leader_elect: bool = True):
     """Namespace + RBAC + controller Deployment (reference:
     deploy/v1/operator.yaml — namespace paddle-system, RBAC, manager
-    Deployment with --leader-elect)."""
+    Deployment with --leader-elect), plus the ControllerManagerConfig
+    ConfigMap, the kube-rbac-proxy'd metrics surface and editor/viewer
+    roles."""
     sa = "tpujob-controller"
     rules = [
         {"apiGroups": [GROUP], "resources": [PLURAL],
@@ -69,6 +161,9 @@ def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
                      "securityContext": {"runAsNonRoot": True,
                                          "runAsUser": 65532},
                      "terminationGracePeriodSeconds": 10,
+                     "volumes": [{
+                         "name": "manager-config",
+                         "configMap": {"name": "tpujob-manager-config"}}],
                      "containers": [{
                          "name": "manager",
                          "image": image,
@@ -76,9 +171,11 @@ def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
                                      "paddle_operator_tpu.controller.manager"],
                          "args": (["--leader-elect"] if leader_elect else [])
                          + ["--namespace=" + namespace,
-                            "--port-range=35000,65000"],
+                            "--config=/etc/tpujob/"
+                            "controller_manager_config.yaml"],
+                         "volumeMounts": [{"name": "manager-config",
+                                           "mountPath": "/etc/tpujob"}],
                          "ports": [
-                             {"containerPort": 8080, "name": "metrics"},
                              {"containerPort": 8081, "name": "probes"},
                          ],
                          "livenessProbe": {
@@ -93,11 +190,24 @@ def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
                          "resources": {
                              "limits": {"cpu": "500m", "memory": "256Mi"},
                              "requests": {"cpu": "100m", "memory": "128Mi"}},
+                     }, {
+                         # auth proxy fronting the metrics endpoint
+                         # (reference: manager_auth_proxy_patch.yaml:17-31;
+                         # the manager binds metrics to 127.0.0.1:8080 via
+                         # the ControllerManagerConfig above)
+                         "name": "kube-rbac-proxy",
+                         "image": RBAC_PROXY_IMAGE,
+                         "args": [
+                             "--secure-listen-address=0.0.0.0:8443",
+                             "--upstream=http://127.0.0.1:8080/",
+                             "--logtostderr=true", "--v=10"],
+                         "ports": [{"containerPort": 8443, "name": "https"}],
                      }],
                  },
              },
          }},
-    ]
+        manager_configmap(namespace),
+    ] + observability_manifests(namespace)
 
 
 def write_yaml(path: str, docs) -> None:

@@ -113,8 +113,11 @@ class Metrics:
             return "".join(f"{k} {v}\n" for k, v in sorted(self.counters.items()))
 
 
-def _serve(port: int, metrics: Metrics, ready_fn) -> threading.Thread:
-    """healthz/readyz/metrics HTTP endpoints (reference main.go:115-122)."""
+def _serve(addr, metrics: Metrics, ready_fn) -> threading.Thread:
+    """healthz/readyz/metrics HTTP endpoints (reference main.go:115-122).
+    ``addr`` is ``(host, port)``; host defaults to all interfaces, and the
+    rendered Deployment binds metrics to 127.0.0.1 so only the
+    kube-rbac-proxy sidecar can reach them."""
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
@@ -135,7 +138,7 @@ def _serve(port: int, metrics: Metrics, ready_fn) -> threading.Thread:
         def log_message(self, *a):  # silence
             pass
 
-    srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    srv = http.server.ThreadingHTTPServer(addr, Handler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return t
@@ -368,8 +371,19 @@ class Manager:
         wq.stop()
 
 
+def load_config_file(path: str) -> Dict:
+    """Read the ControllerManagerConfig tier (reference:
+    config/manager/controller_manager_config.yaml mounted into the manager
+    Deployment).  Returns {} when the file is absent/empty."""
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
 def main(argv=None) -> int:
-    """CLI parity with reference main.go:57-63."""
+    """CLI parity with reference main.go:57-63, plus the --config file
+    tier (flags explicitly set on the command line win over the file)."""
     p = argparse.ArgumentParser(prog="tpujob-controller")
     p.add_argument("--metrics-bind-address", default=":8080")
     p.add_argument("--health-probe-bind-address", default=":8081")
@@ -379,26 +393,45 @@ def main(argv=None) -> int:
                    help="host-port allocation range 'start,end'")
     p.add_argument("--leader-elect", action="store_true")
     p.add_argument("--sync-period", type=float, default=2.0)
+    p.add_argument("--config", default="",
+                   help="YAML ControllerManagerConfig file; CLI flags "
+                        "left at their defaults take the file's values")
     args = p.parse_args(argv)
 
-    lo, hi = (int(x) for x in args.port_range.split(","))
+    file_cfg = load_config_file(args.config) if args.config else {}
+
+    def pick(flag: str, key: str):
+        val = getattr(args, flag)
+        if val == p.get_default(flag) and key in file_cfg:
+            return file_cfg[key]
+        return val
+
+    metrics_addr = pick("metrics_bind_address", "metricsBindAddress")
+    probe_addr = pick("health_probe_bind_address", "healthProbeBindAddress")
+    namespace = pick("namespace", "namespace")
+    port_range = str(pick("port_range", "portRange"))
+    leader_elect = bool(pick("leader_elect", "leaderElect"))
+    sync_period = float(pick("sync_period", "syncPeriod"))
+
+    lo, hi = (int(x) for x in port_range.split(","))
 
     from paddle_operator_tpu.controller.kube_api import KubeAPI
 
     api = KubeAPI()
     metrics = Metrics()
-    mgr = Manager(api, namespace=args.namespace or "default",
-                  sync_period=args.sync_period, port_range=(lo, hi),
-                  leader_elect=args.leader_elect, metrics=metrics)
+    mgr = Manager(api, namespace=namespace or "default",
+                  sync_period=sync_period, port_range=(lo, hi),
+                  leader_elect=leader_elect, metrics=metrics)
 
-    def port_of(addr: str, default: int) -> int:
+    def addr_of(addr: str, default_port: int):
+        host, _, port = addr.rpartition(":")
         try:
-            return int(addr.rsplit(":", 1)[-1])
+            return (host or "0.0.0.0", int(port))
         except ValueError:
-            return default
+            return ("0.0.0.0", default_port)
 
-    _serve(port_of(args.health_probe_bind_address, 8081), metrics, mgr.ready)
-    _serve(port_of(args.metrics_bind_address, 8080), metrics, mgr.ready)
+    _serve(addr_of(probe_addr, 8081), metrics, mgr.ready)
+    _serve(addr_of(metrics_addr, 8080), metrics, mgr.ready)
     mgr.run()
     return 0
 
