@@ -19,9 +19,15 @@ primitive.  Design:
   in place (no boundary all-gather), inserts tp activation collectives
   inside each stage, and the stage body may itself open a nested manual
   region over ``cp`` (ring attention, parallel/ring_attention.py).
-- Schedule: GPipe with M microbatches over P stages: M + P - 1 ticks, each
-  tick runs every stage's local block once.  Bubble fraction
-  (P-1)/(M+P-1) — choose M >= 4·P.
+- Schedules: **GPipe** (:func:`pipeline_apply` — forward-only scan, the
+  backward pipeline comes from autodiff) and **1F1B**
+  (:func:`pipeline_1f1b_grads` — forward and backward interleaved in ONE
+  scan, gradients computed manually).  GPipe's autodiff keeps residuals
+  for every one of the M+P-1 forward ticks live until its backward runs;
+  1F1B stashes only the stage *inputs* of the ≤ min(M, 2P-1) in-flight
+  microbatches and recomputes each stage forward at backward time
+  (jax.vjp per microbatch), so peak activation memory is O(P), not O(M) —
+  the point of 1F1B at M >= 4·P.
 """
 
 from __future__ import annotations
@@ -168,3 +174,163 @@ def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
     if b % num_microbatches:
         raise ValueError(f"batch {b} not divisible by M={num_microbatches}")
     return x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+
+def _masked_add(acc, new, live):
+    """acc + new where live (per-leaf); dead-lane NaNs are selected away,
+    not multiplied."""
+    return jax.tree.map(
+        lambda a, g: a + jnp.where(live, g, jnp.zeros_like(g)), acc, new)
+
+
+def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
+                        trunk_params: Any, head_params: Any,
+                        xm: jax.Array, targets_m: jax.Array,
+                        mask_m: jax.Array, seed: jax.Array,
+                        *, axis_name: str = "pp",
+                        compute_dtype: Any = None):
+    """Fused 1F1B forward+backward inside shard_map (manual over ``pp``).
+
+    Unlike :func:`pipeline_apply` (GPipe: all forwards in one scan, the
+    backward pipeline generated by autodiff), this runs the PipeDream-flush
+    schedule in a single scan and computes gradients manually: stage ``s``
+    forwards microbatch ``f`` at round ``f + s`` and backwards microbatch
+    ``b`` at round ``b + 2P-2-s``; the last stage backwards a microbatch
+    the same round it forwards it.  Only the stage *inputs* of in-flight
+    microbatches are stashed (ring buffer of min(M, 2P-1) slots); the
+    backward recomputes the stage forward under ``jax.vjp`` — peak live
+    activations O(P) instead of GPipe's O(M).
+
+    stage_fn(trunk_params, h) -> h'   (this stage's layer block)
+    head_loss_fn(head_params, h, targets, mask) -> scalar SUM-loss (the
+    caller seeds the gradient with ``seed`` = 1/denom to get mean-loss
+    gradients; in SPMD every stage computes it, the last stage's value is
+    the one kept).
+
+    Returns (sum_loss, d_trunk, d_head, d_xm): sum_loss/d_head/d_xm are
+    psum-replicated over pp, d_trunk stays this stage's local shard.
+
+    Trade-offs vs GPipe (documented, deliberate): the drain adds P-1 extra
+    rounds (R = M + 2P - 2 vs M + P - 1 per direction), and the loss head
+    runs masked on every stage (SPMD) — at LLaMA widths the stage block
+    dominates, and tp-sharding the head shrinks it like any other matmul.
+    MoE aux-loss routing is not supported here; use the GPipe schedule.
+    """
+    if compute_dtype is not None:
+        xm = xm.astype(compute_dtype)
+    stage = jax.lax.axis_index(axis_name)
+    n = jax.lax.psum(1, axis_name)
+    m = xm.shape[0]
+    k = min(m, 2 * n - 1)                 # stash ring-buffer slots
+    rounds = m + 2 * n - 2
+    is_last = stage == n - 1
+
+    perm_fwd = [(i, i + 1) for i in range(n - 1)]   # activations →
+    perm_bwd = [(i, i - 1) for i in range(1, n)]    # cotangents ←
+
+    zero_act = jnp.zeros_like(xm[0])
+
+    def round_fn(carry, r):
+        act_in, cot_in, stash, d_trunk, d_head, d_xm, loss_sum = carry
+
+        # ---- forward slot: microbatch f = r - stage -----------------
+        f = r - stage
+        fwd_live = (f >= 0) & (f < m)
+        fc = jnp.clip(f, 0, m - 1)
+        my_in = jnp.where(stage == 0,
+                          jax.lax.dynamic_index_in_dim(xm, fc, 0,
+                                                       keepdims=False),
+                          act_in)
+        slot_f = fc % k
+        stash = jax.lax.dynamic_update_index_in_dim(
+            stash,
+            jnp.where(fwd_live, my_in,
+                      jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
+                                                   keepdims=False)),
+            slot_f, 0)
+        out = stage_fn(trunk_params, my_in)
+
+        # last stage: head + loss + output cotangent for the SAME
+        # microbatch (1F1B: bwd f starts the round it was forwarded)
+        tgt = jax.lax.dynamic_index_in_dim(targets_m, fc, 0, keepdims=False)
+        msk = jax.lax.dynamic_index_in_dim(mask_m, fc, 0, keepdims=False)
+        sum_loss_f, head_vjp = jax.vjp(
+            lambda hp, h: head_loss_fn(hp, h, tgt, msk), head_params, out)
+        d_head_f, d_out_f = head_vjp(seed)
+        take_loss = is_last & fwd_live
+        loss_sum = loss_sum + jnp.where(take_loss,
+                                        sum_loss_f.astype(jnp.float32), 0.0)
+        d_head = _masked_add(d_head, d_head_f, take_loss)
+
+        # ---- backward slot: microbatch b = r - (2n - 2 - stage) -----
+        b = r - (2 * n - 2 - stage)
+        bwd_live = (b >= 0) & (b < m)
+        bc = jnp.clip(b, 0, m - 1)
+        saved = jax.lax.dynamic_index_in_dim(stash, bc % k, 0,
+                                             keepdims=False)
+        cot = jnp.where(is_last, d_out_f.astype(out.dtype), cot_in)
+        _, stage_vjp = jax.vjp(stage_fn, trunk_params, saved)
+        d_trunk_b, d_in_b = stage_vjp(cot)
+        d_trunk = _masked_add(d_trunk, d_trunk_b, bwd_live)
+        d_in_b = jnp.where(bwd_live, d_in_b, jnp.zeros_like(d_in_b))
+        d_xm = jax.lax.dynamic_update_index_in_dim(
+            d_xm,
+            jnp.where((stage == 0) & bwd_live, d_in_b,
+                      jax.lax.dynamic_index_in_dim(d_xm, bc, 0,
+                                                   keepdims=False)),
+            bc, 0)
+
+        # ---- neighbor communication for the next round --------------
+        act_next = jax.lax.ppermute(
+            jnp.where(fwd_live, out, zero_act), axis_name, perm_fwd)
+        cot_next = jax.lax.ppermute(d_in_b, axis_name, perm_bwd)
+        return (act_next, cot_next, stash, d_trunk, d_head, d_xm,
+                loss_sum), None
+
+    init = (
+        zero_act,                                     # act_in
+        zero_act,                                     # cot_in
+        jnp.zeros((k,) + xm.shape[1:], xm.dtype),     # stash
+        jax.tree.map(jnp.zeros_like, trunk_params),   # d_trunk
+        jax.tree.map(jnp.zeros_like, head_params),    # d_head
+        jnp.zeros_like(xm),                           # d_xm
+        jnp.zeros((), jnp.float32),                   # loss_sum
+    )
+    (_, _, _, d_trunk, d_head, d_xm, loss_sum), _ = jax.lax.scan(
+        round_fn, init, jnp.arange(rounds))
+
+    # replicate the single-stage-owned results over pp (one-hot psums)
+    loss_out = jax.lax.psum(loss_sum, axis_name)
+    d_head_out = jax.tree.map(lambda g: _psum_act(g, axis_name), d_head)
+    d_xm_out = _psum_act(d_xm, axis_name)
+    return loss_out, d_trunk, d_head_out, d_xm_out
+
+
+def make_pipeline_1f1b_fn(mesh: Mesh, stage_fn: Callable,
+                          head_loss_fn: Callable,
+                          *, axis_name: str = "pp"):
+    """Partial-manual shard_map wrapper for :func:`pipeline_1f1b_grads`
+    (same composition story as :func:`make_pipeline_fn`: only ``pp`` is
+    manual; dp/fsdp/tp/cp stay auto under GSPMD)."""
+    from jax import shard_map
+
+    in_specs = (P(axis_name), P(), P(), P(), P(), P())
+    out_specs = (P(), P(axis_name), P(), P())
+
+    def call(trunk_params, head_params, xm, targets_m, mask_m, seed):
+        compute_dtype = None
+        if xm.dtype == jnp.bfloat16:   # boundary dance, see make_pipeline_fn
+            compute_dtype, xm = xm.dtype, xm.astype(jnp.float32)
+        fn = shard_map(
+            functools.partial(pipeline_1f1b_grads, stage_fn, head_loss_fn,
+                              axis_name=axis_name,
+                              compute_dtype=compute_dtype),
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            axis_names=frozenset({axis_name}),
+            check_vma=False,
+        )
+        return fn(trunk_params, head_params, xm, targets_m, mask_m, seed)
+
+    return call

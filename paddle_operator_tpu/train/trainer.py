@@ -99,26 +99,23 @@ def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
     return -(ll * mask).sum() / denom, denom
 
 
-def make_custom_train_step(batch_loss, optimizer: optax.GradientTransformation,
-                           mesh: Mesh, state_sharding) -> Callable:
-    """The generic jitted train step every task-specific step builds on:
-    value_and_grad around ``batch_loss(params, batch_dict) -> (total_loss,
-    metrics_dict)`` (metrics must include "loss" and "tokens"), optimizer
-    update, and the jit with sharded/donated state.  The batch sharding is
-    a leading-dim prefix (batch dim over the data axes, everything else
-    replicated) so heterogeneous batch leaves — [B, S] tokens, [B, F, D]
-    rows, [B] labels — all shard the same way."""
+def make_grads_train_step(compute_grads,
+                          optimizer: optax.GradientTransformation,
+                          mesh: Mesh, state_sharding) -> Callable:
+    """Jitted train step from an explicit-gradients function
+    ``compute_grads(params, batch_dict) -> (metrics_dict, grads)`` —
+    the substrate shared by autodiff steps (:func:`make_custom_train_step`)
+    and the manually-differentiated 1F1B pipeline step."""
     data_sharding = batch_sharding(mesh, extra_dims=0)
 
     def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
-        (_, aux), grads = jax.value_and_grad(
-            batch_loss, has_aux=True)(state.params, batch)
+        metrics, grads = compute_grads(state.params, batch)
         updates, new_opt = optimizer.update(grads, state.opt_state,
                                             state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt)
-        metrics = dict(aux)
+        metrics = dict(metrics)
         metrics["grad_norm"] = optax.global_norm(grads)
         return new_state, metrics
 
@@ -137,6 +134,22 @@ def make_custom_train_step(batch_loss, optimizer: optax.GradientTransformation,
             out_shardings=out_shardings,
             donate_argnums=(0,),
         )
+
+
+def make_custom_train_step(batch_loss, optimizer: optax.GradientTransformation,
+                           mesh: Mesh, state_sharding) -> Callable:
+    """The generic jitted train step every task-specific step builds on:
+    value_and_grad around ``batch_loss(params, batch_dict) -> (total_loss,
+    metrics_dict)`` (metrics must include "loss" and "tokens"), optimizer
+    update, and the jit with sharded/donated state."""
+
+    def compute_grads(params, batch):
+        (_, aux), grads = jax.value_and_grad(
+            batch_loss, has_aux=True)(params, batch)
+        return aux, grads
+
+    return make_grads_train_step(compute_grads, optimizer, mesh,
+                                 state_sharding)
 
 
 def _jit_train_step(forward_loss, optimizer: optax.GradientTransformation,
@@ -190,8 +203,20 @@ def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
 
 def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
                        mesh: Mesh, state_sharding,
-                       *, num_microbatches: int) -> Callable:
-    """Pipeline-parallel LLaMA train step (GPipe over the ``pp`` mesh axis).
+                       *, num_microbatches: int,
+                       schedule: str = "gpipe") -> Callable:
+    """Pipeline-parallel LLaMA train step over the ``pp`` mesh axis.
+
+    ``schedule="gpipe"`` (default): forward scan + autodiff backward —
+    supports every composition including MoE, but autodiff keeps residuals
+    for all M+P-1 forward ticks live until their backwards run.
+
+    ``schedule="1f1b"``: the PipeDream-flush schedule fused into one scan
+    with manually-computed gradients (parallel/pipeline.py
+    pipeline_1f1b_grads) — stashes only the ≤ min(M, 2P-1) in-flight stage
+    inputs and recomputes each stage forward at backward time, so peak
+    activation memory is O(P) instead of O(M).  Gradients match GPipe
+    (same math, verified in tests/test_pp_train.py).  MoE requires gpipe.
 
     Split of labour (SURVEY.md §2 promised TP/PP as first-class — the
     reference's only hybrid hook is a rank id,
@@ -240,7 +265,11 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
                          "stacked `layers` axis IS the pp-sharded dim)")
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible by pp={pp}")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
     moe = getattr(cfg, "n_experts", 0) > 0
+    if moe and schedule == "1f1b":
+        raise ValueError("MoE aux-loss routing needs schedule='gpipe'")
 
     stack = LayerStack(cfg, cfg.n_layers // pp, mesh)
 
@@ -251,15 +280,62 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
                                h, cos, sin)
         return (out, aux) if moe else out
 
-    pipe = PP.make_pipeline_fn(mesh, stage_fn,
-                               num_microbatches=num_microbatches,
-                               has_aux=moe)
-
     # Head/tail are the same module definitions Llama.__call__ composes
     # (models/llama.py), applied standalone on their param subtrees.
     embed_mod = embed_module(cfg)
     norm_mod = final_norm_module(cfg)
     head_mod = lm_head_module(cfg)
+
+    if schedule == "1f1b":
+        # moe is False here, so stage_fn returns a bare activation
+        def head_loss(head_params, h, tgt, msk):
+            # SUM-loss per microbatch: the 1F1B machinery seeds its vjp
+            # with 1/denom, so gradients match the mean cross_entropy_loss.
+            # Target extraction is a one-hot contraction, not
+            # take_along_axis: a sharded gather inside the partial-manual
+            # region CHECK-crashes XLA:CPU's SPMD partitioner when tp and
+            # cp shard the logits together (spmd_partitioner_util.cc:495),
+            # and the masked select partitions like any elementwise op.
+            y = norm_mod.apply({"params": head_params["final_norm"]}, h)
+            logits = head_mod.apply(
+                {"params": head_params["lm_head"]}, y).astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            vocab_iota = jax.lax.broadcasted_iota(
+                jnp.int32, logp.shape, len(logp.shape) - 1)
+            ll = jnp.where(vocab_iota == tgt[..., None], logp, 0.0).sum(-1)
+            return -(ll * msk.astype(jnp.float32)).sum()
+
+        fused = PP.make_pipeline_1f1b_fn(mesh, stage_fn, head_loss)
+
+        def compute_grads(params, batch):
+            tokens = batch["tokens"]
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+            mask = batch.get("mask")
+            msk = (mask[:, 1:] if mask is not None
+                   else jnp.ones_like(targets)).astype(jnp.float32)
+            denom = jnp.maximum(msk.sum(), 1.0)
+            x, embed_vjp = jax.vjp(
+                lambda ep: embed_mod.apply({"params": ep}, inputs),
+                params["tok_embed"])
+            xm = PP.microbatch(x, num_microbatches)
+            tm = PP.microbatch(targets, num_microbatches)
+            mm = PP.microbatch(msk, num_microbatches)
+            head_params = {"final_norm": params["final_norm"],
+                           "lm_head": params["lm_head"]}
+            loss_sum, d_trunk, d_head, d_xm = fused(
+                params["layers"], head_params, xm, tm, mm, 1.0 / denom)
+            (d_embed,) = embed_vjp(d_xm.reshape(x.shape).astype(x.dtype))
+            grads = {"tok_embed": d_embed, "layers": d_trunk,
+                     "final_norm": d_head["final_norm"],
+                     "lm_head": d_head["lm_head"]}
+            return {"loss": loss_sum / denom, "tokens": denom}, grads
+
+        return make_grads_train_step(compute_grads, optimizer, mesh,
+                                     state_sharding)
+
+    pipe = PP.make_pipeline_fn(mesh, stage_fn,
+                               num_microbatches=num_microbatches,
+                               has_aux=moe)
 
     def forward_loss(params, inputs, targets, mask):
         x = embed_mod.apply({"params": params["tok_embed"]}, inputs)
@@ -287,12 +363,14 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
 def make_step_for_mesh(model: nn.Module, cfg,
                        optimizer: optax.GradientTransformation,
                        mesh: Mesh, state_sharding=None,
-                       *, num_microbatches: int = 4) -> Callable:
-    """Pick the right train step for the mesh: the GPipe step when pp > 1,
-    the plain GSPMD step otherwise."""
+                       *, num_microbatches: int = 4,
+                       schedule: str = "gpipe") -> Callable:
+    """Pick the right train step for the mesh: a pipeline step (gpipe or
+    1f1b schedule) when pp > 1, the plain GSPMD step otherwise."""
     if mesh_axis_sizes(mesh).get("pp", 1) > 1:
         return make_pp_train_step(cfg, optimizer, mesh, state_sharding,
-                                  num_microbatches=num_microbatches)
+                                  num_microbatches=num_microbatches,
+                                  schedule=schedule)
     return make_train_step(model, optimizer, mesh, state_sharding)
 
 

@@ -22,7 +22,7 @@ BATCH, SEQ = 16, 16
 
 
 def _run(mesh_spec, steps=3, microbatches=4, fixed_batch=False,
-         preset="tiny"):
+         preset="tiny", schedule="gpipe", with_grad_norm=False):
     mesh = make_mesh(mesh_spec)
     model, cfg = make_model(preset, dtype=jnp.float32, mesh=mesh)
     opt = T.make_optimizer(1e-3, warmup_steps=2, decay_steps=10)
@@ -31,14 +31,18 @@ def _run(mesh_spec, steps=3, microbatches=4, fixed_batch=False,
     shardings, _ = T.state_shardings(model, opt, mesh, pats, example)
     state = T.create_state(model, opt, mesh, pats, example)
     step = T.make_step_for_mesh(model, cfg, opt, mesh, shardings,
-                                num_microbatches=microbatches)
-    losses = []
+                                num_microbatches=microbatches,
+                                schedule=schedule)
+    losses, grad_norms = [], []
     for i in range(steps):
         batch = T.synthetic_batch(BATCH, SEQ + 1, cfg.vocab_size,
                                   seed=0 if fixed_batch else i)
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
+        grad_norms.append(float(metrics["grad_norm"]))
     assert all(np.isfinite(l) for l in losses)
+    if with_grad_norm:
+        return losses, grad_norms
     return losses
 
 
@@ -80,6 +84,58 @@ class TestPipelineLlama:
         losses = _run(MeshSpec(pp=2, dp=2, ep=2), steps=5, fixed_batch=True,
                       preset="tiny-moe")
         assert losses[-1] < losses[0]
+
+    def test_1f1b_gradients_match_gpipe(self):
+        """VERDICT r2 next #8: the 1F1B schedule (fused fwd/bwd scan,
+        manual gradients, O(P) activation stash) must produce the same
+        gradients as GPipe-by-autodiff — compared via grad_norm AND the
+        loss trajectory through a shared optimizer, multi-step."""
+        g_loss, g_gn = _run(MeshSpec(pp=2, dp=2, fsdp=2),
+                            with_grad_norm=True)
+        f_loss, f_gn = _run(MeshSpec(pp=2, dp=2, fsdp=2), schedule="1f1b",
+                            with_grad_norm=True)
+        np.testing.assert_allclose(f_loss, g_loss, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(f_gn, g_gn, rtol=1e-4, atol=1e-5)
+
+    def test_1f1b_matches_gspmd_loss_trajectory(self):
+        ref = _run(MeshSpec(dp=4, fsdp=2))
+        f = _run(MeshSpec(pp=2, dp=2, fsdp=2), schedule="1f1b")
+        np.testing.assert_allclose(f, ref, rtol=1e-4, atol=1e-4)
+
+    def test_1f1b_hybrid_tp_matches_gspmd(self):
+        ref = _run(MeshSpec(dp=4, fsdp=2))
+        f = _run(MeshSpec(dp=2, pp=2, tp=2), schedule="1f1b")
+        np.testing.assert_allclose(f, ref, rtol=1e-4, atol=1e-4)
+
+    def test_1f1b_hybrid_cp_matches_gspmd(self):
+        # ring attention's nested manual cp region must differentiate
+        # correctly under the manual jax.vjp the 1F1B backward slot uses
+        ref = _run(MeshSpec(dp=4, fsdp=2))
+        f = _run(MeshSpec(dp=2, pp=2, cp=2), schedule="1f1b")
+        np.testing.assert_allclose(f, ref, rtol=1e-4, atol=1e-4)
+
+    def test_1f1b_hybrid_tp_cp_matches_gspmd(self):
+        # tp AND cp together shard the head logits inside the manual
+        # region — the combo that forced the one-hot loss formulation
+        # (sharded gather CHECK-crashes XLA:CPU's partitioner there)
+        ref = _run(MeshSpec(dp=4, fsdp=2))
+        f = _run(MeshSpec(pp=2, tp=2, cp=2), schedule="1f1b")
+        np.testing.assert_allclose(f, ref, rtol=1e-4, atol=1e-4)
+
+    def test_1f1b_small_microbatch_count(self):
+        # M = 2 with P = 2: warmup/drain dominate; schedule indexing and
+        # the stash ring buffer must still line up
+        ref = _run(MeshSpec(dp=4, fsdp=2), microbatches=2)
+        f = _run(MeshSpec(pp=2, dp=2, fsdp=2), microbatches=2,
+                 schedule="1f1b")
+        np.testing.assert_allclose(f, ref, rtol=1e-4, atol=1e-4)
+
+    def test_1f1b_rejects_moe(self):
+        mesh = make_mesh(MeshSpec(pp=2, dp=2, ep=2))
+        _, cfg = make_model("tiny-moe")
+        with pytest.raises(ValueError, match="gpipe"):
+            T.make_pp_train_step(cfg, T.make_optimizer(), mesh, None,
+                                 num_microbatches=4, schedule="1f1b")
 
     def test_pp_rejects_unscanned_layers(self):
         mesh = make_mesh(MeshSpec(pp=2, dp=4))
