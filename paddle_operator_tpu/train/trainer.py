@@ -32,6 +32,9 @@ class TrainState(struct.PyTreeNode):
     step: jax.Array
     params: Any
     opt_state: Any
+    # non-optimized model variables (e.g. BatchNorm batch_stats for the
+    # ResNet family); None for purely-parametric models
+    model_state: Any = None
 
 
 def make_optimizer(learning_rate: float = 3e-4,
@@ -419,6 +422,83 @@ def make_wide_deep_train_step(model: nn.Module,
 
     return make_custom_train_step(batch_loss, optimizer, mesh,
                                   state_sharding)
+
+
+def create_resnet_state(model: nn.Module,
+                        optimizer: optax.GradientTransformation,
+                        example_images: jax.Array) -> TrainState:
+    """Init a ResNet-family state: params + optimizer + the BatchNorm
+    ``batch_stats`` collection carried in ``TrainState.model_state``."""
+    variables = model.init(jax.random.PRNGKey(0), example_images,
+                           train=False)
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=optimizer.init(params),
+        model_state={"batch_stats": variables["batch_stats"]})
+
+
+def make_resnet_train_step(model: nn.Module,
+                           optimizer: optax.GradientTransformation,
+                           mesh: Mesh, state_sharding=None) -> Callable:
+    """Image-classification train step for the ResNet family (BASELINE
+    config 2 — the reference's Collective-mode example trains ResNet-50
+    in-container, deploy/examples/resnet.yaml; here it is first-party).
+    Pure data parallelism (batch sharded over dp×fsdp), matching how the
+    reference example deploys it.
+
+    batch: {"images": [B, H, W, 3] float, "labels": [B] int32}.  BatchNorm
+    runs in train mode: ``batch_stats`` live in ``state.model_state`` and
+    advance every step alongside the params.
+    """
+    data_sharding = batch_sharding(mesh, extra_dims=0)
+
+    def step_fn(state: TrainState, batch: Dict[str, jax.Array]):
+        def loss_fn(params):
+            logits, new_vars = model.apply(
+                {"params": params, **state.model_state},
+                batch["images"], train=True, mutable=["batch_stats"])
+            labels = batch["labels"]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            loss = -jnp.take_along_axis(
+                logp, labels[:, None], axis=-1).mean()
+            metrics = {
+                "loss": loss,
+                "tokens": jnp.float32(labels.shape[0]),
+                "accuracy": (logits.argmax(-1) == labels).mean(
+                    dtype=jnp.float32),
+            }
+            return loss, (metrics, new_vars)
+
+        (_, (metrics, new_vars)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = dict(metrics)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = TrainState(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            model_state={"batch_stats": new_vars["batch_stats"]})
+        return new_state, metrics
+
+    in_shardings = (state_sharding, data_sharding) \
+        if state_sharding is not None else None
+    out_shardings = (state_sharding, None) \
+        if state_sharding is not None else None
+    with mesh:
+        return jax.jit(step_fn, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=(0,))
+
+
+def image_synthetic_batch(batch_size: int, hw: int, num_classes: int,
+                          *, seed: int = 0) -> Dict[str, jax.Array]:
+    """Deterministic synthetic image-classification batch."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    images = jax.random.normal(k1, (batch_size, hw, hw, 3), jnp.float32)
+    labels = jax.random.randint(k2, (batch_size,), 0, num_classes,
+                                dtype=jnp.int32)
+    return {"images": images, "labels": labels}
 
 
 def mlm_synthetic_batch(batch_size: int, seq_len: int, vocab: int,
