@@ -133,6 +133,25 @@ class TestShardedTraining:
         l_mesh, _, _ = self.run_steps(MeshSpec(dp=2, fsdp=2, tp=2))
         np.testing.assert_allclose(l_single, l_mesh, rtol=2e-3, atol=2e-3)
 
+    def test_remat_policies_equivalent(self):
+        """Every remat policy (full / save_attn / dots) computes the same
+        loss — remat trades memory for recompute, never math."""
+        from paddle_operator_tpu.parallel.mesh import make_mesh
+
+        losses = {}
+        for pol in ("full", "save_attn", "dots"):
+            mesh = make_mesh(MeshSpec(dp=8))
+            model, cfg = L.make_model("tiny", remat_policy=pol)
+            opt = T.make_optimizer()
+            pats = L.partition_patterns(cfg)
+            ex = (jnp.zeros((8, 16), jnp.int32),)
+            sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
+            state = T.create_state(model, opt, mesh, pats, ex)
+            step = T.make_train_step(model, opt, mesh, sh)
+            _, m = step(state, T.synthetic_batch(8, 17, cfg.vocab_size))
+            losses[pol] = float(m["loss"])
+        assert losses["full"] == losses["save_attn"] == losses["dots"]
+
 
 class TestLoss:
     def test_perfect_prediction_zero_loss(self):
