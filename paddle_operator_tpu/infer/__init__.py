@@ -1,0 +1,5 @@
+from paddle_operator_tpu.infer.decode import (  # noqa: F401
+    generate,
+    init_cache,
+    prefill,
+)

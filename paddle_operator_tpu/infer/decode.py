@@ -1,0 +1,193 @@
+"""Autoregressive KV-cache decoding for the LLaMA family.
+
+The reference delegates ALL model execution to user containers; a complete
+framework also needs the serving-shaped path.  TPU-native design:
+
+- **Static shapes throughout**: the KV cache is a fixed-size ring of
+  ``[L, B, max_len, H_kv, D]`` arrays and the generation loop is a
+  ``lax.scan`` over ``max_new_tokens`` — one compile serves any
+  prompt/continuation length ≤ max_len (no shape-polymorphic retraces).
+- **Pure functions over the trained param tree**: decode consumes the
+  exact pytree ``train/trainer.py`` optimizes (scanned ``layers`` layout),
+  so a checkpoint restored by ``train/checkpoint.py`` serves directly.
+  The layer math mirrors ``models/llama.py`` (RMSNorm → GQA attention
+  with the split-halves RoPE → SwiGLU); equivalence is pinned by
+  tests/test_decode.py, which asserts decode logits match the training
+  forward position-for-position.
+- Prefill processes the whole prompt in one pass (MXU-friendly [B, S]
+  matmuls + causal mask against the cache); the step loop then decodes
+  one token per scan tick with single-query attention over the cache.
+
+MoE configs are not supported here yet (capacity-factor routing is
+batch-shaped); dense configs only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
+
+
+def _rms(x: jax.Array, scale: jax.Array, eps: float, dtype) -> jax.Array:
+    """models/llama.py RMSNorm math, f32 internals."""
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * scale.astype(jnp.float32)).astype(dtype)
+
+
+def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
+          pos: jax.Array) -> jax.Array:
+    """Split-halves RoPE at dynamic offset ``pos`` (mirrors
+    models/llama.py apply_rope, which slices at a static offset)."""
+    t = x.shape[1]
+    cos = jax.lax.dynamic_slice_in_dim(cos, pos, t)[None, :, None, :]
+    sin = jax.lax.dynamic_slice_in_dim(sin, pos, t)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+def init_cache(cfg: LlamaConfig, batch: int,
+               max_len: Optional[int] = None) -> Dict[str, jax.Array]:
+    """Fixed-size KV cache: k/v [L, B, max_len, H_kv, D] in compute dtype,
+    plus the fill position (scalar int32)."""
+    max_len = max_len or cfg.max_seq_len
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+           cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
+           v_cache: jax.Array, pos: jax.Array
+           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer over [B, T] new positions starting at ``pos``,
+    attending to the cache's [0, pos+T).  Returns (y, k_cache', v_cache').
+    lp is ONE layer's param subtree (unstacked)."""
+    b, t, _ = x.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    q = (h @ lp["attn"]["wq"]["kernel"].astype(cfg.dtype)
+         ).reshape(b, t, hq, d)
+    k = (h @ lp["attn"]["wk"]["kernel"].astype(cfg.dtype)
+         ).reshape(b, t, hkv, d)
+    v = (h @ lp["attn"]["wv"]["kernel"].astype(cfg.dtype)
+         ).reshape(b, t, hkv, d)
+    q = _rope(q, cos, sin, pos)
+    k = _rope(k, cos, sin, pos)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+
+    # GQA: group query heads onto kv heads; single-query (or prefill-
+    # block) attention against the cache with a causal+fill mask
+    n_rep = hq // hkv
+    max_len = k_cache.shape[1]
+    qg = q.reshape(b, t, hkv, n_rep, d).astype(jnp.float32)
+    kc = k_cache.astype(jnp.float32)
+    vc = v_cache.astype(jnp.float32)
+    # scores [B, T, Hkv, n_rep, max_len]; rows may attend cache cols up to
+    # their own absolute position (causal + cache-fill mask in one)
+    scores = jnp.einsum("bthrd,bshd->bthrs", qg, kc) / jnp.sqrt(
+        jnp.float32(d))
+    cols = jnp.arange(max_len)                           # [S]
+    rows = pos + jnp.arange(t)                           # [T]
+    mask = cols[None, :] <= rows[:, None]                # [T, S]
+    scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bshd->bthrd", probs, vc)
+    out = out.reshape(b, t, hq * d).astype(cfg.dtype)
+    attn_out = out @ lp["attn"]["wo"]["kernel"].astype(cfg.dtype)
+
+    x = x + attn_out
+    n = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    gate = n @ lp["mlp"]["w1"]["kernel"].astype(cfg.dtype)
+    up = n @ lp["mlp"]["w3"]["kernel"].astype(cfg.dtype)
+    ffn = (jax.nn.silu(gate) * up) @ lp["mlp"]["w2"]["kernel"].astype(
+        cfg.dtype)
+    return x + ffn, k_cache, v_cache
+
+
+def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
+             cache: Dict[str, jax.Array]
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """[B, T] new tokens at cache['pos'] -> ([B, T, vocab] logits,
+    advanced cache).  Layers run under lax.scan over the stacked params
+    (the same ``layers`` layout nn.scan trains)."""
+    if cfg.n_experts > 0:
+        raise NotImplementedError("MoE decode not supported yet")
+    pos = cache["pos"]
+    x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tokens]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+
+    def body(x, layer_in):
+        lp, k_c, v_c = layer_in
+        y, k_c, v_c = _layer(cfg, lp, x, cos, sin, k_c, v_c, pos)
+        return y, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    logits = (x @ params["lm_head"]["kernel"].astype(cfg.dtype)
+              ).astype(jnp.float32)
+    new_cache = {"k": k_new, "v": v_new,
+                 "pos": pos + tokens.shape[1]}
+    return logits, new_cache
+
+
+def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
+            max_len: Optional[int] = None
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Process the whole prompt [B, S] in one pass.  Returns
+    ([B, vocab] last-position logits, filled cache)."""
+    cache = init_cache(cfg, tokens.shape[0], max_len)
+    logits, cache = _forward(cfg, params, tokens, cache)
+    return logits[:, -1], cache
+
+
+def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
+                token: jax.Array, cache: Dict[str, jax.Array]
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One token [B] -> next-position logits [B, vocab] + advanced cache."""
+    logits, cache = _forward(cfg, params, token[:, None], cache)
+    return logits[:, 0], cache
+
+
+def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
+             *, max_new_tokens: int, temperature: float = 0.0,
+             key: Optional[jax.Array] = None,
+             max_len: Optional[int] = None) -> jax.Array:
+    """Greedy (temperature=0) or temperature sampling.  prompt [B, S] ->
+    [B, S + max_new_tokens].  jit-friendly: the step loop is a lax.scan
+    with static trip count."""
+    if temperature > 0 and key is None:
+        key = jax.random.PRNGKey(0)
+
+    logits, cache = prefill(params, cfg, prompt, max_len)
+
+    def sample(logits, k):
+        if temperature <= 0:
+            return logits.argmax(-1).astype(prompt.dtype)
+        return jax.random.categorical(
+            k, logits / temperature).astype(prompt.dtype)
+
+    def step(carry, k):
+        logits, cache = carry
+        tok = sample(logits, k)
+        logits, cache = decode_step(params, cfg, tok, cache)
+        return (logits, cache), tok
+
+    keys = (jax.random.split(key, max_new_tokens) if temperature > 0
+            else jnp.zeros((max_new_tokens, 2), jnp.uint32))
+    (_, _), toks = jax.lax.scan(step, (logits, cache), keys)
+    return jnp.concatenate([prompt, toks.T], axis=1)
