@@ -78,3 +78,25 @@ def test_disabled_without_path():
     assert not ckpt.enabled
     state, resumed = resume_or_init(ckpt, lambda: {"w": jnp.zeros(2)})
     assert not resumed
+
+
+def test_model_state_roundtrip(tmp_path):
+    """TrainState.model_state (ResNet BatchNorm batch_stats) must survive
+    the checkpoint round-trip alongside params/opt_state."""
+    from paddle_operator_tpu.models import resnet as R
+
+    model, cfg = R.make_model("tiny")
+    mesh = make_mesh(MeshSpec(dp=8))
+    opt = T.make_optimizer(1e-3, warmup_steps=1, decay_steps=20)
+    state = T.create_resnet_state(
+        model, opt, jnp.zeros((2, 16, 16, 3), jnp.float32))
+    step = T.make_resnet_train_step(model, opt, mesh)
+    state, _ = step(state, T.image_synthetic_batch(8, 16, cfg.num_classes))
+
+    ckpt = CheckpointManager(str(tmp_path / "ck"), save_interval_steps=1)
+    assert ckpt.save(1, state, force=True)
+    restored = ckpt.restore(jax.tree.map(jnp.zeros_like, state))
+    for a, b in zip(jax.tree.leaves(state.model_state),
+                    jax.tree.leaves(restored.model_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == 1
