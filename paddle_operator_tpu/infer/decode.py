@@ -18,8 +18,9 @@ framework also needs the serving-shaped path.  TPU-native design:
   matmuls + causal mask against the cache); the step loop then decodes
   one token per scan tick with single-query attention over the cache.
 
-MoE configs are not supported here yet (capacity-factor routing is
-batch-shaped); dense configs only.
+MoE configs decode with exact no-drop top-1 routing (the training layer's
+capacity buffer is a static-shape device whose drops are an
+approximation; inference computes the conditional model directly).
 """
 
 from __future__ import annotations
@@ -110,11 +111,42 @@ def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
     x = x + attn_out
     n = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    gate = n @ lp["mlp"]["w1"]["kernel"].astype(cfg.dtype)
-    up = n @ lp["mlp"]["w3"]["kernel"].astype(cfg.dtype)
-    ffn = (jax.nn.silu(gate) * up) @ lp["mlp"]["w2"]["kernel"].astype(
-        cfg.dtype)
+    if cfg.n_experts > 0:
+        ffn = _moe_ffn(cfg, lp["moe"], n)
+    else:
+        gate = n @ lp["mlp"]["w1"]["kernel"].astype(cfg.dtype)
+        up = n @ lp["mlp"]["w3"]["kernel"].astype(cfg.dtype)
+        ffn = (jax.nn.silu(gate) * up) @ lp["mlp"]["w2"]["kernel"].astype(
+            cfg.dtype)
     return x + ffn, k_cache, v_cache
+
+
+def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
+             n: jax.Array) -> jax.Array:
+    """Top-1 MoE FFN at inference: exact conditional computation with NO
+    capacity dropping (the capacity buffer of models/moe.py is a
+    training-time static-shape device; drops are its approximation, not
+    the model).  Experts run under lax.scan so peak memory is one
+    expert's activations, then the router's argmax selects per token."""
+    b, t, d = n.shape
+    tokens = n.reshape(b * t, d)
+    probs = jax.nn.softmax(
+        tokens.astype(jnp.float32)
+        @ mp["router"]["kernel"].astype(jnp.float32), axis=-1)
+    eidx = jnp.argmax(probs, axis=-1)                       # [T]
+    gate = jnp.take_along_axis(probs, eidx[:, None], 1)[:, 0]
+
+    def one_expert(_, w):
+        w1_e, w2_e = w
+        h = jax.nn.gelu(tokens @ w1_e.astype(cfg.dtype))
+        return None, h @ w2_e.astype(cfg.dtype)             # [T, D]
+
+    _, outs = jax.lax.scan(one_expert, None,
+                           (mp["w1"], mp["w2"]))            # [E, T, D]
+    sel = jax.nn.one_hot(eidx, cfg.n_experts,
+                         dtype=jnp.float32) * gate[:, None]
+    out = jnp.einsum("te,etd->td", sel.astype(cfg.dtype), outs)
+    return out.reshape(b, t, d)
 
 
 def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
@@ -123,8 +155,6 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     """[B, T] new tokens at cache['pos'] -> ([B, T, vocab] logits,
     advanced cache).  Layers run under lax.scan over the stacked params
     (the same ``layers`` layout nn.scan trains)."""
-    if cfg.n_experts > 0:
-        raise NotImplementedError("MoE decode not supported yet")
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tokens]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,

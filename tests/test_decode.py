@@ -99,10 +99,45 @@ class TestGenerate:
         assert out.shape == (2, 7)
         assert int(out.max()) < cfg.vocab_size
 
-    def test_moe_rejected(self):
-        _, cfg = make_model("tiny-moe")
-        with pytest.raises(NotImplementedError):
-            D.prefill({}, cfg, jnp.zeros((1, 4), jnp.int32))
+    def test_moe_generates(self):
+        model, cfg = make_model("tiny-moe", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        out = D.generate(params, cfg, _prompt(cfg, b=2, s=4),
+                         max_new_tokens=4)
+        assert out.shape == (2, 8)
+
+
+class TestMoEDecodeEquivalence:
+    def test_prefill_matches_training_forward_when_no_drops(self):
+        """Decode computes no-drop top-1 MoE; the training layer drops
+        tokens past its capacity buffer.  With capacity_factor >= E no
+        token can ever drop, so the two must agree exactly."""
+        model, cfg = make_model("tiny-moe", dtype=jnp.float32,
+                                moe_capacity_factor=8.0)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        toks = _prompt(cfg, b=2, s=10)
+        ref, _aux = model.apply({"params": params}, toks)
+        cache = D.init_cache(cfg, toks.shape[0])
+        logits, _ = D._forward(cfg, params, toks, cache)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_incremental_moe_decode_matches(self):
+        model, cfg = make_model("tiny-moe", dtype=jnp.float32,
+                                moe_capacity_factor=8.0)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        toks = _prompt(cfg, b=2, s=8, seed=5)
+        _, cache = D.prefill(params, cfg, toks[:, :3])
+        for t in range(3, toks.shape[1]):
+            step_logits, cache = D.decode_step(params, cfg, toks[:, t],
+                                               cache)
+            ref, _aux = model.apply({"params": params}, toks[:, :t + 1])
+            np.testing.assert_allclose(np.asarray(step_logits),
+                                       np.asarray(ref[:, -1]),
+                                       rtol=1e-4, atol=1e-4, err_msg=str(t))
 
 
 class TestShardedDecode:
