@@ -110,6 +110,38 @@ def measure_llama(cfg, batch: int, seq: int, steps: int, warmup: int,
     }
 
 
+def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int) -> dict:
+    """Greedy KV-cache decode throughput (infer/decode.py) for one config
+    on the current device.  Decode is HBM-bandwidth-bound (every step
+    streams the full weights); tokens/s/chip is the serving headline."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_operator_tpu.infer import decode as D
+    from paddle_operator_tpu.models import llama as L
+
+    model = L.Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
+                                0, cfg.vocab_size, dtype=jnp.int32)
+    gen = jax.jit(lambda p, t: D.generate(
+        p, cfg, t, max_new_tokens=new_tokens,
+        max_len=prompt_len + new_tokens))
+    out = gen(params, prompt)
+    int(out[0, -1])                       # host sync: compile + run done
+    t0 = time.perf_counter()
+    out = gen(params, prompt)
+    int(out[0, -1])
+    dt = time.perf_counter() - t0
+    return {
+        "decode_batch": batch, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_tok_per_sec": round(batch * new_tokens / dt, 1),
+        "decode_ms_per_token": round(dt / new_tokens * 1000, 2),
+    }
+
+
 def measure_submit_latency() -> dict:
     """submit→rendezvous-ConfigMap over real HTTP (BASELINE.md metric
     'kubectl apply → first training step'; the training-side share is the
@@ -195,6 +227,15 @@ def main() -> int:
                                  peak=peak)
         sweep = []
 
+    if on_tpu:
+        decode = measure_decode(
+            cfg_with(dim=2048, n_layers=8, n_heads=16, n_kv_heads=16,
+                     ffn_dim=8192),
+            batch=8, prompt_len=128, new_tokens=64)
+    else:
+        decode = measure_decode(L.CONFIGS["tiny"], batch=2, prompt_len=8,
+                                new_tokens=4)
+
     latency = measure_submit_latency()
 
     detail = {
@@ -204,6 +245,7 @@ def main() -> int:
                                     "steps", "step_time_s", "first_step_s",
                                     "loss")},
         "sweep": sweep,
+        **decode,
         **latency,
         # end-to-end BASELINE latency: orchestration + compile/first step
         "submit_to_first_step_s": round(
