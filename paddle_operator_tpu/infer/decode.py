@@ -200,14 +200,19 @@ def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
 def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
              *, max_new_tokens: int, temperature: float = 0.0,
              key: Optional[jax.Array] = None,
-             max_len: Optional[int] = None) -> jax.Array:
+             max_len: Optional[int] = None,
+             eos_token: Optional[int] = None) -> jax.Array:
     """Greedy (temperature=0) or temperature sampling.  prompt [B, S] ->
     [B, S + max_new_tokens].  jit-friendly: the step loop is a lax.scan
-    with static trip count."""
+    with static trip count (shapes never depend on when sequences stop).
+    With ``eos_token``, a sequence that emits it keeps emitting eos for
+    its remaining positions (the scan still runs max_new_tokens ticks —
+    static shapes beat early exit on TPU)."""
     if temperature > 0 and key is None:
         key = jax.random.PRNGKey(0)
 
     logits, cache = prefill(params, cfg, prompt, max_len)
+    done0 = jnp.zeros((prompt.shape[0],), bool)
 
     def sample(logits, k):
         if temperature <= 0:
@@ -216,12 +221,15 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
             k, logits / temperature).astype(prompt.dtype)
 
     def step(carry, k):
-        logits, cache = carry
+        logits, cache, done = carry
         tok = sample(logits, k)
+        if eos_token is not None:
+            tok = jnp.where(done, jnp.asarray(eos_token, tok.dtype), tok)
+            done = done | (tok == eos_token)
         logits, cache = decode_step(params, cfg, tok, cache)
-        return (logits, cache), tok
+        return (logits, cache, done), tok
 
     keys = (jax.random.split(key, max_new_tokens) if temperature > 0
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))
-    (_, _), toks = jax.lax.scan(step, (logits, cache), keys)
+    (_, _, _), toks = jax.lax.scan(step, (logits, cache, done0), keys)
     return jnp.concatenate([prompt, toks.T], axis=1)

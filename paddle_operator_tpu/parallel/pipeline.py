@@ -28,6 +28,14 @@ primitive.  Design:
   microbatches and recomputes each stage forward at backward time
   (jax.vjp per microbatch), so peak activation memory is O(P), not O(M) —
   the point of 1F1B at M >= 4·P.
+- **No interleaved (virtual-stage) schedule, deliberately**: in the
+  masked-SPMD scan formulation every round executes the full program and
+  masks dead lanes, so a round costs the same whether its slot is live or
+  a bubble.  Interleaving's benefit is exactly bubble-time reduction via
+  per-device divergent chunk ordering — which SPMD masking cannot
+  capture (each device would pay for all V chunks every round).  The
+  schedules here optimize what the formulation CAN deliver: fewer masked
+  rounds (both) and O(P) activation memory (1F1B).
 """
 
 from __future__ import annotations

@@ -165,3 +165,24 @@ class TestShardedDecode:
                 p, cfg, t, max_new_tokens=5))(
                     sharded, jax.device_put(prompt, data_sh))
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+class TestEosToken:
+    def test_sequences_pad_with_eos_after_stopping(self, setup):
+        """Once a sequence emits eos, every later position is eos (static
+        shapes: the scan still runs all ticks)."""
+        _, cfg, params = setup
+        prompt = _prompt(cfg, b=4, s=5, seed=11)
+        # pick the model's own first greedy token as "eos" for one row so
+        # the stop path definitely triggers
+        first, _ = D.prefill(params, cfg, prompt)
+        eos = int(first.argmax(-1)[0])
+        out = np.asarray(D.generate(params, cfg, prompt, max_new_tokens=6,
+                                    eos_token=eos))
+        gen_part = out[:, 5:]
+        for row in gen_part:
+            hits = np.where(row == eos)[0]
+            if hits.size:
+                assert (row[hits[0]:] == eos).all()
+        # row 0 stopped at its first generated token by construction
+        assert (gen_part[0] == eos).all()
