@@ -1,0 +1,97 @@
+// Native data-loader fast path: memory-mapped token files + batched
+// window gather.
+//
+// The Python pipeline (train/data.py mmap_token_batches) assembles each
+// [B, seq+1] batch with a per-row numpy slice loop; this library does the
+// whole gather in one C call over an mmap'd file — one pass, widening
+// uint16/uint32 tokens to the int32 the trainer consumes.  The reference
+// ships no data loader at all (data is user-container territory,
+// docs/user-guide.md:260-347); our framework owns the workload layer, so
+// the loader is a framework component and its hot loop is native.
+//
+// C ABI for ctypes (no pybind11 in this toolchain).
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct TokenFile {
+  void* base = nullptr;
+  size_t bytes = 0;
+  int width = 2;  // bytes per token: 2 (uint16) or 4 (uint32)
+};
+
+}  // namespace
+
+extern "C" {
+
+// Open + mmap a flat token file.  width = bytes/token (2 or 4).
+// Returns a handle or nullptr.
+void* dio_open(const char* path, int width) {
+  if (width != 2 && width != 4) return nullptr;
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);  // mapping keeps the file alive
+  if (base == MAP_FAILED) return nullptr;
+  madvise(base, st.st_size, MADV_RANDOM);  // gather access pattern
+  auto* tf = new TokenFile();
+  tf->base = base;
+  tf->bytes = static_cast<size_t>(st.st_size);
+  tf->width = width;
+  return tf;
+}
+
+// Number of tokens in the file.
+int64_t dio_len(void* handle) {
+  auto* tf = static_cast<TokenFile*>(handle);
+  return tf ? static_cast<int64_t>(tf->bytes / tf->width) : -1;
+}
+
+// Gather n windows of `win` tokens starting at starts[i], widened to
+// int32 into out [n * win].  Returns 0, or -1 on a bounds violation
+// (nothing partially written before validation).
+int dio_gather(void* handle, const int64_t* starts, int64_t n,
+               int64_t win, int32_t* out) {
+  auto* tf = static_cast<TokenFile*>(handle);
+  if (!tf || n < 0 || win <= 0) return -1;
+  const int64_t total = static_cast<int64_t>(tf->bytes / tf->width);
+  for (int64_t i = 0; i < n; ++i) {
+    if (starts[i] < 0 || starts[i] + win > total) return -1;
+  }
+  if (tf->width == 2) {
+    const auto* data = static_cast<const uint16_t*>(tf->base);
+    for (int64_t i = 0; i < n; ++i) {
+      const uint16_t* src = data + starts[i];
+      int32_t* dst = out + i * win;
+      for (int64_t j = 0; j < win; ++j) dst[j] = src[j];
+    }
+  } else {
+    const auto* data = static_cast<const uint32_t*>(tf->base);
+    for (int64_t i = 0; i < n; ++i) {
+      const uint32_t* src = data + starts[i];
+      int32_t* dst = out + i * win;
+      for (int64_t j = 0; j < win; ++j) dst[j] = static_cast<int32_t>(src[j]);
+    }
+  }
+  return 0;
+}
+
+void dio_close(void* handle) {
+  auto* tf = static_cast<TokenFile*>(handle);
+  if (!tf) return;
+  if (tf->base) munmap(tf->base, tf->bytes);
+  delete tf;
+}
+
+}  // extern "C"

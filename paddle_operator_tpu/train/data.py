@@ -37,21 +37,94 @@ def synthetic_lm_batches(batch_size: int, seq_len: int, vocab: int,
             0, vocab, (batch_size, seq_len), dtype=np.int32)}
 
 
+class NativeTokenFile:
+    """ctypes binding to the native mmap gather (native/dataio.cpp): one C
+    call assembles a whole [B, win] int32 batch from a flat token file."""
+
+    def __init__(self, path: str, dtype=np.uint16,
+                 lib_path: Optional[str] = None) -> None:
+        import ctypes
+
+        from paddle_operator_tpu.controller.hostport import _find_native_lib
+
+        width = np.dtype(dtype).itemsize
+        if width not in (2, 4):
+            raise ValueError(f"unsupported token dtype {dtype}")
+        lib_file = lib_path or _find_native_lib()
+        if lib_file is None:
+            raise FileNotFoundError("native library not built "
+                                    "(run `make -C native`)")
+        lib = ctypes.CDLL(lib_file)
+        lib.dio_open.restype = ctypes.c_void_p
+        lib.dio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dio_len.restype = ctypes.c_int64
+        lib.dio_len.argtypes = [ctypes.c_void_p]
+        lib.dio_gather.restype = ctypes.c_int
+        lib.dio_gather.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
+        lib.dio_close.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.dio_open(path.encode(), width)
+        if not self._h:
+            raise FileNotFoundError(f"dio_open failed for {path}")
+
+    def __len__(self) -> int:
+        return int(self._lib.dio_len(self._h))
+
+    def gather(self, starts: np.ndarray, win: int) -> np.ndarray:
+        starts = np.ascontiguousarray(starts, np.int64)
+        out = np.empty((len(starts), win), np.int32)
+        rc = self._lib.dio_gather(self._h, starts, len(starts), win, out)
+        if rc != 0:
+            raise IndexError("window out of bounds")
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.dio_close(self._h)
+            self._h = None
+
+    def __del__(self) -> None:
+        self.close()
+
+
 def mmap_token_batches(path: str, batch_size: int, seq_len: int,
                        *, dtype=np.uint16, seed: int = 0,
-                       loop: bool = True) -> Iterator[Dict[str, np.ndarray]]:
+                       loop: bool = True,
+                       native: Optional[bool] = None
+                       ) -> Iterator[Dict[str, np.ndarray]]:
     """Sample [batch, seq+1] windows from a flat token file (memory-mapped;
     zero-copy until batch assembly).  Each process samples independently —
-    with per-process seeds the dp shards are disjoint in expectation."""
-    data = np.memmap(path, dtype=dtype, mode="r")
-    n = len(data) - seq_len - 1
+    with per-process seeds the dp shards are disjoint in expectation.
+
+    ``native``: use the C++ gather (native/dataio.cpp) — one call per
+    batch instead of a per-row python slice loop.  Default: native when
+    the library is built, python otherwise; pass True/False to force."""
+    reader = None
+    if native is not False:
+        try:
+            reader = NativeTokenFile(path, dtype)
+        except (FileNotFoundError, ValueError):
+            if native:
+                raise
+    if reader is not None:
+        n = len(reader) - seq_len - 1
+    else:
+        data = np.memmap(path, dtype=dtype, mode="r")
+        n = len(data) - seq_len - 1
     if n <= 0:
         raise ValueError(f"{path}: too short for seq_len={seq_len}")
     rng = np.random.default_rng(seed + 2654435761 * jax.process_index())
     while True:
         starts = rng.integers(0, n, batch_size)
-        batch = np.stack([np.asarray(data[s:s + seq_len + 1])
-                          for s in starts]).astype(np.int32)
+        if reader is not None:
+            batch = reader.gather(starts, seq_len + 1)
+        else:
+            batch = np.stack([np.asarray(data[s:s + seq_len + 1])
+                              for s in starts]).astype(np.int32)
         yield {"tokens": batch}
         if not loop:
             break

@@ -76,3 +76,39 @@ class TestObservability:
         l1 = get_logger("x")
         l2 = get_logger("x")
         assert l1 is l2 and len(l1.handlers) == 1
+
+
+class TestNativeDataIO:
+    def test_native_matches_python_path(self, tmp_path):
+        """The C++ gather (native/dataio.cpp) must produce the exact
+        batches the numpy slice loop produces for the same seed."""
+        path = tmp_path / "tok.bin"
+        np.random.default_rng(0).integers(
+            0, 60000, 50000).astype(np.uint16).tofile(path)
+        a = next(D.mmap_token_batches(str(path), 16, 64, seed=9,
+                                      native=True))
+        b = next(D.mmap_token_batches(str(path), 16, 64, seed=9,
+                                      native=False))
+        np.testing.assert_array_equal(a["tokens"], b["tokens"])
+        assert a["tokens"].dtype == np.int32
+
+    def test_native_uint32_widening(self, tmp_path):
+        path = tmp_path / "tok32.bin"
+        np.arange(5000, dtype=np.uint32).tofile(path)
+        batch = next(D.mmap_token_batches(str(path), 4, 16,
+                                          dtype=np.uint32, native=True))
+        assert batch["tokens"].dtype == np.int32
+        assert (np.diff(batch["tokens"][0]) == 1).all()
+
+    def test_native_bounds_check(self, tmp_path):
+        path = tmp_path / "small.bin"
+        np.arange(100, dtype=np.uint16).tofile(path)
+        f = D.NativeTokenFile(str(path))
+        assert len(f) == 100
+        with np.testing.assert_raises(IndexError):
+            f.gather(np.array([95]), 10)
+        with np.testing.assert_raises(IndexError):
+            f.gather(np.array([-1]), 5)
+        np.testing.assert_array_equal(
+            f.gather(np.array([90]), 10)[0], np.arange(90, 100))
+        f.close()
