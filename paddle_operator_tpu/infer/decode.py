@@ -56,8 +56,13 @@ def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
 def init_cache(cfg: LlamaConfig, batch: int,
                max_len: Optional[int] = None) -> Dict[str, jax.Array]:
     """Fixed-size KV cache: k/v [L, B, max_len, H_kv, D] in compute dtype,
-    plus the fill position (scalar int32)."""
+    plus the fill position (scalar int32).  max_len may not exceed
+    cfg.max_seq_len: positions past the RoPE table would silently clamp
+    (dynamic_slice semantics) and corrupt the rotary phases."""
     max_len = max_len or cfg.max_seq_len
+    if max_len > cfg.max_seq_len:
+        raise ValueError(f"cache max_len {max_len} exceeds the RoPE table "
+                         f"(cfg.max_seq_len={cfg.max_seq_len})")
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
@@ -210,6 +215,12 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
     static shapes beat early exit on TPU)."""
     if temperature > 0 and key is None:
         key = jax.random.PRNGKey(0)
+    need = prompt.shape[1] + max_new_tokens
+    cache_len = max_len or cfg.max_seq_len
+    if need > cache_len:
+        raise ValueError(f"prompt ({prompt.shape[1]}) + max_new_tokens "
+                         f"({max_new_tokens}) = {need} exceeds the cache "
+                         f"({cache_len} positions)")
 
     logits, cache = prefill(params, cfg, prompt, max_len)
     done0 = jnp.zeros((prompt.shape[0],), bool)

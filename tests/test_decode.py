@@ -186,3 +186,19 @@ class TestEosToken:
                 assert (row[hits[0]:] == eos).all()
         # row 0 stopped at its first generated token by construction
         assert (gen_part[0] == eos).all()
+
+
+class TestCacheBounds:
+    def test_generation_past_cache_rejected(self, setup):
+        """dynamic_slice would silently clamp past the RoPE table and
+        corrupt rotary phases — must be a loud error instead."""
+        _, cfg, params = setup
+        prompt = _prompt(cfg, b=1, s=8)
+        with pytest.raises(ValueError, match="exceeds the cache"):
+            D.generate(params, cfg, prompt,
+                       max_new_tokens=cfg.max_seq_len)
+
+    def test_cache_larger_than_rope_table_rejected(self, setup):
+        _, cfg, params = setup
+        with pytest.raises(ValueError, match="RoPE table"):
+            D.init_cache(cfg, 1, max_len=cfg.max_seq_len + 1)
