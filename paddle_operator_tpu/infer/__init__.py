@@ -1,5 +1,7 @@
 from paddle_operator_tpu.infer.decode import (  # noqa: F401
+    decode_step,
     generate,
     init_cache,
+    make_decode_fn,
     prefill,
 )

@@ -202,6 +202,24 @@ def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
     return logits[:, 0], cache
 
 
+def make_decode_fn(cfg: LlamaConfig):
+    """Jitted single-token step with the cache DONATED: driving
+    decode_step yourself (serving loops, speculative drafts) without
+    donation would copy the whole KV cache every step — for a 7B-shaped
+    cache that is gigabytes of HBM traffic per token.  Inside
+    :func:`generate` the scan already keeps the cache on-device, so this
+    matters only for host-driven loops.
+
+    Returns ``step(params, token [B], cache) -> (logits [B, V], cache)``;
+    the passed cache buffer is consumed."""
+
+    def step(params, token, cache):
+        logits, cache = _forward(cfg, params, token[:, None], cache)
+        return logits[:, 0], cache
+
+    return jax.jit(step, donate_argnums=(2,))
+
+
 def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
              *, max_new_tokens: int, temperature: float = 0.0,
              key: Optional[jax.Array] = None,

@@ -202,3 +202,20 @@ class TestCacheBounds:
         _, cfg, params = setup
         with pytest.raises(ValueError, match="RoPE table"):
             D.init_cache(cfg, 1, max_len=cfg.max_seq_len + 1)
+
+
+class TestMakeDecodeFn:
+    def test_donated_step_matches_plain_step(self, setup):
+        _, cfg, params = setup
+        toks = _prompt(cfg, b=2, s=6, seed=21)
+        _, cache_a = D.prefill(params, cfg, toks)
+        _, cache_b = D.prefill(params, cfg, toks)
+        nxt = jnp.full((2,), 7, jnp.int32)
+        ref, _ = D.decode_step(params, cfg, nxt, cache_a)
+        step = D.make_decode_fn(cfg)
+        got, cache_b = step(params, nxt, cache_b)   # cache_b donated
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # the returned cache keeps working
+        got2, _ = step(params, nxt, cache_b)
+        assert np.isfinite(np.asarray(got2)).all()
