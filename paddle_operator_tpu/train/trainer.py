@@ -519,16 +519,22 @@ def fit(state: TrainState, step_fn: Callable, batches,
         checkpoint=None,
         timer=None,
         logger=None,
-        log_every: int = 0) -> Tuple[TrainState, List[Dict[str, float]]]:
+        log_every: int = 0,
+        eval_fn: Optional[Callable] = None,
+        eval_every: int = 0) -> Tuple[TrainState, List[Dict[str, float]]]:
     """The reusable training loop: drive `step_fn` over `batches` (any
     iterator of device-ready batch dicts — typically a
     :class:`train.data.DevicePrefetcher`), saving through a
     :class:`train.checkpoint.CheckpointManager` and ticking a
     :class:`utils.observability.StepTimer`.
 
+    ``eval_fn(state) -> metrics_dict`` runs every ``eval_every`` steps
+    (e.g. a :func:`make_eval_step` closure over a held-out batch); its
+    float metrics land in that step's history entry under ``eval_*`` keys.
+
     Replaces the per-model ad-hoc loops; every BASELINE family (LLaMA,
-    ERNIE, Wide&Deep) trains through this one function.  Returns the final
-    state and the per-step float metrics history.
+    ERNIE, Wide&Deep, ResNet) trains through this one function.  Returns
+    the final state and the per-step float metrics history.
     """
     raw_history: List[Dict[str, Any]] = []
     # One sync up front; per-step host conversion would block on every
@@ -543,8 +549,12 @@ def fit(state: TrainState, step_fn: Callable, batches,
         state, metrics = step_fn(state, batch)
         if timer is not None:
             timer.tick()
-        raw_history.append(metrics)   # device scalars: no host sync
         step_no = start_step + i + 1
+        if eval_fn is not None and eval_every and step_no % eval_every == 0:
+            metrics = dict(metrics)
+            metrics.update({f"eval_{k}": v
+                            for k, v in eval_fn(state).items()})
+        raw_history.append(metrics)   # device scalars: no host sync
         if checkpoint is not None and checkpoint.enabled:
             checkpoint.save(step_no, state)
         if logger is not None and log_every and (i + 1) % log_every == 0:

@@ -198,3 +198,32 @@ class TestResNetTrainStep:
         state, history = T.fit(state, step, batches, steps=4)
         assert len(history) == 4
         assert all(np.isfinite(h["loss"]) for h in history)
+
+
+class TestFitEvalHook:
+    def test_eval_metrics_land_in_history(self):
+        from paddle_operator_tpu.models import llama as L
+
+        mesh = make_mesh(MeshSpec(dp=8))
+        model, cfg = L.make_model("tiny")
+        opt = T.make_optimizer(1e-3, warmup_steps=1, decay_steps=20)
+        pats = L.partition_patterns(cfg)
+        ex = (jnp.zeros((BATCH, SEQ), jnp.int32),)
+        sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
+        state = T.create_state(model, opt, mesh, pats, ex)
+        step = T.make_train_step(model, opt, mesh, sh)
+        eval_step = T.make_eval_step(model, mesh)
+        held_out = T.synthetic_batch(BATCH, SEQ + 1, cfg.vocab_size,
+                                     seed=99)
+
+        def eval_fn(st):
+            return eval_step(st.params, held_out)
+
+        batches = (T.synthetic_batch(BATCH, SEQ + 1, cfg.vocab_size,
+                                     seed=i) for i in range(6))
+        state, history = T.fit(state, step, batches, steps=6,
+                               eval_fn=eval_fn, eval_every=3)
+        assert len(history) == 6
+        assert "eval_loss" in history[2] and "eval_loss" in history[5]
+        assert "eval_loss" not in history[0]
+        assert np.isfinite(history[2]["eval_loss"])
