@@ -126,3 +126,43 @@ class TestWatchManager:
                   ["data"]["TPUJOB_NUM_WORKERS"] == "1")
         finally:
             mgr.stop()
+
+
+class TestManyJobs:
+    def test_fleet_of_jobs_all_converge(self):
+        """The reference's envtest only ever reconciles one job; the
+        watch-driven loop must converge a whole fleet — every job reaches
+        Running with its own rendezvous ConfigMap, no cross-job bleed."""
+        api = FakeAPI()
+        fleet = FakeFleet(api)
+        mgr = Manager(api, sync_period=60.0)   # watch path, poll off
+        t = threading.Thread(target=mgr.run, daemon=True)
+        t.start()
+        try:
+            n = 25
+            for i in range(n):
+                api.create("TPUJob", _job(f"fleet-{i}", workers=2).to_dict())
+            _wait(lambda: sum(1 for k in api.store if k[0] == "Pod")
+                  == 2 * n, timeout=30)
+            fleet.run_all()
+            _wait(lambda: sum(1 for k in api.store
+                              if k[0] == "ConfigMap") == n, timeout=30)
+
+            def all_running():
+                for i in range(n):
+                    job = api.store.get(("TPUJob", "default", f"fleet-{i}"))
+                    if not job or job.get("status", {}).get("phase") != \
+                            "Running":
+                        return False
+                return True
+            _wait(all_running, timeout=30)
+            seen = set()
+            for i in range(n):
+                cm = api.get("ConfigMap", "default", f"fleet-{i}")
+                addr = cm["data"]["TPUJOB_COORDINATOR_ADDRESS"]
+                pod = api.get("Pod", "default", f"fleet-{i}-worker-0")
+                assert addr.split(":")[0] == pod["status"]["podIP"]
+                assert addr not in seen    # no cross-job bleed
+                seen.add(addr)
+        finally:
+            mgr.stop()
