@@ -41,7 +41,7 @@ primitive.  Design:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -195,7 +195,9 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
                         trunk_params: Any, head_params: Any,
                         xm: jax.Array, targets_m: jax.Array,
                         mask_m: jax.Array, seed: jax.Array,
+                        aux_seed: Optional[jax.Array] = None,
                         *, axis_name: str = "pp",
+                        has_aux: bool = False,
                         compute_dtype: Any = None):
     """Fused 1F1B forward+backward inside shard_map (manual over ``pp``).
 
@@ -209,20 +211,27 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
     backward recomputes the stage forward under ``jax.vjp`` — peak live
     activations O(P) instead of GPipe's O(M).
 
-    stage_fn(trunk_params, h) -> h'   (this stage's layer block)
+    stage_fn(trunk_params, h) -> h' (this stage's layer block), or with
+    ``has_aux`` -> (h', aux_scalar) (e.g. the MoE load-balancing loss of
+    the stage's layers, routed per microbatch).  The aux gradient enters
+    as a CONSTANT cotangent on the stage vjp: ``aux_seed`` must equal
+    d(total_loss)/d(one stage-microbatch aux unit) — for the trainer's
+    ``total += weight * psum(aux)/M`` that is ``weight / M``.
+
     head_loss_fn(head_params, h, targets, mask) -> scalar SUM-loss (the
     caller seeds the gradient with ``seed`` = 1/denom to get mean-loss
     gradients; in SPMD every stage computes it, the last stage's value is
     the one kept).
 
-    Returns (sum_loss, d_trunk, d_head, d_xm): sum_loss/d_head/d_xm are
-    psum-replicated over pp, d_trunk stays this stage's local shard.
+    Returns (sum_loss, d_trunk, d_head, d_xm[, aux_mean]):
+    sum_loss/d_head/d_xm/aux are psum-replicated over pp, d_trunk stays
+    this stage's local shard; aux_mean is the per-layer aux summed over
+    stages and averaged over microbatches (unscaled).
 
     Trade-offs vs GPipe (documented, deliberate): the drain adds P-1 extra
     rounds (R = M + 2P - 2 vs M + P - 1 per direction), and the loss head
     runs masked on every stage (SPMD) — at LLaMA widths the stage block
     dominates, and tp-sharding the head shrinks it like any other matmul.
-    MoE aux-loss routing is not supported here; use the GPipe schedule.
     """
     if compute_dtype is not None:
         xm = xm.astype(compute_dtype)
@@ -239,7 +248,8 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
     zero_act = jnp.zeros_like(xm[0])
 
     def round_fn(carry, r):
-        act_in, cot_in, stash, d_trunk, d_head, d_xm, loss_sum = carry
+        (act_in, cot_in, stash, d_trunk, d_head, d_xm, loss_sum,
+         aux_sum) = carry
 
         # ---- forward slot: microbatch f = r - stage -----------------
         f = r - stage
@@ -256,7 +266,12 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
                       jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
                                                    keepdims=False)),
             slot_f, 0)
-        out = stage_fn(trunk_params, my_in)
+        if has_aux:
+            out, aux_f = stage_fn(trunk_params, my_in)
+            aux_sum = aux_sum + jnp.where(fwd_live,
+                                          aux_f.astype(jnp.float32), 0.0)
+        else:
+            out = stage_fn(trunk_params, my_in)
 
         # last stage: head + loss + output cotangent for the SAME
         # microbatch (1F1B: bwd f starts the round it was forwarded)
@@ -277,8 +292,15 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
         saved = jax.lax.dynamic_index_in_dim(stash, bc % k, 0,
                                              keepdims=False)
         cot = jnp.where(is_last, d_out_f.astype(out.dtype), cot_in)
-        _, stage_vjp = jax.vjp(stage_fn, trunk_params, saved)
-        d_trunk_b, d_in_b = stage_vjp(cot)
+        if has_aux:
+            # aux gradient: constant seed (dead slots masked via
+            # _masked_add below, like the activation path)
+            (_, aux_b), stage_vjp = jax.vjp(stage_fn, trunk_params, saved)
+            d_trunk_b, d_in_b = stage_vjp(
+                (cot, jnp.asarray(aux_seed, aux_b.dtype)))
+        else:
+            _, stage_vjp = jax.vjp(stage_fn, trunk_params, saved)
+            d_trunk_b, d_in_b = stage_vjp(cot)
         d_trunk = _masked_add(d_trunk, d_trunk_b, bwd_live)
         d_in_b = jnp.where(bwd_live, d_in_b, jnp.zeros_like(d_in_b))
         d_xm = jax.lax.dynamic_update_index_in_dim(
@@ -293,7 +315,7 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
             jnp.where(fwd_live, out, zero_act), axis_name, perm_fwd)
         cot_next = jax.lax.ppermute(d_in_b, axis_name, perm_bwd)
         return (act_next, cot_next, stash, d_trunk, d_head, d_xm,
-                loss_sum), None
+                loss_sum, aux_sum), None
 
     init = (
         zero_act,                                     # act_in
@@ -303,35 +325,43 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
         jax.tree.map(jnp.zeros_like, head_params),    # d_head
         jnp.zeros_like(xm),                           # d_xm
         jnp.zeros((), jnp.float32),                   # loss_sum
+        jnp.zeros((), jnp.float32),                   # aux_sum
     )
-    (_, _, _, d_trunk, d_head, d_xm, loss_sum), _ = jax.lax.scan(
+    (_, _, _, d_trunk, d_head, d_xm, loss_sum, aux_sum), _ = jax.lax.scan(
         round_fn, init, jnp.arange(rounds))
 
     # replicate the single-stage-owned results over pp (one-hot psums)
     loss_out = jax.lax.psum(loss_sum, axis_name)
     d_head_out = jax.tree.map(lambda g: _psum_act(g, axis_name), d_head)
     d_xm_out = _psum_act(d_xm, axis_name)
-    return loss_out, d_trunk, d_head_out, d_xm_out
+    if not has_aux:
+        return loss_out, d_trunk, d_head_out, d_xm_out
+    aux_out = jax.lax.psum(aux_sum, axis_name) / m
+    return loss_out, d_trunk, d_head_out, d_xm_out, aux_out
 
 
 def make_pipeline_1f1b_fn(mesh: Mesh, stage_fn: Callable,
                           head_loss_fn: Callable,
-                          *, axis_name: str = "pp"):
+                          *, axis_name: str = "pp",
+                          has_aux: bool = False):
     """Partial-manual shard_map wrapper for :func:`pipeline_1f1b_grads`
     (same composition story as :func:`make_pipeline_fn`: only ``pp`` is
     manual; dp/fsdp/tp/cp stay auto under GSPMD)."""
     from jax import shard_map
 
-    in_specs = (P(axis_name), P(), P(), P(), P(), P())
-    out_specs = (P(), P(axis_name), P(), P())
+    in_specs = (P(axis_name), P(), P(), P(), P(), P(), P())
+    out_specs = ((P(), P(axis_name), P(), P(), P()) if has_aux
+                 else (P(), P(axis_name), P(), P()))
 
-    def call(trunk_params, head_params, xm, targets_m, mask_m, seed):
+    def call(trunk_params, head_params, xm, targets_m, mask_m, seed,
+             aux_seed=0.0):
         compute_dtype = None
         if xm.dtype == jnp.bfloat16:   # boundary dance, see make_pipeline_fn
             compute_dtype, xm = xm.dtype, xm.astype(jnp.float32)
         fn = shard_map(
             functools.partial(pipeline_1f1b_grads, stage_fn, head_loss_fn,
                               axis_name=axis_name,
+                              has_aux=has_aux,
                               compute_dtype=compute_dtype),
             mesh=mesh,
             in_specs=in_specs,
@@ -339,6 +369,7 @@ def make_pipeline_1f1b_fn(mesh: Mesh, stage_fn: Callable,
             axis_names=frozenset({axis_name}),
             check_vma=False,
         )
-        return fn(trunk_params, head_params, xm, targets_m, mask_m, seed)
+        return fn(trunk_params, head_params, xm, targets_m, mask_m, seed,
+                  jnp.asarray(aux_seed, jnp.float32))
 
     return call

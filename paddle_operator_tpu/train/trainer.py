@@ -219,7 +219,8 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
     pipeline_1f1b_grads) — stashes only the ≤ min(M, 2P-1) in-flight stage
     inputs and recomputes each stage forward at backward time, so peak
     activation memory is O(P) instead of O(M).  Gradients match GPipe
-    (same math, verified in tests/test_pp_train.py).  MoE requires gpipe.
+    (same math, including per-microbatch MoE routing + aux loss,
+    verified in tests/test_pp_train.py).
 
     Split of labour (SURVEY.md §2 promised TP/PP as first-class — the
     reference's only hybrid hook is a rank id,
@@ -271,8 +272,6 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
     moe = getattr(cfg, "n_experts", 0) > 0
-    if moe and schedule == "1f1b":
-        raise ValueError("MoE aux-loss routing needs schedule='gpipe'")
 
     stack = LayerStack(cfg, cfg.n_layers // pp, mesh)
 
@@ -290,7 +289,6 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
     head_mod = lm_head_module(cfg)
 
     if schedule == "1f1b":
-        # moe is False here, so stage_fn returns a bare activation
         def head_loss(head_params, h, tgt, msk):
             # SUM-loss per microbatch: the 1F1B machinery seeds its vjp
             # with 1/denom, so gradients match the mean cross_entropy_loss.
@@ -308,7 +306,8 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
             ll = jnp.where(vocab_iota == tgt[..., None], logp, 0.0).sum(-1)
             return -(ll * msk.astype(jnp.float32)).sum()
 
-        fused = PP.make_pipeline_1f1b_fn(mesh, stage_fn, head_loss)
+        fused = PP.make_pipeline_1f1b_fn(mesh, stage_fn, head_loss,
+                                         has_aux=moe)
 
         def compute_grads(params, batch):
             tokens = batch["tokens"]
@@ -325,13 +324,23 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
             mm = PP.microbatch(msk, num_microbatches)
             head_params = {"final_norm": params["final_norm"],
                            "lm_head": params["lm_head"]}
-            loss_sum, d_trunk, d_head, d_xm = fused(
-                params["layers"], head_params, xm, tm, mm, 1.0 / denom)
+            if moe:
+                # aux enters the optimized total as weight * mean(aux):
+                # d/d(one stage-microbatch aux unit) = weight / M
+                loss_sum, d_trunk, d_head, d_xm, aux_raw = fused(
+                    params["layers"], head_params, xm, tm, mm, 1.0 / denom,
+                    cfg.moe_aux_weight / num_microbatches)
+            else:
+                loss_sum, d_trunk, d_head, d_xm = fused(
+                    params["layers"], head_params, xm, tm, mm, 1.0 / denom)
             (d_embed,) = embed_vjp(d_xm.reshape(x.shape).astype(x.dtype))
             grads = {"tok_embed": d_embed, "layers": d_trunk,
                      "final_norm": d_head["final_norm"],
                      "lm_head": d_head["lm_head"]}
-            return {"loss": loss_sum / denom, "tokens": denom}, grads
+            metrics = {"loss": loss_sum / denom, "tokens": denom}
+            if moe:
+                metrics["aux_loss"] = aux_raw * cfg.moe_aux_weight
+            return metrics, grads
 
         return make_grads_train_step(compute_grads, optimizer, mesh,
                                      state_sharding)

@@ -130,12 +130,35 @@ class TestPipelineLlama:
                  schedule="1f1b")
         np.testing.assert_allclose(f, ref, rtol=1e-4, atol=1e-4)
 
-    def test_1f1b_rejects_moe(self):
-        mesh = make_mesh(MeshSpec(pp=2, dp=2, ep=2))
-        _, cfg = make_model("tiny-moe")
-        with pytest.raises(ValueError, match="gpipe"):
-            T.make_pp_train_step(cfg, T.make_optimizer(), mesh, None,
-                                 num_microbatches=4, schedule="1f1b")
+    def test_1f1b_moe_matches_gpipe_moe(self):
+        """MoE under 1F1B routes per microbatch exactly like GPipe-MoE
+        (same capacity math, aux entering via the constant cotangent
+        seed) — the loss AND aux trajectories must coincide."""
+        def run(schedule):
+            mesh = make_mesh(MeshSpec(pp=2, dp=2, ep=2))
+            model, cfg = make_model("tiny-moe", dtype=jnp.float32,
+                                    mesh=mesh)
+            opt = T.make_optimizer(1e-3, warmup_steps=2, decay_steps=10)
+            pats = partition_patterns(cfg)
+            example = (jnp.zeros((BATCH, SEQ), jnp.int32),)
+            sh, _ = T.state_shardings(model, opt, mesh, pats, example)
+            state = T.create_state(model, opt, mesh, pats, example)
+            step = T.make_step_for_mesh(model, cfg, opt, mesh, sh,
+                                        num_microbatches=4,
+                                        schedule=schedule)
+            loss, aux = [], []
+            for i in range(3):
+                batch = T.synthetic_batch(BATCH, SEQ + 1, cfg.vocab_size,
+                                          seed=i)
+                state, m = step(state, batch)
+                loss.append(float(m["loss"]))
+                aux.append(float(m["aux_loss"]))
+            return loss, aux
+
+        g_loss, g_aux = run("gpipe")
+        f_loss, f_aux = run("1f1b")
+        np.testing.assert_allclose(f_loss, g_loss, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(f_aux, g_aux, rtol=1e-5, atol=1e-6)
 
     def test_masked_batches_match_gspmd_both_schedules(self):
         """Padding masks flow differently through the two pipeline
