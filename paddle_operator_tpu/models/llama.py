@@ -49,6 +49,11 @@ class LlamaConfig:
     # "full" (recompute everything — fastest measured on v5e),
     # "save_attn" (keep flash-attention outputs), "dots" (save matmul outs)
     remat_policy: str = "full"
+    # context-parallel attention when cp > 1: "ring" (K/V rotation,
+    # parallel/ring_attention.py) or "ulysses" (head/seq all-to-all,
+    # parallel/ulysses.py — needs n_heads and n_kv_heads divisible by cp;
+    # falls back to ring otherwise)
+    cp_impl: str = "ring"
     # Mixture-of-Experts: n_experts > 0 replaces every layer's SwiGLU MLP
     # with a Switch-style top-1 MoE (models/moe.py), expert-sharded over the
     # `ep` mesh axis.  The model then returns (logits, aux_loss) where
@@ -191,11 +196,21 @@ class Attention(nn.Module):
             cp = dict(zip(self.mesh.axis_names,
                           self.mesh.devices.shape)).get("cp", 1)
         if cp > 1:
-            from paddle_operator_tpu.parallel.ring_attention import (
-                make_ring_attention_fn,
-            )
+            if (cfg.cp_impl == "ulysses" and cfg.n_heads % cp == 0
+                    and cfg.n_kv_heads % cp == 0):
+                from paddle_operator_tpu.parallel.ulysses import (
+                    make_ulysses_attention_fn,
+                )
 
-            out = make_ring_attention_fn(self.mesh, causal=True)(q, k, v)
+                out = make_ulysses_attention_fn(
+                    self.mesh, causal=True)(q, k, v)
+            else:
+                from paddle_operator_tpu.parallel.ring_attention import (
+                    make_ring_attention_fn,
+                )
+
+                out = make_ring_attention_fn(
+                    self.mesh, causal=True)(q, k, v)
         else:
             out = attention(q, k, v, causal=True, segment_ids=segment_ids)
         # Tag for remat_policy="save_attn": under that policy the flash
