@@ -196,6 +196,15 @@ class Attention(nn.Module):
             cp = dict(zip(self.mesh.axis_names,
                           self.mesh.devices.shape)).get("cp", 1)
         if cp > 1:
+            if cfg.cp_impl not in ("ring", "ulysses"):
+                raise ValueError(f"unknown cp_impl {cfg.cp_impl!r} "
+                                 "(expected 'ring' or 'ulysses')")
+            if segment_ids is not None:
+                # neither cp implementation plumbs packed-sequence masks
+                raise NotImplementedError(
+                    "segment_ids with cp > 1 is not supported — the "
+                    "context-parallel attention paths would silently "
+                    "attend across document boundaries")
             if (cfg.cp_impl == "ulysses" and cfg.n_heads % cp == 0
                     and cfg.n_kv_heads % cp == 0):
                 from paddle_operator_tpu.parallel.ulysses import (

@@ -77,3 +77,16 @@ def single_device_mesh() -> Mesh:
     """A 1-chip mesh (all axes size 1) — lets the same pjit train step run
     unmodified on one device."""
     return make_mesh(MeshSpec(), devices=jax.devices()[:1])
+
+
+def resolve_shard_map_mesh(mesh: Mesh):
+    """Mesh argument for a (possibly nested) partial-manual shard_map:
+    when tracing already happens inside another manual region, the
+    context's abstract mesh must be inherited (pass None) instead of the
+    concrete mesh.  Shared by the ring and Ulysses attention wrappers —
+    the idiom is subtle enough that two copies would drift.  Returns
+    ``(mesh_or_None, axis_sizes_dict)``."""
+    ctx = jax.sharding.get_abstract_mesh()
+    if ctx is not None and not ctx.empty:
+        return None, dict(ctx.shape)
+    return mesh, dict(mesh.shape)

@@ -119,11 +119,10 @@ def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
     """
     from jax import shard_map
 
-    seq_spec = P(None, axis_name)
+    from paddle_operator_tpu.parallel.mesh import resolve_shard_map_mesh
 
-    ctx = jax.sharding.get_abstract_mesh()
-    use_mesh = None if (ctx is not None and not ctx.empty) else mesh
-    sizes = ctx.shape if use_mesh is None else dict(mesh.shape)
+    seq_spec = P(None, axis_name)
+    use_mesh, sizes = resolve_shard_map_mesh(mesh)
     size = sizes.get(axis_name, 1)
 
     fn = shard_map(

@@ -60,10 +60,10 @@ def make_ulysses_attention_fn(mesh: Mesh, *, causal: bool = True,
     regions (the pp pipeline body)."""
     from jax import shard_map
 
-    seq_spec = P(None, axis_name)
+    from paddle_operator_tpu.parallel.mesh import resolve_shard_map_mesh
 
-    ctx = jax.sharding.get_abstract_mesh()
-    use_mesh = None if (ctx is not None and not ctx.empty) else mesh
+    seq_spec = P(None, axis_name)
+    use_mesh, _ = resolve_shard_map_mesh(mesh)
 
     return shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name,

@@ -80,11 +80,9 @@ class TestModel:
 
 class TestShardedTraining:
     def run_steps(self, mesh_spec, n_steps=3, batch=8, **model_kw):
-        model, cfg = L.make_model("tiny", **model_kw)
         mesh = make_mesh(mesh_spec) if mesh_spec else single_device_mesh()
-        if model_kw.get("cp_impl") or (mesh_spec and
-                                       getattr(mesh_spec, "cp", 1) > 1):
-            model, cfg = L.make_model("tiny", mesh=mesh, **model_kw)
+        # mesh is inert for attention unless the cp axis > 1
+        model, cfg = L.make_model("tiny", mesh=mesh, **model_kw)
         opt = T.make_optimizer(1e-3, warmup_steps=1, decay_steps=100)
         pats = L.partition_patterns(cfg)
         # short init example: param shapes are seq-independent, and a
