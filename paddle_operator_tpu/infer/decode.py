@@ -189,6 +189,10 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Process the whole prompt [B, S] in one pass.  Returns
     ([B, vocab] last-position logits, filled cache)."""
+    cache_len = max_len or cfg.max_seq_len
+    if tokens.shape[1] > cache_len:
+        raise ValueError(f"prompt length {tokens.shape[1]} exceeds the "
+                         f"cache ({cache_len} positions)")
     cache = init_cache(cfg, tokens.shape[0], max_len)
     logits, cache = _forward(cfg, params, tokens, cache)
     return logits[:, -1], cache
