@@ -199,12 +199,6 @@ class Attention(nn.Module):
             if cfg.cp_impl not in ("ring", "ulysses"):
                 raise ValueError(f"unknown cp_impl {cfg.cp_impl!r} "
                                  "(expected 'ring' or 'ulysses')")
-            if segment_ids is not None:
-                # neither cp implementation plumbs packed-sequence masks
-                raise NotImplementedError(
-                    "segment_ids with cp > 1 is not supported — the "
-                    "context-parallel attention paths would silently "
-                    "attend across document boundaries")
             if (cfg.cp_impl == "ulysses" and cfg.n_heads % cp == 0
                     and cfg.n_kv_heads % cp == 0):
                 from paddle_operator_tpu.parallel.ulysses import (
@@ -212,14 +206,14 @@ class Attention(nn.Module):
                 )
 
                 out = make_ulysses_attention_fn(
-                    self.mesh, causal=True)(q, k, v)
+                    self.mesh, causal=True)(q, k, v, segment_ids)
             else:
                 from paddle_operator_tpu.parallel.ring_attention import (
                     make_ring_attention_fn,
                 )
 
                 out = make_ring_attention_fn(
-                    self.mesh, causal=True)(q, k, v)
+                    self.mesh, causal=True)(q, k, v, segment_ids)
         else:
             out = attention(q, k, v, causal=True, segment_ids=segment_ids)
         # Tag for remat_policy="save_attn": under that policy the flash

@@ -40,6 +40,7 @@ def _chunk_scores(q, k, *, scale):
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    ring_pos: Optional[jax.Array] = None,
+                   segment_ids: Optional[jax.Array] = None,
                    *, axis_name: str = "cp",
                    causal: bool = True) -> jax.Array:
     """Per-device body: local [B, S_loc, H, D] shards, full attention over
@@ -51,7 +52,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     ``jax.lax.axis_index``; passing it as data instead keeps the body legal
     in a *nested* manual region (axis_index's lowering re-binds every mesh
     axis, which MLIR rejects inside a parent manual computation — the pp
-    pipeline body)."""
+    pipeline body).
+
+    segment_ids: optional [B, S_loc] int32 — packed-sequence ids; the
+    local chunk rotates around the ring with K/V so every score tile can
+    mask cross-document attention."""
     my = (jax.lax.axis_index(axis_name) if ring_pos is None
           else ring_pos[0])
     n = jax.lax.psum(1, axis_name)
@@ -59,6 +64,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, s_loc, h, d = q.shape
     hkv = k.shape[2]
     n_rep = h // hkv
+    has_seg = segment_ids is not None
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -68,7 +74,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
 
     def body(carry, step):
-        m, l, acc, k_cur, v_cur = carry
+        if has_seg:
+            m, l, acc, k_cur, v_cur, seg_cur = carry
+        else:
+            m, l, acc, k_cur, v_cur = carry
         src = (my - step) % n          # which chunk k_cur/v_cur came from
 
         s = _chunk_scores(q, k_cur, scale=scale)      # [B, H, Sq, Sk]
@@ -79,6 +88,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             # full when src < my; diagonal-causal when src == my; none after
             keep = jnp.where(src == my, diag_mask, src < my)
             s = jnp.where(keep[None, None], s, NEG_INF)
+        if has_seg:
+            seg_keep = (segment_ids[:, :, None]
+                        == seg_cur[:, None, :])       # [B, Sq, Sk]
+            s = jnp.where(seg_keep[:, None], s, NEG_INF)
 
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
@@ -89,14 +102,19 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         preferred_element_type=jnp.float32)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + pv
-        # rotate K/V to the next device (skip after the final use)
+        # rotate K/V (and segments) to the next device
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (m_new, l_new, acc_new, k_nxt, v_nxt), None
+        out = (m_new, l_new, acc_new, k_nxt, v_nxt)
+        if has_seg:
+            out = out + (jax.lax.ppermute(seg_cur, axis_name, perm),)
+        return out, None
 
-    (m, l, acc, _, _), _ = jax.lax.scan(
-        body, (m0, l0, acc0, k, v), jnp.arange(n)
-    )
+    init = (m0, l0, acc0, k, v)
+    if has_seg:
+        init = init + (segment_ids,)
+    carry, _ = jax.lax.scan(body, init, jnp.arange(n))
+    _, l, acc = carry[0], carry[1], carry[2]
     l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l).astype(q.dtype)                   # [B, H, Sq, D]
     return out.transpose(0, 2, 1, 3)                  # [B, Sq, H, D]
@@ -125,18 +143,26 @@ def make_ring_attention_fn(mesh: Mesh, *, causal: bool = True,
     use_mesh, sizes = resolve_shard_map_mesh(mesh)
     size = sizes.get(axis_name, 1)
 
+    common = dict(mesh=use_mesh, out_specs=seq_spec,
+                  axis_names=frozenset({axis_name}), check_vma=False)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name,
                           causal=causal),
-        mesh=use_mesh,
         in_specs=(seq_spec, seq_spec, seq_spec, P(axis_name)),
-        out_specs=seq_spec,
-        axis_names=frozenset({axis_name}),
-        check_vma=False,
+        **common,
+    )
+    fn_seg = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal),
+        in_specs=(seq_spec, seq_spec, seq_spec, P(axis_name), seq_spec),
+        **common,
     )
 
-    def call(q, k, v):
+    def call(q, k, v, segment_ids=None):
         # ring position as data (see ring_attention docstring)
-        return fn(q, k, v, jnp.arange(size, dtype=jnp.int32))
+        pos = jnp.arange(size, dtype=jnp.int32)
+        if segment_ids is None:
+            return fn(q, k, v, pos)
+        return fn_seg(q, k, v, pos, segment_ids)
 
     return call

@@ -31,13 +31,17 @@ from paddle_operator_tpu.ops.attention import attention
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      segment_ids: Optional[jax.Array] = None,
                       *, axis_name: str = "cp",
                       causal: bool = True) -> jax.Array:
     """Per-device body: local [B, S_loc, H, D] shards in, same shape out.
-    Must run inside shard_map with `axis_name` bound."""
+    Must run inside shard_map with `axis_name` bound.  segment_ids
+    [B, S_loc] (packed sequences) are all-gathered to the full sequence —
+    every device attends full-length for its head subset, so the mask is
+    applied by ordinary attention."""
     n = jax.lax.psum(1, axis_name)
     if n == 1:
-        return attention(q, k, v, causal=causal)
+        return attention(q, k, v, causal=causal, segment_ids=segment_ids)
     # seq-sharded -> head-sharded: split heads (axis 2), gather seq (axis 1)
     qh = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
                             tiled=True)
@@ -45,7 +49,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                             tiled=True)
     vh = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
                             tiled=True)
-    out = attention(qh, kh, vh, causal=causal)   # full-seq, H/cp heads
+    seg_full = None
+    if segment_ids is not None:
+        seg_full = jax.lax.all_gather(segment_ids, axis_name, axis=1,
+                                      tiled=True)
+    out = attention(qh, kh, vh, causal=causal,
+                    segment_ids=seg_full)        # full-seq, H/cp heads
     # head-sharded -> seq-sharded: split seq, gather heads
     return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
@@ -65,12 +74,24 @@ def make_ulysses_attention_fn(mesh: Mesh, *, causal: bool = True,
     seq_spec = P(None, axis_name)
     use_mesh, _ = resolve_shard_map_mesh(mesh)
 
-    return shard_map(
+    common = dict(mesh=use_mesh, out_specs=seq_spec,
+                  axis_names=frozenset({axis_name}), check_vma=False)
+    fn = shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal),
-        mesh=use_mesh,
         in_specs=(seq_spec, seq_spec, seq_spec),
-        out_specs=seq_spec,
-        axis_names=frozenset({axis_name}),
-        check_vma=False,
+        **common,
     )
+    fn_seg = shard_map(
+        functools.partial(ulysses_attention, axis_name=axis_name,
+                          causal=causal),
+        in_specs=(seq_spec, seq_spec, seq_spec, seq_spec),
+        **common,
+    )
+
+    def call(q, k, v, segment_ids=None):
+        if segment_ids is None:
+            return fn(q, k, v)
+        return fn_seg(q, k, v, segment_ids)
+
+    return call
