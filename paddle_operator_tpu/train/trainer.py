@@ -168,7 +168,10 @@ def _jit_train_step(forward_loss, optimizer: optax.GradientTransformation,
         mask = batch.get("mask")
         if mask is not None:
             mask = mask[:, 1:]
-        return forward_loss(params, inputs, targets, mask)
+        seg = batch.get("segment_ids")
+        if seg is not None:
+            seg = seg[:, :-1]
+        return forward_loss(params, inputs, targets, mask, seg)
 
     return make_custom_train_step(batch_loss, optimizer, mesh, state_sharding)
 
@@ -179,13 +182,15 @@ def make_train_step(model: nn.Module,
                     state_sharding=None) -> Callable:
     """Build the jitted train step.
 
-    batch: {"tokens": int32 [B, S]} (optionally "mask" [B, S]).  Computes
-    next-token loss on tokens[:, 1:], updates params, returns (state,
-    metrics).  Donates the input state.
+    batch: {"tokens": int32 [B, S]} (optionally "mask" [B, S] and
+    "segment_ids" [B, S] for packed sequences — attention then masks
+    cross-document positions, on every cp strategy).  Computes next-token
+    loss on tokens[:, 1:], updates params, returns (state, metrics).
+    Donates the input state.
     """
 
-    def forward_loss(params, inputs, targets, mask):
-        out = model.apply({"params": params}, inputs)
+    def forward_loss(params, inputs, targets, mask, segment_ids=None):
+        out = model.apply({"params": params}, inputs, segment_ids)
         # MoE models return (logits, aux): aux is the load-balancing loss
         # already scaled by the model (models/llama.py Llama.__call__) —
         # it joins the optimized total but not the reported task loss.
@@ -349,7 +354,10 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
                                num_microbatches=num_microbatches,
                                has_aux=moe)
 
-    def forward_loss(params, inputs, targets, mask):
+    def forward_loss(params, inputs, targets, mask, segment_ids=None):
+        if segment_ids is not None:
+            raise ValueError("packed sequences (segment_ids) are not "
+                             "supported by the pipeline train step yet")
         x = embed_mod.apply({"params": params["tok_embed"]}, inputs)
         b = x.shape[0]
         xm = PP.microbatch(x, num_microbatches)
