@@ -37,9 +37,11 @@ DEFAULT_BLOCK_K = 512
 
 
 
-def _masked_scores(q, k, iq, ik, *, scale, causal, block_q, block_k):
-    """Block score tile [bq, bk] in f32 with the causal mask applied —
-    shared by the forward and both backward kernels."""
+def _masked_scores(q, k, iq, ik, *, scale, causal, block_q, block_k,
+                   seg_q=None, seg_k=None):
+    """Block score tile [bq, bk] in f32 with the causal (and optional
+    packed-sequence) mask applied — shared by the forward and both
+    backward kernels."""
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
@@ -49,6 +51,10 @@ def _masked_scores(q, k, iq, ik, *, scale, causal, block_q, block_k):
         cols = ik * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         s = jnp.where(rows >= cols, s, NEG_INF)
+    if seg_q is not None:
+        # seg tiles arrive [8, block] (sublane-padded layout, see _seg3d);
+        # row 0 carries the ids
+        s = jnp.where(seg_q[0][:, None] == seg_k[0][None, :], s, NEG_INF)
     return s
 
 
@@ -57,9 +63,12 @@ def _masked_scores(q, k, iq, ik, *, scale, causal, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, scale: float, causal: bool,
-                block_q: int, block_k: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
+                block_q: int, block_k: int, has_seg: bool = False):
+    if has_seg:
+        seg_q_ref, seg_k_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
 
@@ -80,7 +89,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[0, 0]                              # [bk, D]
         v = v_ref[0, 0]                              # [bk, D]
         s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k,
+                           seg_q=seg_q_ref[0] if has_seg else None,
+                           seg_k=seg_k_ref[0] if has_seg else None)
 
         m_prev = m_ref[:, :1]                        # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)   # [bq, 1]
@@ -116,17 +127,33 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                   m_ref[:, :1] + jnp.log(l))
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k, n_rep,
+def _fwd(q, k, v, seg=None, *, scale, causal, block_q, block_k, n_rep,
          interpret=False):
     b, h, sq, d = q.shape
     _, hk, sk, _ = k.shape
     nq, nk = sq // block_q, sk // block_k
     grid = (b, h, nq, nk)
+    has_seg = seg is not None
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, has_seg=has_seg,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
+    ]
+    args = [q, k, v]
+    if has_seg:
+        seg3 = _seg3d(seg)
+        in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, h, iq, ik: (b, 0, iq)),
+            pl.BlockSpec((1, 8, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+        ]
+        args += [seg3, seg3]
     out_shape = [
         jax.ShapeDtypeStruct(q.shape, q.dtype),
         jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
@@ -134,13 +161,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, n_rep,
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -152,7 +173,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, n_rep,
         ],
         out_shape=out_shape,
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
@@ -162,14 +183,26 @@ def _vmem(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
+def _seg3d(seg):
+    """[B, S] segment ids -> [B, 8, S]: Pallas TPU lowering needs the last
+    two block dims divisible by (8, 128), so the ids are broadcast over a
+    sublane dim (kernels read row 0).  ~8·S·4 bytes per row — noise."""
+    b, s = seg.shape
+    return jnp.broadcast_to(seg[:, None, :], (b, 8, s))
+
+
 # ---------------------------------------------------------------------------
 # Backward
 # ---------------------------------------------------------------------------
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc,
-                    *, scale, causal, block_q, block_k):
+                    *rest, scale, causal, block_q, block_k,
+                    has_seg: bool = False):
+    if has_seg:
+        seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     ik, iq = pl.program_id(2), pl.program_id(3)   # q innermost
     nq = pl.num_programs(3)
 
@@ -190,7 +223,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]                    # [bq, 1]
 
         s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k,
+                           seg_q=seg_q_ref[0] if has_seg else None,
+                           seg_k=seg_k_ref[0] if has_seg else None)
         p = jnp.exp(s - lse)                       # [bq, bk]
         # dv += p^T @ dO
         dv_acc[:] += jax.lax.dot_general(
@@ -212,8 +247,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc,
-                   *, scale, causal, block_q, block_k):
+                   *rest, scale, causal, block_q, block_k,
+                   has_seg: bool = False):
+    if has_seg:
+        seg_q_ref, seg_k_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
     iq, ik = pl.program_id(2), pl.program_id(3)   # k innermost
     nk = pl.num_programs(3)
 
@@ -233,7 +272,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, 0]
 
         s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k,
+                           seg_q=seg_q_ref[0] if has_seg else None,
+                           seg_k=seg_k_ref[0] if has_seg else None)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -253,6 +294,90 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # ---------------------------------------------------------------------------
 
 
+def _bwd_impl(q, k, v, seg, o, lse, do, *, causal, block_q, block_k,
+              n_rep, interpret):
+    b, h, sq, d = q.shape
+    _, hk, sk, _ = k.shape
+    scale = d ** -0.5
+    has_seg = seg is not None
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)        # [B, H, Sq, 1]
+
+    nq, nk = sq // block_q, sk // block_k
+    common = dict(scale=scale, causal=causal,
+                  block_q=block_q, block_k=block_k, has_seg=has_seg)
+
+    # GQA: walk query heads; kv blocks indexed h // n_rep.  dk/dv produced
+    # per query head then reduced over the repeat groups below.
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b, h, ik, iq, n_rep=n_rep: (b, h // n_rep, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b, h, ik, iq, n_rep=n_rep: (b, h // n_rep, ik, 0)),
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+    ]
+    seg3 = _seg3d(seg) if has_seg else None
+    dkv_args = [q, k, v, do, lse, delta]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, h, ik, iq: (b, 0, iq)),
+            pl.BlockSpec((1, 8, block_k), lambda b, h, ik, iq: (b, 0, ik)),
+        ]
+        dkv_args += [seg3, seg3]
+    dkv_shape = [
+        jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+        jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(b, h, nk, nq),
+        in_specs=dkv_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
+        ],
+        scratch_shapes=[_vmem((block_k, d)), _vmem((block_k, d))],
+        out_shape=dkv_shape,
+        interpret=interpret,
+    )(*dkv_args)
+
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
+        pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+        pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+    ]
+    dq_args = [q, k, v, do, lse, delta]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, 8, block_q), lambda b, h, iq, ik: (b, 0, iq)),
+            pl.BlockSpec((1, 8, block_k), lambda b, h, iq, ik: (b, 0, ik)),
+        ]
+        dq_args += [seg3, seg3]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(b, h, nq, nk),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b, h, iq, ik: (b, h, iq, 0)),
+        scratch_shapes=[_vmem((block_q, d))],
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+        interpret=interpret,
+    )(*dq_args)
+
+    if n_rep > 1:
+        dk = dk.reshape(b, hk, n_rep, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hk, n_rep, sk, d).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, block_q, block_k, n_rep, interpret):
     o, _ = _fwd(q, k, v, scale=q.shape[-1] ** -0.5, causal=causal,
@@ -270,71 +395,41 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, n_rep, interpret):
 
 def _flash_bwd(causal, block_q, block_k, n_rep, interpret, res, do):
     q, k, v, o, lse = res
-    b, h, sq, d = q.shape
-    _, hk, sk, _ = k.shape
-    scale = d ** -0.5
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)        # [B, H, Sq, 1]
-
-    nq, nk = sq // block_q, sk // block_k
-    common = dict(scale=scale, causal=causal,
-                  block_q=block_q, block_k=block_k)
-
-    # GQA: walk query heads; kv blocks indexed h // n_rep.  dk/dv produced
-    # per query head then reduced over the repeat groups below.
-    dkv_shape = [
-        jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
-        jax.ShapeDtypeStruct((b, h, sk, d), jnp.float32),
-    ]
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(b, h, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, ik, iq, n_rep=n_rep: (b, h // n_rep, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, ik, iq, n_rep=n_rep: (b, h // n_rep, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda b, h, ik, iq: (b, h, ik, 0)),
-        ],
-        scratch_shapes=[_vmem((block_k, d)), _vmem((block_k, d))],
-        out_shape=dkv_shape,
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
-        grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda b, h, iq, ik, n_rep=n_rep: (b, h // n_rep, ik, 0)),
-            pl.BlockSpec((1, 1, block_q, d), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda b, h, iq, ik: (b, h, iq, 0)),
-        scratch_shapes=[_vmem((block_q, d))],
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
-    if n_rep > 1:
-        dk = dk.reshape(b, hk, n_rep, sk, d).sum(axis=2)
-        dv = dv.reshape(b, hk, n_rep, sk, d).sum(axis=2)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _bwd_impl(q, k, v, None, o, lse, do, causal=causal,
+                     block_q=block_q, block_k=block_k, n_rep=n_rep,
+                     interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# Packed-sequence variant: segment_ids ride as a differentiable-position
+# arg (int arrays take a None cotangent) so the bwd kernels see them.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_seg(q, k, v, seg, causal, block_q, block_k, n_rep, interpret):
+    o, _ = _fwd(q, k, v, seg, scale=q.shape[-1] ** -0.5, causal=causal,
+                block_q=block_q, block_k=block_k, n_rep=n_rep,
+                interpret=interpret)
+    return o
+
+
+def _flash_seg_fwd(q, k, v, seg, causal, block_q, block_k, n_rep,
+                   interpret):
+    o, lse = _fwd(q, k, v, seg, scale=q.shape[-1] ** -0.5, causal=causal,
+                  block_q=block_q, block_k=block_k, n_rep=n_rep,
+                  interpret=interpret)
+    return o, (q, k, v, seg, o, lse)
+
+
+def _flash_seg_bwd(causal, block_q, block_k, n_rep, interpret, res, do):
+    q, k, v, seg, o, lse = res
+    dq, dk, dv = _bwd_impl(q, k, v, seg, o, lse, do, causal=causal,
+                           block_q=block_q, block_k=block_k, n_rep=n_rep,
+                           interpret=interpret)
+    return dq, dk, dv, None
+
+
+_flash_seg.defvjp(_flash_seg_fwd, _flash_seg_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -348,11 +443,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False) -> jax.Array:
-    """[B, S, H, D] flash attention.  Falls back (NotImplementedError) when
-    the shape doesn't tile or segment masking is requested — the dispatcher
-    in ops.attention catches it and uses the reference path."""
-    if segment_ids is not None:
-        raise NotImplementedError("segment_ids -> reference path")
+    """[B, S, H, D] flash attention, optionally with packed-sequence
+    ``segment_ids`` [B, S] (cross-document scores masked in-kernel).
+    Falls back (NotImplementedError) when the shape doesn't tile — the
+    dispatcher in ops.attention catches it and uses the reference path."""
     b, s, hq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, s)
@@ -360,10 +454,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if (s % block_q or sk % block_k or block_q % 128 or block_k % 128
             or d not in (64, 128, 256)):
         raise NotImplementedError("shape does not tile")
+    if segment_ids is not None and (segment_ids.shape != (b, s) or s != sk):
+        raise NotImplementedError("segment_ids shape -> reference path")
     n_rep = hq // k.shape[2]
 
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    ot = _flash(qt, kt, vt, causal, block_q, block_k, n_rep, interpret)
+    if segment_ids is not None:
+        ot = _flash_seg(qt, kt, vt, segment_ids.astype(jnp.int32),
+                        causal, block_q, block_k, n_rep, interpret)
+    else:
+        ot = _flash(qt, kt, vt, causal, block_q, block_k, n_rep, interpret)
     return ot.transpose(0, 2, 1, 3)
