@@ -321,9 +321,11 @@ class LayerStack(nn.Module):
     mesh: Optional[Any] = None
 
     @nn.compact
-    def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array):
+    def __call__(self, x: jax.Array, cos: jax.Array, sin: jax.Array,
+                 segment_ids: Optional[jax.Array] = None):
         Scan = _scanned(_layer_cls(self.cfg), self.n_layers)
-        x, aux = Scan(self.cfg, self.mesh, name="layers")(x, cos, sin, None)
+        x, aux = Scan(self.cfg, self.mesh, name="layers")(x, cos, sin,
+                                                          segment_ids)
         return x, (aux.sum() if aux is not None else None)
 
 

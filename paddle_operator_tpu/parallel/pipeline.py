@@ -63,6 +63,7 @@ def _psum_act(x: jax.Array, axis_name: str) -> jax.Array:
 def pipeline_apply(layer_fn: Callable,
                    stage_params: Any,
                    x: jax.Array,
+                   extras: Any = None,
                    *, axis_name: str = "pp",
                    num_microbatches: int,
                    has_aux: bool = False,
@@ -72,6 +73,12 @@ def pipeline_apply(layer_fn: Callable,
     layer_fn(stage_params, h) applies THIS stage's local layer block; when
     ``has_aux`` it returns ``(h, aux_scalar)`` (e.g. the MoE load-balancing
     loss of the stage's layers) instead of ``h`` alone.
+
+    extras: optional pytree of [M, ...] microbatched side inputs every
+    stage needs for ITS current microbatch (e.g. packed-sequence
+    segment_ids for attention masking).  A stage on tick t is processing
+    microbatch t - stage, so the tick indexes extras accordingly and
+    calls ``layer_fn(stage_params, h, extra_slice)``.
 
     x: [M, Bm, ...] microbatched input (every stage receives the same x;
     only stage 0 actually consumes it).  Returns the last stage's outputs
@@ -103,11 +110,18 @@ def pipeline_apply(layer_fn: Callable,
                                                        keepdims=False),
                           prev_out)
         live = (t - stage >= 0) & (t - stage < m)
+        args = (stage_params, my_in)
+        if extras is not None:
+            my_mb = jnp.clip(t - stage, 0, m - 1)   # this stage's microbatch
+            args = args + (jax.tree.map(
+                lambda e: jax.lax.dynamic_index_in_dim(e, my_mb, 0,
+                                                       keepdims=False),
+                extras),)
         if has_aux:
-            out, aux = layer_fn(stage_params, my_in)
+            out, aux = layer_fn(*args)
             aux_acc = aux_acc + jnp.where(live, aux.astype(jnp.float32), 0.0)
         else:
-            out = layer_fn(stage_params, my_in)
+            out = layer_fn(*args)
         out = jnp.where(live, out, zero)
         nxt = jax.lax.ppermute(out, axis_name, perm)
         return (nxt, aux_acc), out
@@ -134,7 +148,8 @@ def pipeline_apply(layer_fn: Callable,
 def make_pipeline_fn(mesh: Mesh, layer_fn: Callable,
                      *, num_microbatches: int,
                      axis_name: str = "pp",
-                     has_aux: bool = False):
+                     has_aux: bool = False,
+                     with_extras: bool = False):
     """Partial-manual shard_map wrapper: ONLY ``pp`` is manual; every other
     mesh axis stays auto (GSPMD).  Consequences:
 
@@ -148,10 +163,10 @@ def make_pipeline_fn(mesh: Mesh, layer_fn: Callable,
     """
     from jax import shard_map
 
-    in_specs = (P(axis_name), P())
+    in_specs = (P(axis_name), P()) + ((P(),) if with_extras else ())
     out_specs = (P(), P()) if has_aux else P()
 
-    def call(stage_params, x):
+    def call(stage_params, x, extras=None):
         # bf16 crosses the shard_map boundary as f32: shard_map transposes
         # a replicated input into a psum of its cotangent, and a bf16 psum
         # in a partial-manual region crashes XLA:CPU (see _psum_act).  The
@@ -171,6 +186,8 @@ def make_pipeline_fn(mesh: Mesh, layer_fn: Callable,
             axis_names=frozenset({axis_name}),
             check_vma=False,
         )
+        if with_extras:
+            return fn(stage_params, x, extras)
         return fn(stage_params, x)
 
     return call
@@ -196,6 +213,7 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
                         xm: jax.Array, targets_m: jax.Array,
                         mask_m: jax.Array, seed: jax.Array,
                         aux_seed: Optional[jax.Array] = None,
+                        extras: Any = None,
                         *, axis_name: str = "pp",
                         has_aux: bool = False,
                         compute_dtype: Any = None):
@@ -266,12 +284,21 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
                       jax.lax.dynamic_index_in_dim(stash, slot_f, 0,
                                                    keepdims=False)),
             slot_f, 0)
+        def extras_at(idx):
+            return jax.tree.map(
+                lambda e: jax.lax.dynamic_index_in_dim(e, idx, 0,
+                                                       keepdims=False),
+                extras)
+
+        fwd_args = (trunk_params, my_in)
+        if extras is not None:
+            fwd_args = fwd_args + (extras_at(fc),)
         if has_aux:
-            out, aux_f = stage_fn(trunk_params, my_in)
+            out, aux_f = stage_fn(*fwd_args)
             aux_sum = aux_sum + jnp.where(fwd_live,
                                           aux_f.astype(jnp.float32), 0.0)
         else:
-            out = stage_fn(trunk_params, my_in)
+            out = stage_fn(*fwd_args)
 
         # last stage: head + loss + output cotangent for the SAME
         # microbatch (1F1B: bwd f starts the round it was forwarded)
@@ -292,14 +319,21 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
         saved = jax.lax.dynamic_index_in_dim(stash, bc % k, 0,
                                              keepdims=False)
         cot = jnp.where(is_last, d_out_f.astype(out.dtype), cot_in)
+        if extras is not None:
+            # close over the saved microbatch's extras: jax.vjp then
+            # differentiates wrt (params, activation) only
+            ex_b = extras_at(bc)
+            bwd_fn = lambda p, h: stage_fn(p, h, ex_b)  # noqa: E731
+        else:
+            bwd_fn = stage_fn
         if has_aux:
             # aux gradient: constant seed (dead slots masked via
             # _masked_add below, like the activation path)
-            (_, aux_b), stage_vjp = jax.vjp(stage_fn, trunk_params, saved)
+            (_, aux_b), stage_vjp = jax.vjp(bwd_fn, trunk_params, saved)
             d_trunk_b, d_in_b = stage_vjp(
                 (cot, jnp.asarray(aux_seed, aux_b.dtype)))
         else:
-            _, stage_vjp = jax.vjp(stage_fn, trunk_params, saved)
+            _, stage_vjp = jax.vjp(bwd_fn, trunk_params, saved)
             d_trunk_b, d_in_b = stage_vjp(cot)
         d_trunk = _masked_add(d_trunk, d_trunk_b, bwd_live)
         d_in_b = jnp.where(bwd_live, d_in_b, jnp.zeros_like(d_in_b))
@@ -343,18 +377,20 @@ def pipeline_1f1b_grads(stage_fn: Callable, head_loss_fn: Callable,
 def make_pipeline_1f1b_fn(mesh: Mesh, stage_fn: Callable,
                           head_loss_fn: Callable,
                           *, axis_name: str = "pp",
-                          has_aux: bool = False):
+                          has_aux: bool = False,
+                          with_extras: bool = False):
     """Partial-manual shard_map wrapper for :func:`pipeline_1f1b_grads`
     (same composition story as :func:`make_pipeline_fn`: only ``pp`` is
     manual; dp/fsdp/tp/cp stay auto under GSPMD)."""
     from jax import shard_map
 
-    in_specs = (P(axis_name), P(), P(), P(), P(), P(), P())
+    in_specs = (P(axis_name), P(), P(), P(), P(), P(), P()) \
+        + ((P(),) if with_extras else ())
     out_specs = ((P(), P(axis_name), P(), P(), P()) if has_aux
                  else (P(), P(axis_name), P(), P()))
 
     def call(trunk_params, head_params, xm, targets_m, mask_m, seed,
-             aux_seed=0.0):
+             aux_seed=0.0, extras=None):
         compute_dtype = None
         if xm.dtype == jnp.bfloat16:   # boundary dance, see make_pipeline_fn
             compute_dtype, xm = xm.dtype, xm.astype(jnp.float32)
@@ -369,7 +405,10 @@ def make_pipeline_1f1b_fn(mesh: Mesh, stage_fn: Callable,
             axis_names=frozenset({axis_name}),
             check_vma=False,
         )
-        return fn(trunk_params, head_params, xm, targets_m, mask_m, seed,
-                  jnp.asarray(aux_seed, jnp.float32))
+        args = (trunk_params, head_params, xm, targets_m, mask_m, seed,
+                jnp.asarray(aux_seed, jnp.float32))
+        if with_extras:
+            args = args + (extras,)
+        return fn(*args)
 
     return call

@@ -280,11 +280,11 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
 
     stack = LayerStack(cfg, cfg.n_layers // pp, mesh)
 
-    def stage_fn(stage_params, h):
+    def stage_fn(stage_params, h, seg=None):
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                     cfg.rope_theta)
         out, aux = stack.apply({"params": {"layers": stage_params}},
-                               h, cos, sin)
+                               h, cos, sin, seg)
         return (out, aux) if moe else out
 
     # Head/tail are the same module definitions Llama.__call__ composes
@@ -313,6 +313,8 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
 
         fused = PP.make_pipeline_1f1b_fn(mesh, stage_fn, head_loss,
                                          has_aux=moe)
+        fused_seg = PP.make_pipeline_1f1b_fn(mesh, stage_fn, head_loss,
+                                             has_aux=moe, with_extras=True)
 
         def compute_grads(params, batch):
             tokens = batch["tokens"]
@@ -321,6 +323,7 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
             msk = (mask[:, 1:] if mask is not None
                    else jnp.ones_like(targets)).astype(jnp.float32)
             denom = jnp.maximum(msk.sum(), 1.0)
+            seg = batch.get("segment_ids")
             x, embed_vjp = jax.vjp(
                 lambda ep: embed_mod.apply({"params": ep}, inputs),
                 params["tok_embed"])
@@ -329,15 +332,20 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
             mm = PP.microbatch(msk, num_microbatches)
             head_params = {"final_norm": params["final_norm"],
                            "lm_head": params["lm_head"]}
-            if moe:
-                # aux enters the optimized total as weight * mean(aux):
-                # d/d(one stage-microbatch aux unit) = weight / M
-                loss_sum, d_trunk, d_head, d_xm, aux_raw = fused(
-                    params["layers"], head_params, xm, tm, mm, 1.0 / denom,
-                    cfg.moe_aux_weight / num_microbatches)
+            # aux enters the optimized total as weight * mean(aux):
+            # d/d(one stage-microbatch aux unit) = weight / M
+            aux_seed = cfg.moe_aux_weight / num_microbatches if moe else 0.0
+            if seg is not None:
+                sm = PP.microbatch(seg[:, :-1], num_microbatches)
+                res = fused_seg(params["layers"], head_params, xm, tm, mm,
+                                1.0 / denom, aux_seed, sm)
             else:
-                loss_sum, d_trunk, d_head, d_xm = fused(
-                    params["layers"], head_params, xm, tm, mm, 1.0 / denom)
+                res = fused(params["layers"], head_params, xm, tm, mm,
+                            1.0 / denom, aux_seed)
+            if moe:
+                loss_sum, d_trunk, d_head, d_xm, aux_raw = res
+            else:
+                loss_sum, d_trunk, d_head, d_xm = res
             (d_embed,) = embed_vjp(d_xm.reshape(x.shape).astype(x.dtype))
             grads = {"tok_embed": d_embed, "layers": d_trunk,
                      "final_norm": d_head["final_norm"],
@@ -353,18 +361,20 @@ def make_pp_train_step(cfg, optimizer: optax.GradientTransformation,
     pipe = PP.make_pipeline_fn(mesh, stage_fn,
                                num_microbatches=num_microbatches,
                                has_aux=moe)
+    pipe_seg = PP.make_pipeline_fn(mesh, stage_fn,
+                                   num_microbatches=num_microbatches,
+                                   has_aux=moe, with_extras=True)
 
     def forward_loss(params, inputs, targets, mask, segment_ids=None):
-        if segment_ids is not None:
-            raise ValueError("packed sequences (segment_ids) are not "
-                             "supported by the pipeline train step yet")
         x = embed_mod.apply({"params": params["tok_embed"]}, inputs)
         b = x.shape[0]
         xm = PP.microbatch(x, num_microbatches)
-        if moe:
-            ym, aux = pipe(params["layers"], xm)
+        if segment_ids is not None:
+            sm = PP.microbatch(segment_ids, num_microbatches)
+            out = pipe_seg(params["layers"], xm, sm)
         else:
-            ym, aux = pipe(params["layers"], xm), None
+            out = pipe(params["layers"], xm)
+        ym, aux = out if moe else (out, None)
         y = ym.reshape(b, *ym.shape[2:])
         y = norm_mod.apply({"params": params["final_norm"]}, y)
         logits = head_mod.apply(
