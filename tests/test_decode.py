@@ -219,3 +219,21 @@ class TestMakeDecodeFn:
         # the returned cache keeps working
         got2, _ = step(params, nxt, cache_b)
         assert np.isfinite(np.asarray(got2)).all()
+
+
+class TestDecodeEdgeCases:
+    def test_single_token_prompt(self, setup):
+        _, cfg, params = setup
+        out = D.generate(params, cfg, _prompt(cfg, b=2, s=1),
+                         max_new_tokens=3)
+        assert out.shape == (2, 4)
+
+    def test_prompt_filling_whole_cache_rejected_only_past_it(self, setup):
+        _, cfg, params = setup
+        # prompt exactly fills the cache: prefill fine, generation of even
+        # one token must be rejected
+        prompt = _prompt(cfg, b=1, s=16)
+        logits, _ = D.prefill(params, cfg, prompt, max_len=16)
+        assert logits.shape[-1] == cfg.vocab_size
+        with pytest.raises(ValueError, match="exceeds the cache"):
+            D.generate(params, cfg, prompt, max_new_tokens=1, max_len=16)

@@ -260,3 +260,16 @@ class TestSegmentedContextParallel:
         with mesh:
             out = jax.jit(make_ring_attention_fn(mesh))(q, k, v, seg)
         np.testing.assert_allclose(ref, out, atol=2e-5, rtol=2e-5)
+
+
+class TestFullMeshContextParallel:
+    def test_ring_cp8(self):
+        # the whole 8-device mesh on cp: 7 rotation hops
+        mesh = make_mesh(MeshSpec(cp=8))
+        q = jax.random.normal(jax.random.PRNGKey(0), (2, 512, 4, 16))
+        k = jax.random.normal(jax.random.PRNGKey(1), (2, 512, 2, 16))
+        v = jax.random.normal(jax.random.PRNGKey(2), (2, 512, 2, 16))
+        ref = reference_attention(q, k, v, causal=True)
+        with mesh:
+            out = jax.jit(make_ring_attention_fn(mesh))(q, k, v)
+        np.testing.assert_allclose(ref, out, atol=2e-5, rtol=2e-5)

@@ -272,3 +272,15 @@ class TestPipelineLlama:
         with pytest.raises(ValueError, match="not divisible"):
             T.make_pp_train_step(cfg, T.make_optimizer(), mesh, None,
                                  num_microbatches=2)
+
+
+class TestPipelineEdgeCases:
+    def test_single_microbatch(self):
+        # M=1 degenerates to sequential stages; both schedules must agree
+        # with GSPMD (warmup/drain only, no steady state)
+        ref = _run(MeshSpec(dp=4, fsdp=2), microbatches=1)
+        g = _run(MeshSpec(pp=2, dp=2, fsdp=2), microbatches=1)
+        f = _run(MeshSpec(pp=2, dp=2, fsdp=2), microbatches=1,
+                 schedule="1f1b")
+        np.testing.assert_allclose(g, ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(f, ref, rtol=1e-4, atol=1e-4)
