@@ -1,0 +1,15 @@
+"""Heterogeneous-worker runtime: CPU preprocessor pods feeding TPU workers.
+
+The reference declares a ``Heter`` tier but never reconciles it (dead
+scaffolding — ``Heter *ResourceSpec`` api/v1/paddlejob_types.go:129-130,
+commented env paddlejob_helper.go:142).  Here the tier is live end-to-end:
+the controller creates heter pods and injects ``TPUJOB_HETER_ENDPOINTS``
+(round 2), and this package gives them a program — a batch-preparation
+service (``heter.server``) that runs the CPU-heavy input work (tokenize /
+pack / augment) next to the TPU slice, and a worker-side iterator
+(``heter.client``) that streams prepared batches round-robin from the
+tier straight into :class:`train.data.DevicePrefetcher`.
+"""
+
+from paddle_operator_tpu.heter.client import HeterBatchIterator  # noqa: F401
+from paddle_operator_tpu.heter.server import make_server, serve  # noqa: F401

@@ -1,0 +1,59 @@
+"""Worker-side heter-tier client: stream prepared batches.
+
+Consumes ``TPUJOB_HETER_ENDPOINTS`` (injected by the controller,
+controller/builders.py) round-robin; yields plain numpy batch dicts, so
+it plugs straight into :class:`train.data.DevicePrefetcher` wherever a
+host iterator is expected.
+"""
+
+from __future__ import annotations
+
+import io
+import urllib.error
+import urllib.request
+from typing import Dict, Iterator, Sequence
+
+import numpy as np
+
+
+class HeterBatchIterator:
+    """Round-robin batch stream from the heter tier.  Stops when every
+    endpoint reports exhaustion (HTTP 204)."""
+
+    def __init__(self, endpoints: Sequence[str],
+                 timeout: float = 30.0) -> None:
+        if not endpoints:
+            raise ValueError("no heter endpoints")
+        self.endpoints = list(endpoints)
+        self.timeout = timeout
+        self._i = 0
+        self._live = set(range(len(self.endpoints)))
+
+    @classmethod
+    def from_env(cls, environ=None) -> "HeterBatchIterator":
+        from paddle_operator_tpu.launch.launcher import JobEnv
+
+        return cls(JobEnv.from_env(environ).heter_endpoints)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        while self._live:
+            idx = self._i % len(self.endpoints)
+            self._i += 1
+            if idx not in self._live:
+                continue
+            url = f"http://{self.endpoints[idx]}/v1/next"
+            try:
+                with urllib.request.urlopen(url,
+                                            timeout=self.timeout) as resp:
+                    if resp.status == 204:
+                        self._live.discard(idx)
+                        continue
+                    body = resp.read()
+            except urllib.error.HTTPError as e:
+                raise RuntimeError(
+                    f"{url}: HTTP {e.code} {e.read()[:200]!r}") from None
+            return dict(np.load(io.BytesIO(body)))
+        raise StopIteration

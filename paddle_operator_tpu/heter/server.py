@@ -1,0 +1,132 @@
+"""Heter-pod batch server.
+
+Runs in heter pods (CPU tier of a TPUJob): pulls batches from a
+producer callable — the CPU-heavy part of the input pipeline — into a
+bounded ring of prepared batches, and serves them over HTTP as npz.
+Transport mirrors ps/server.py (stdlib http.server + npz bodies).
+
+Entrypoint parity with the PS tier: ``python -m
+paddle_operator_tpu.heter.server`` reads the launcher env contract
+(TPUJOB_ROLE_RANK for a per-shard data seed).  Real deployments replace
+:func:`synthetic_producer` with their corpus pipeline via :func:`serve`.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Iterator
+from urllib.parse import urlparse
+
+import numpy as np
+
+
+def _npz_bytes(**arrays) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+class BatchBuffer:
+    """Background producer thread + bounded queue of prepared batches."""
+
+    def __init__(self, producer: Iterator[Dict[str, np.ndarray]],
+                 depth: int = 8) -> None:
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._count = 0
+        self._lock = threading.Lock()
+
+        def fill() -> None:
+            for batch in producer:
+                self._q.put(batch)
+            self._q.put(None)
+
+        threading.Thread(target=fill, daemon=True).start()
+
+    def next(self) -> Dict[str, np.ndarray]:
+        item = self._q.get()
+        if item is None:
+            raise StopIteration
+        with self._lock:
+            self._count += 1
+        return item
+
+    @property
+    def served(self) -> int:
+        return self._count
+
+
+class _Handler(BaseHTTPRequestHandler):
+    buffer: BatchBuffer  # injected by make_server
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes = b"",
+              ctype: str = "application/octet-stream") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        path = urlparse(self.path).path
+        if path == "/healthz":
+            self._send(200, b"ok", "text/plain")
+        elif path == "/v1/stats":
+            self._send(200, json.dumps(
+                {"served": self.buffer.served}).encode(),
+                "application/json")
+        elif path == "/v1/next":
+            try:
+                batch = self.buffer.next()
+            except StopIteration:
+                self._send(204)        # producer exhausted
+                return
+            self._send(200, _npz_bytes(**batch))
+        else:
+            self._send(404)
+
+
+def synthetic_producer(batch_size: int, seq_len: int, vocab: int,
+                       seed: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Stand-in corpus pipeline (per-shard seed so heter pods produce
+    disjoint streams)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {"tokens": rng.integers(0, vocab, (batch_size, seq_len),
+                                      dtype=np.int32)}
+
+
+def make_server(host: str, port: int,
+                producer: Iterator[Dict[str, np.ndarray]],
+                depth: int = 8) -> ThreadingHTTPServer:
+    buf = BatchBuffer(producer, depth)
+    handler = type("Handler", (_Handler,), {"buffer": buf})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(port: int, producer: Iterator[Dict[str, np.ndarray]],
+          host: str = "0.0.0.0") -> None:
+    srv = make_server(host, port, producer)
+    print(f"heter batch server on {host}:{port}", flush=True)
+    srv.serve_forever()
+
+
+def main() -> int:
+    """Heter-pod entrypoint: shard seed from the launcher env contract."""
+    from paddle_operator_tpu.launch.launcher import JobEnv
+
+    env = JobEnv.from_env()
+    producer = synthetic_producer(batch_size=32, seq_len=2049,
+                                  vocab=32000, seed=env.role_rank)
+    serve(env.port, producer)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
