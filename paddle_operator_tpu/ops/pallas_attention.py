@@ -58,6 +58,18 @@ def _masked_scores(q, k, iq, ik, *, scale, causal, block_q, block_k,
     return s
 
 
+def _seg_gate(live, seg_q, seg_k):
+    """Block-execution gate: the causal skip AND (when packed) a dynamic
+    id-range overlap test — disjoint q/k document ranges mean the whole
+    tile is masked, so skip its matmuls entirely.  ``live`` may be a
+    Python bool (causal=False) or a traced predicate."""
+    if seg_q is None:
+        return live
+    sq, sk = seg_q[0], seg_k[0]
+    overlap = (jnp.min(sq) <= jnp.max(sk)) & (jnp.max(sq) >= jnp.min(sk))
+    return jnp.logical_and(live, overlap)
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -80,8 +92,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
 
     # block-level causal skip: block is live iff some q_row >= some k_col
     live = (not causal) or (iq * block_q + block_q - 1 >= ik * block_k)
+    # segment skip: a tile whose q and k documents are disjoint is fully
+    # masked — with contiguous packing this cuts attention work from S^2
+    # to ~S x doc_len (min/max reductions cost nothing vs the matmul)
+    gate = _seg_gate(live, seg_q_ref[0] if has_seg else None,
+                     seg_k_ref[0] if has_seg else None)
 
-    @pl.when(live)
+    @pl.when(gate)
     def _compute():
         # keep MXU inputs in their storage dtype (bf16 native rate);
         # accumulation is f32 via preferred_element_type.
@@ -212,8 +229,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     live = (not causal) or (iq * block_q + block_q - 1 >= ik * block_k)
+    gate = _seg_gate(live, seg_q_ref[0] if has_seg else None,
+                     seg_k_ref[0] if has_seg else None)
 
-    @pl.when(live)
+    @pl.when(gate)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
@@ -261,8 +280,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     live = (not causal) or (iq * block_q + block_q - 1 >= ik * block_k)
+    gate = _seg_gate(live, seg_q_ref[0] if has_seg else None,
+                     seg_k_ref[0] if has_seg else None)
 
-    @pl.when(live)
+    @pl.when(gate)
     def _compute():
         q = q_ref[0, 0]
         k = k_ref[0, 0]
