@@ -62,11 +62,13 @@ def _seg_gate(live, seg_q, seg_k):
     """Block-execution gate: the causal skip AND (when packed) a dynamic
     id-range overlap test — disjoint q/k document ranges mean the whole
     tile is masked, so skip its matmuls entirely.  ``live`` may be a
-    Python bool (causal=False) or a traced predicate."""
+    Python bool (causal=False) or a traced predicate.  Reductions run on
+    the full 2-D [8, block] tiles (rows identical, see _seg3d) — Mosaic-
+    layout-friendly, verified compiled on v5e."""
     if seg_q is None:
         return live
-    sq, sk = seg_q[0], seg_k[0]
-    overlap = (jnp.min(sq) <= jnp.max(sk)) & (jnp.max(sq) >= jnp.min(sk))
+    overlap = ((jnp.min(seg_q) <= jnp.max(seg_k))
+               & (jnp.max(seg_q) >= jnp.min(seg_k)))
     return jnp.logical_and(live, overlap)
 
 
@@ -83,6 +85,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
         o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     iq, ik = pl.program_id(2), pl.program_id(3)
     nk = pl.num_programs(3)
+    seg_q = seg_q_ref[0] if has_seg else None
+    seg_k = seg_k_ref[0] if has_seg else None
 
     @pl.when(ik == 0)
     def _init():
@@ -95,8 +99,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
     # segment skip: a tile whose q and k documents are disjoint is fully
     # masked — with contiguous packing this cuts attention work from S^2
     # to ~S x doc_len (min/max reductions cost nothing vs the matmul)
-    gate = _seg_gate(live, seg_q_ref[0] if has_seg else None,
-                     seg_k_ref[0] if has_seg else None)
+    gate = _seg_gate(live, seg_q, seg_k)
 
     @pl.when(gate)
     def _compute():
@@ -107,8 +110,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale: float, causal: bool,
         v = v_ref[0, 0]                              # [bk, D]
         s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
-                           seg_q=seg_q_ref[0] if has_seg else None,
-                           seg_k=seg_k_ref[0] if has_seg else None)
+                           seg_q=seg_q, seg_k=seg_k)
 
         m_prev = m_ref[:, :1]                        # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)   # [bq, 1]
@@ -229,8 +231,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     live = (not causal) or (iq * block_q + block_q - 1 >= ik * block_k)
-    gate = _seg_gate(live, seg_q_ref[0] if has_seg else None,
-                     seg_k_ref[0] if has_seg else None)
+    seg_q = seg_q_ref[0] if has_seg else None
+    seg_k = seg_k_ref[0] if has_seg else None
+    gate = _seg_gate(live, seg_q, seg_k)
 
     @pl.when(gate)
     def _compute():
@@ -243,8 +246,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
-                           seg_q=seg_q_ref[0] if has_seg else None,
-                           seg_k=seg_k_ref[0] if has_seg else None)
+                           seg_q=seg_q, seg_k=seg_k)
         p = jnp.exp(s - lse)                       # [bq, bk]
         # dv += p^T @ dO
         dv_acc[:] += jax.lax.dot_general(
@@ -280,8 +282,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     live = (not causal) or (iq * block_q + block_q - 1 >= ik * block_k)
-    gate = _seg_gate(live, seg_q_ref[0] if has_seg else None,
-                     seg_k_ref[0] if has_seg else None)
+    seg_q = seg_q_ref[0] if has_seg else None
+    seg_k = seg_k_ref[0] if has_seg else None
+    gate = _seg_gate(live, seg_q, seg_k)
 
     @pl.when(gate)
     def _compute():
@@ -294,8 +297,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = _masked_scores(q, k, iq, ik, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
-                           seg_q=seg_q_ref[0] if has_seg else None,
-                           seg_k=seg_k_ref[0] if has_seg else None)
+                           seg_q=seg_q, seg_k=seg_k)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
