@@ -159,11 +159,16 @@ def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
 
 
 def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
-             cache: Dict[str, jax.Array]
+             cache: Dict[str, jax.Array], *, last_only: bool = False
              ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """[B, T] new tokens at cache['pos'] -> ([B, T, vocab] logits,
     advanced cache).  Layers run under lax.scan over the stacked params
-    (the same ``layers`` layout nn.scan trains)."""
+    (the same ``layers`` layout nn.scan trains).
+
+    ``last_only``: apply the norm + lm head to the final position only
+    (logits [B, 1, vocab]) — prefill needs just the next-token logits,
+    and head logits over a whole long prompt are the biggest tensor in
+    the decode path ([B, S, V] f32 — gigabytes at real vocab sizes)."""
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tokens]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
@@ -176,6 +181,8 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
+    if last_only:
+        x = x[:, -1:]
     x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     logits = (x @ params["lm_head"]["kernel"].astype(cfg.dtype)
               ).astype(jnp.float32)
@@ -194,8 +201,8 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
         raise ValueError(f"prompt length {tokens.shape[1]} exceeds the "
                          f"cache ({cache_len} positions)")
     cache = init_cache(cfg, tokens.shape[0], max_len)
-    logits, cache = _forward(cfg, params, tokens, cache)
-    return logits[:, -1], cache
+    logits, cache = _forward(cfg, params, tokens, cache, last_only=True)
+    return logits[:, 0], cache
 
 
 def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
