@@ -1,0 +1,84 @@
+"""Sample client CLI (client/client.py — reference C5 analogue,
+client/client.go:41-93) driven end-to-end over real HTTP against the mock
+apiserver: create-from-yaml (with validation), get, list, delete.
+"""
+
+import json
+import sys
+import threading
+from http.server import ThreadingHTTPServer
+
+import pytest
+import yaml
+
+from paddle_operator_tpu.controller.fake_api import FakeAPI
+
+sys.path.insert(0, "hack")
+sys.path.insert(0, "client")
+from mock_apiserver import make_handler  # noqa: E402
+
+import client as client_cli  # noqa: E402  (client/client.py)
+
+
+@pytest.fixture()
+def server(monkeypatch):
+    api = FakeAPI()
+    handler, lock = make_handler(api)
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    monkeypatch.setenv("KUBE_HOST",
+                       f"http://127.0.0.1:{srv.server_address[1]}")
+    monkeypatch.setenv("KUBE_TOKEN", "")
+    yield api
+    srv.shutdown()
+
+
+def _write_job(tmp_path, name="cli-job", workers=2):
+    doc = {
+        "apiVersion": "batch.tpujob.dev/v1", "kind": "TPUJob",
+        "metadata": {"name": name},
+        "spec": {"worker": {"replicas": workers, "template": {
+            "spec": {"containers": [{"name": "m", "image": "i"}]}}}},
+    }
+    path = tmp_path / f"{name}.yaml"
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+class TestClientCLI:
+    def test_create_get_list_delete(self, server, tmp_path, capsys):
+        assert client_cli.main(["create", _write_job(tmp_path)]) == 0
+        assert ("TPUJob", "default", "cli-job") in server.store
+
+        assert client_cli.main(["get", "cli-job"]) == 0
+        got = json.loads(capsys.readouterr().out.split("created\n", 1)[-1])
+        assert got["metadata"]["name"] == "cli-job"
+
+        assert client_cli.main(["list"]) == 0
+        assert "cli-job" in capsys.readouterr().out
+
+        assert client_cli.main(["delete", "cli-job"]) == 0
+        assert ("TPUJob", "default", "cli-job") not in server.store
+
+    def test_create_rejects_invalid_spec(self, server, tmp_path, capsys):
+        doc = {
+            "apiVersion": "batch.tpujob.dev/v1", "kind": "TPUJob",
+            "metadata": {"name": "bad"},
+            "spec": {
+                "worker": {"replicas": 3, "template": {
+                    "spec": {"containers": [{"name": "m", "image": "i"}]}}},
+                # 2x4 topology / 4 chips-per-worker => 2 workers per slice;
+                # 3 replicas contradicts it
+                "tpu": {"accelerator": "tpu-v5-lite-podslice",
+                        "topology": "2x4", "sliceCount": 1,
+                        "chipsPerWorker": 4},
+            },
+        }
+        path = tmp_path / "bad.yaml"
+        path.write_text(yaml.safe_dump(doc))
+        assert client_cli.main(["create", str(path)]) == 1
+        assert "invalid spec" in capsys.readouterr().err
+        assert ("TPUJob", "default", "bad") not in server.store
+
+    def test_usage_on_unknown_command(self, server, capsys):
+        assert client_cli.main(["frobnicate"]) == 2
