@@ -175,7 +175,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     In a **PS pod** with no command, the shim runs the embedding parameter
     server (ps/server.py) — the default PS-tier program, the way the
     reference's PS pods run Paddle's pserver loop
-    (/root/reference/docs/design-arch.md:5-12)."""
+    (/root/reference/docs/design-arch.md:5-12).  A **heter pod** with no
+    command likewise runs the batch-preparation server (heter/server.py)."""
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
@@ -192,6 +193,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             from paddle_operator_tpu.ps import server as ps_server
 
             return ps_server.main()
+        if env.res_type == "heter":
+            from paddle_operator_tpu.heter import server as heter_server
+
+            return heter_server.main()
         print(json.dumps({
             "rank": env.rank, "num_workers": env.num_workers,
             "coordinator": env.coordinator_address,

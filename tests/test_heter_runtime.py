@@ -71,3 +71,18 @@ class TestHeterRuntime:
     def test_no_endpoints_raises(self):
         with pytest.raises(ValueError, match="no heter endpoints"):
             HeterBatchIterator([])
+
+
+class TestLauncherDefaultProgram:
+    def test_heter_pod_without_command_runs_batch_server(self, monkeypatch):
+        """Launcher parity with the PS tier: a heter pod with no command
+        gets the batch-prep server as its default program."""
+        from paddle_operator_tpu.heter import server as heter_server
+        from paddle_operator_tpu.launch import launcher
+
+        monkeypatch.setenv("TPUJOB_RES_TYPE", "heter")
+        called = {}
+        monkeypatch.setattr(heter_server, "main",
+                            lambda: (called.setdefault("ran", True), 0)[1])
+        assert launcher.main([]) == 0
+        assert called.get("ran")
