@@ -234,11 +234,17 @@ def render_chart(root: str) -> None:
     }])
     write_yaml(os.path.join(chart_dir, "templates", "crd.yaml"),
                [generate_crd()])
-    # templated namespace/image via helm values
+    # templated namespace/image/leader-election via helm values
     ops = operator_manifests("__NS__", "__IMG__")
     text = yaml.safe_dump_all(ops, sort_keys=False)
     text = text.replace("__NS__", "{{ .Values.controllernamespace }}")
     text = text.replace("__IMG__", "{{ .Values.image }}")
+    text = text.replace(
+        "        - --leader-elect\n",
+        "        {{- if .Values.leaderElect }}\n"
+        "        - --leader-elect\n"
+        "        {{- end }}\n")
+    text = text.replace("leaderElect: true", "leaderElect: {{ .Values.leaderElect }}")
     path = os.path.join(chart_dir, "templates", "controller.yaml")
     with open(path, "w") as f:
         f.write(text)
