@@ -1,0 +1,119 @@
+"""BASELINE config 5 end-to-end: preemption → bounded gang restart →
+checkpoint resume, control plane and workload knitted together in one
+test.  The reference only ever sketched this (its fault-tolerance doc was
+never implemented); here every piece is real: the reconciler's restart
+path, the rendezvous ConfigMap regeneration, the TPUJOB_CHECKPOINT_PATH
+contract injected by the builders, and orbax resume into the same
+shardings.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_operator_tpu.api import ResourceSpec, TPUJob, TPUJobSpec
+from paddle_operator_tpu.api.types import Phase
+from paddle_operator_tpu.controller.fake_api import FakeAPI, FakeFleet
+from paddle_operator_tpu.controller.reconciler import (
+    KIND_CM,
+    KIND_JOB,
+    TPUJobReconciler,
+    run_to_settled,
+)
+from paddle_operator_tpu.models import llama as L
+from paddle_operator_tpu.parallel.mesh import make_mesh
+from paddle_operator_tpu.api.types import MeshSpec
+from paddle_operator_tpu.train import trainer as T
+from paddle_operator_tpu.train.checkpoint import CheckpointManager, resume_or_init
+
+TMPL = {"spec": {"containers": [{"name": "m", "image": "jax:latest"}]}}
+NS = "default"
+
+
+class TestPreemptionRecovery:
+    def test_preempt_restart_resume(self, tmp_path):
+        ckpt_path = str(tmp_path / "ckpt")
+
+        # -- control plane: submit with a checkpoint path, reach Running
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        fleet = FakeFleet(api, NS)
+        job = TPUJob(name="pj", namespace=NS, spec=TPUJobSpec(
+            worker=ResourceSpec(replicas=2, template=TMPL),
+            max_restarts=2, checkpoint_path=ckpt_path))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "pj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "pj")
+        cm = api.get(KIND_CM, NS, "pj")
+        assert cm["data"]["TPUJOB_CHECKPOINT_PATH"] == ckpt_path
+
+        # -- workload (epoch 1): train 3 steps, checkpoint each, exactly as
+        #    a worker launched with the injected env would
+        mesh = make_mesh(MeshSpec(dp=8))
+        model, cfg = L.make_model("tiny")
+        opt = T.make_optimizer(1e-3, warmup_steps=1, decay_steps=50)
+        pats = L.partition_patterns(cfg)
+        ex = (jnp.zeros((8, 8), jnp.int32),)
+        sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
+
+        def init():
+            return T.create_state(model, opt, mesh, pats, ex)
+
+        ckpt = CheckpointManager(cm["data"]["TPUJOB_CHECKPOINT_PATH"],
+                                 save_interval_steps=1)
+        state, resumed = resume_or_init(ckpt, init)
+        assert not resumed
+        step = T.make_train_step(model, opt, mesh, sh)
+        for i in range(3):
+            state, m = step(state, T.synthetic_batch(8, 17, cfg.vocab_size,
+                                                     seed=i))
+            ckpt.save(int(state.step), state, force=True)
+        loss_before = float(m["loss"])
+        ckpt.wait()
+
+        # -- preemption: a worker pod fails; the controller consumes one
+        #    restart, tears the gang down, and recreates it with the SAME
+        #    ranks and checkpoint path
+        fleet.fail("pj-worker-1")
+        run_to_settled(rec, NS, "pj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "pj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "pj"))
+        assert got.status.phase == Phase.RUNNING
+        assert got.status.restart_count == 1
+        cm2 = api.get(KIND_CM, NS, "pj")
+        assert cm2["data"]["TPUJOB_CHECKPOINT_PATH"] == ckpt_path
+
+        # -- workload (epoch 2, the restarted gang): resume and continue
+        ckpt2 = CheckpointManager(cm2["data"]["TPUJOB_CHECKPOINT_PATH"],
+                                  save_interval_steps=1)
+        state2, resumed = resume_or_init(ckpt2, init)
+        assert resumed
+        assert int(state2.step) == 3          # no lost progress
+        state2, m2 = step(state2, T.synthetic_batch(8, 17, cfg.vocab_size,
+                                                    seed=3))
+        assert int(state2.step) == 4
+        assert np.isfinite(float(m2["loss"]))
+        assert abs(float(m2["loss"]) - loss_before) < 1.0  # continued, not reset
+
+    def test_budget_exhaustion_ends_in_failed(self, tmp_path):
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        fleet = FakeFleet(api, NS)
+        job = TPUJob(name="fj", namespace=NS, spec=TPUJobSpec(
+            worker=ResourceSpec(replicas=2, template=TMPL),
+            max_restarts=1))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "fj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "fj")
+        for _ in range(2):                     # two failures, budget = 1
+            fleet.fail("fj-worker-0")
+            run_to_settled(rec, NS, "fj")
+            fleet.run_all()
+            run_to_settled(rec, NS, "fj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert got.status.phase == Phase.FAILED
+        assert got.status.restart_count == 1
