@@ -231,12 +231,35 @@ def make_decode_fn(cfg: LlamaConfig):
     return jax.jit(step, donate_argnums=(2,))
 
 
+def _filter_logits(logits: jax.Array, top_k: Optional[int],
+                   top_p: Optional[float]) -> jax.Array:
+    """Standard sampling filters, static-shaped: top-k keeps the k highest
+    logits; top-p (nucleus) keeps the smallest set of tokens whose
+    probability mass reaches p.  Filtered entries go to -inf."""
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until the cumulative mass FIRST exceeds p (the
+        # token crossing the threshold is kept — standard nucleus rule)
+        keep_sorted = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
              *, max_new_tokens: int, temperature: float = 0.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
              key: Optional[jax.Array] = None,
              max_len: Optional[int] = None,
              eos_token: Optional[int] = None) -> jax.Array:
-    """Greedy (temperature=0) or temperature sampling.  prompt [B, S] ->
+    """Greedy (temperature=0) or temperature sampling, with optional
+    top-k / nucleus (top-p) filtering.  prompt [B, S] ->
     [B, S + max_new_tokens].  jit-friendly: the step loop is a lax.scan
     with static trip count (shapes never depend on when sequences stop).
     With ``eos_token``, a sequence that emits it keeps emitting eos for
@@ -257,8 +280,8 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
     def sample(logits, k):
         if temperature <= 0:
             return logits.argmax(-1).astype(prompt.dtype)
-        return jax.random.categorical(
-            k, logits / temperature).astype(prompt.dtype)
+        logits = _filter_logits(logits / temperature, top_k, top_p)
+        return jax.random.categorical(k, logits).astype(prompt.dtype)
 
     def step(carry, k):
         logits, cache, done = carry

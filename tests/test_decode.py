@@ -237,3 +237,52 @@ class TestDecodeEdgeCases:
         assert logits.shape[-1] == cfg.vocab_size
         with pytest.raises(ValueError, match="exceeds the cache"):
             D.generate(params, cfg, prompt, max_new_tokens=1, max_len=16)
+
+
+class TestSamplingFilters:
+    def test_top_k_restricts_to_k_tokens(self, setup):
+        _, cfg, params = setup
+        prompt = _prompt(cfg, b=2, s=4)
+        logits, _ = D.prefill(params, cfg, prompt)
+        allowed = set()
+        for row in np.asarray(logits):
+            allowed.update(np.argsort(row)[-2:].tolist())
+        outs = set()
+        for seed in range(20):
+            out = D.generate(params, cfg, prompt, max_new_tokens=1,
+                             temperature=1.5, top_k=2,
+                             key=jax.random.PRNGKey(seed))
+            outs.update(np.asarray(out)[:, -1].tolist())
+        assert outs <= allowed
+
+    def test_top_p_one_keeps_full_distribution(self, setup):
+        """top_p=1.0 must not change the sampling distribution — compare
+        a fixed-key draw to the unfiltered draw."""
+        _, cfg, params = setup
+        prompt = _prompt(cfg, b=4, s=4)
+        a = D.generate(params, cfg, prompt, max_new_tokens=3,
+                       temperature=0.8, top_p=1.0,
+                       key=jax.random.PRNGKey(5))
+        b = D.generate(params, cfg, prompt, max_new_tokens=3,
+                       temperature=0.8, key=jax.random.PRNGKey(5))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_tiny_top_p_degenerates_to_greedy(self, setup):
+        _, cfg, params = setup
+        prompt = _prompt(cfg, b=2, s=4)
+        greedy = D.generate(params, cfg, prompt, max_new_tokens=3)
+        nucleus = D.generate(params, cfg, prompt, max_new_tokens=3,
+                             temperature=1.0, top_p=1e-6,
+                             key=jax.random.PRNGKey(1))
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(nucleus))
+
+    def test_filters_jit(self, setup):
+        _, cfg, params = setup
+        prompt = _prompt(cfg, b=2, s=4)
+        gen = jax.jit(lambda p, t: D.generate(
+            p, cfg, t, max_new_tokens=3, temperature=0.9, top_k=8,
+            top_p=0.9, key=jax.random.PRNGKey(2)))
+        out = gen(params, prompt)
+        assert out.shape == (2, 7)
+        assert int(out.max()) < cfg.vocab_size
