@@ -114,3 +114,19 @@ def test_segmented_gradients_match_reference():
     gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gr, gf):
         np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+
+
+def test_dispatcher_uses_pallas_for_segments():
+    """segment_ids no longer bounce to the reference path — the dispatcher
+    keeps the flash kernel (in-kernel masking)."""
+    from unittest import mock
+
+    from paddle_operator_tpu.ops import attention as A
+
+    q, k, v = rand_qkv(1, 256, 2, 2, 64)
+    seg = _seg_pattern(1, 256)
+    with mock.patch.object(A, "reference_attention",
+                           side_effect=AssertionError("fell back")):
+        out = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                              block_q=128, block_k=128, interpret=True)
+    assert out.shape == q.shape
