@@ -1,0 +1,150 @@
+"""Minimal generation server — the deployable face of the infer layer.
+
+Runs in a worker pod (or anywhere with the params): loads a checkpoint
+through the same ``TPUJOB_CHECKPOINT_PATH`` contract training uses, jits
+:func:`infer.decode.generate`, and serves JSON over stdlib HTTP (the same
+transport discipline as the ps/ and heter/ tiers — no web framework).
+
+    POST /v1/generate
+      {"tokens": [[...], ...], "max_new_tokens": N,
+       "temperature": 0.7, "top_k": 40, "top_p": 0.9, "eos_token": 2}
+    -> {"tokens": [[...], ...]}   (prompt + continuation per row)
+
+Each distinct (batch, prompt-length, options) combination jits once and
+is cached — exact semantics always (no pad tokens entering the context).
+Production callers should bucket requests to a few prompt lengths to
+bound the compile set; this server is the framework's serving reference,
+not a batching scheduler.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.infer import decode as D
+from paddle_operator_tpu.models.llama import LlamaConfig
+
+
+class Generator:
+    """Jit-per-(shape, options) wrapper around decode.generate."""
+
+    def __init__(self, params: Any, cfg: LlamaConfig) -> None:
+        self.params = params
+        self.cfg = cfg
+        self._fns: Dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+
+    def __call__(self, tokens: np.ndarray, *, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_token: Optional[int] = None,
+                 seed: int = 0) -> np.ndarray:
+        key = (tokens.shape, max_new_tokens, temperature, top_k, top_p,
+               eos_token)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = jax.jit(lambda p, t, k: D.generate(
+                    p, self.cfg, t, max_new_tokens=max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    eos_token=eos_token, key=k))
+                self._fns[key] = fn
+        out = fn(self.params, jnp.asarray(tokens, jnp.int32),
+                 jax.random.PRNGKey(seed))
+        return np.asarray(out)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    generator: Generator  # injected
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            self._send(200, {"ok": True})
+        else:
+            self._send(404, {})
+
+    def do_POST(self):
+        if self.path != "/v1/generate":
+            self._send(404, {})
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        try:
+            req = json.loads(self.rfile.read(n))
+            tokens = np.asarray(req["tokens"], np.int32)
+            if tokens.ndim != 2:
+                raise ValueError("tokens must be [batch, seq]")
+            out = self.generator(
+                tokens,
+                max_new_tokens=int(req.get("max_new_tokens", 32)),
+                temperature=float(req.get("temperature", 0.0)),
+                top_k=req.get("top_k"),
+                top_p=req.get("top_p"),
+                eos_token=req.get("eos_token"),
+                seed=int(req.get("seed", 0)))
+            self._send(200, {"tokens": out.tolist()})
+        except Exception as e:
+            self._send(400, {"error": str(e)})
+
+
+def make_server(host: str, port: int, params: Any,
+                cfg: LlamaConfig) -> ThreadingHTTPServer:
+    gen = Generator(params, cfg)
+    handler = type("Handler", (_Handler,), {"generator": gen})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def main() -> int:
+    """Serving entrypoint: restore params from TPUJOB_CHECKPOINT_PATH
+    (fresh init if none — smoke mode) and serve on TPUJOB_PORT."""
+    import os
+
+    from paddle_operator_tpu.launch.launcher import JobEnv
+    from paddle_operator_tpu.models.llama import Llama, make_model
+    from paddle_operator_tpu.train import trainer as T
+    from paddle_operator_tpu.train.checkpoint import (
+        CheckpointManager,
+        resume_or_init,
+    )
+
+    env = JobEnv.from_env()
+    model, cfg = make_model(os.environ.get("MODEL_PRESET", "7b"))
+    opt = T.make_optimizer()
+
+    def init():
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        # full TrainState structure so a TRAINING checkpoint restores
+        # cleanly; only params are served
+        return T.TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                            opt_state=opt.init(params))
+
+    ckpt = CheckpointManager()   # TPUJOB_CHECKPOINT_PATH
+    state, resumed = resume_or_init(ckpt, init)
+    print(f"serving {os.environ.get('MODEL_PRESET', '7b')} "
+          f"(resumed={resumed}) on :{env.port}", flush=True)
+    srv = make_server("0.0.0.0", env.port, state.params, cfg)
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
