@@ -40,6 +40,17 @@ def _rms(x: jax.Array, scale: jax.Array, eps: float, dtype) -> jax.Array:
     return (norm * scale.astype(jnp.float32)).astype(dtype)
 
 
+def _mm(x: jax.Array, kernel_leaf, dtype) -> jax.Array:
+    """x @ kernel for a raw or weight-only-int8 kernel leaf
+    (infer/quant.py): quantized weights stream from HBM at half the
+    bytes; the per-output-channel scale applies after the matmul (valid
+    because the scale is constant along the contraction dim)."""
+    if isinstance(kernel_leaf, dict) and "q" in kernel_leaf:
+        out = x @ kernel_leaf["q"].astype(dtype)
+        return out * kernel_leaf["s"][..., 0, :].astype(dtype)
+    return x @ kernel_leaf.astype(dtype)
+
+
 def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
           pos: jax.Array) -> jax.Array:
     """Split-halves RoPE at dynamic offset ``pos`` (mirrors
@@ -82,12 +93,9 @@ def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
 
     h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    q = (h @ lp["attn"]["wq"]["kernel"].astype(cfg.dtype)
-         ).reshape(b, t, hq, d)
-    k = (h @ lp["attn"]["wk"]["kernel"].astype(cfg.dtype)
-         ).reshape(b, t, hkv, d)
-    v = (h @ lp["attn"]["wv"]["kernel"].astype(cfg.dtype)
-         ).reshape(b, t, hkv, d)
+    q = _mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
+    k = _mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    v = _mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
     q = _rope(q, cos, sin, pos)
     k = _rope(k, cos, sin, pos)
 
@@ -116,17 +124,17 @@ def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     out = jnp.einsum("bthrs,bshd->bthrd", probs.astype(cfg.dtype), v_cache,
                      preferred_element_type=jnp.float32)
     out = out.reshape(b, t, hq * d).astype(cfg.dtype)
-    attn_out = out @ lp["attn"]["wo"]["kernel"].astype(cfg.dtype)
+    attn_out = _mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
 
     x = x + attn_out
     n = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     if cfg.n_experts > 0:
         ffn = _moe_ffn(cfg, lp["moe"], n)
     else:
-        gate = n @ lp["mlp"]["w1"]["kernel"].astype(cfg.dtype)
-        up = n @ lp["mlp"]["w3"]["kernel"].astype(cfg.dtype)
-        ffn = (jax.nn.silu(gate) * up) @ lp["mlp"]["w2"]["kernel"].astype(
-            cfg.dtype)
+        gate = _mm(n, lp["mlp"]["w1"]["kernel"], cfg.dtype)
+        up = _mm(n, lp["mlp"]["w3"]["kernel"], cfg.dtype)
+        ffn = _mm(jax.nn.silu(gate) * up, lp["mlp"]["w2"]["kernel"],
+                  cfg.dtype)
     return x + ffn, k_cache, v_cache
 
 
@@ -147,8 +155,8 @@ def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
 
     def one_expert(_, w):
         w1_e, w2_e = w
-        h = jax.nn.gelu(tokens @ w1_e.astype(cfg.dtype))
-        return None, h @ w2_e.astype(cfg.dtype)             # [T, D]
+        h = jax.nn.gelu(_mm(tokens, w1_e, cfg.dtype))
+        return None, _mm(h, w2_e, cfg.dtype)                # [T, D]
 
     _, outs = jax.lax.scan(one_expert, None,
                            (mp["w1"], mp["w2"]))            # [E, T, D]
@@ -184,8 +192,8 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     if last_only:
         x = x[:, -1:]
     x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    logits = (x @ params["lm_head"]["kernel"].astype(cfg.dtype)
-              ).astype(jnp.float32)
+    logits = _mm(x, params["lm_head"]["kernel"],
+                 cfg.dtype).astype(jnp.float32)
     new_cache = {"k": k_new, "v": v_new,
                  "pos": pos + tokens.shape[1]}
     return logits, new_cache

@@ -1,0 +1,71 @@
+"""Weight-only int8 quantization for decoding.
+
+Decode is HBM-bandwidth-bound: every step streams every weight.  Storing
+the matmul kernels as int8 with per-output-channel f32 scales halves the
+bytes streamed (activations and accumulation stay in the compute dtype —
+"weight-only" quantization, the standard serving recipe).  Norm scales
+and the embedding table stay full precision (tiny / gather-shaped).
+
+``quantize_params`` maps the trained param tree to the same tree shape
+with each targeted ``kernel`` leaf replaced by ``{"q": int8, "s": f32}``;
+infer/decode.py's matmul helper consumes either form, so all decode entry
+points (prefill / decode_step / generate / serve) work unchanged on
+quantized params.  Accuracy is config-dependent; tests bound the logit
+error on the tiny model.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+# matmul kernels worth quantizing: attention + (dense or MoE) FFN + head
+_TARGETS = re.compile(
+    r"(attn/(wq|wk|wv|wo)/kernel"
+    r"|mlp/w[123]/kernel"
+    r"|moe/w[12]"
+    r"|lm_head/kernel)$")
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def quantize_leaf(w: jax.Array) -> Dict[str, jax.Array]:
+    """[..., in, out] kernel -> int8 with per-out-channel scales
+    (absmax over the contraction dim)."""
+    s = jnp.max(jnp.abs(w), axis=-2, keepdims=True) / 127.0
+    s = jnp.where(s == 0, 1.0, s)
+    q = jnp.clip(jnp.round(w / s), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def dequantize_leaf(leaf, dtype) -> jax.Array:
+    if isinstance(leaf, dict) and "q" in leaf:
+        return (leaf["q"].astype(dtype) * leaf["s"].astype(dtype))
+    return leaf.astype(dtype)
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Return the params tree with the decode-relevant matmul kernels
+    replaced by int8+scale pairs (everything else untouched)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    out = params
+    quantized = {}
+    for path, leaf in flat:
+        if _TARGETS.search(_path_str(path)):
+            quantized[_path_str(path)] = quantize_leaf(leaf)
+
+    def rebuild(tree, prefix=""):
+        if not isinstance(tree, dict):
+            return tree
+        return {k: (quantized[f"{prefix}{k}"]
+                    if f"{prefix}{k}" in quantized
+                    else rebuild(v, f"{prefix}{k}/"))
+                for k, v in tree.items()}
+
+    return rebuild(out)

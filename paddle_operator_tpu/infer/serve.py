@@ -139,9 +139,16 @@ def main() -> int:
 
     ckpt = CheckpointManager()   # TPUJOB_CHECKPOINT_PATH
     state, resumed = resume_or_init(ckpt, init)
+    params = state.params
+    if os.environ.get("QUANTIZE", "") == "int8":
+        from paddle_operator_tpu.infer.quant import quantize_params
+
+        params = quantize_params(params)   # ~1.75x decode on v5e
     print(f"serving {os.environ.get('MODEL_PRESET', '7b')} "
-          f"(resumed={resumed}) on :{env.port}", flush=True)
-    srv = make_server("0.0.0.0", env.port, state.params, cfg)
+          f"(resumed={resumed}, "
+          f"quantize={os.environ.get('QUANTIZE', 'off')}) on :{env.port}",
+          flush=True)
+    srv = make_server("0.0.0.0", env.port, params, cfg)
     srv.serve_forever()
     return 0
 

@@ -286,3 +286,54 @@ class TestSamplingFilters:
         out = gen(params, prompt)
         assert out.shape == (2, 7)
         assert int(out.max()) < cfg.vocab_size
+
+
+class TestWeightOnlyInt8:
+    def test_quantized_logits_close_and_generation_runs(self, setup):
+        from paddle_operator_tpu.infer import quant as Q
+
+        _, cfg, params = setup
+        qparams = Q.quantize_params(params)
+        # targeted kernels became int8
+        assert qparams["layers"]["attn"]["wq"]["kernel"]["q"].dtype == \
+            jnp.int8
+        assert qparams["lm_head"]["kernel"]["q"].dtype == jnp.int8
+        # untouched: norms, embedding, biases
+        assert qparams["final_norm"]["scale"].dtype == jnp.float32
+        assert qparams["tok_embed"]["embedding"].dtype == jnp.float32
+
+        toks = _prompt(cfg, b=2, s=10)
+        ref, _ = D.prefill(params, cfg, toks)
+        got, _ = D.prefill(qparams, cfg, toks)
+        # int8 weight rounding: logits within a few percent of the span
+        span = float(np.abs(np.asarray(ref)).max())
+        err = float(np.abs(np.asarray(got) - np.asarray(ref)).max())
+        assert err < 0.05 * span, (err, span)
+
+        out = D.generate(qparams, cfg, _prompt(cfg, b=2, s=4),
+                         max_new_tokens=5)
+        assert out.shape == (2, 9)
+
+    def test_quantized_moe_decode_runs(self):
+        from paddle_operator_tpu.infer import quant as Q
+
+        model, cfg = make_model("tiny-moe", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        qparams = Q.quantize_params(params)
+        assert qparams["layers"]["moe"]["w1"]["q"].dtype == jnp.int8
+        out = D.generate(qparams, cfg, _prompt(cfg, b=2, s=4),
+                         max_new_tokens=3)
+        assert out.shape == (2, 7)
+
+    def test_dequantize_roundtrip_error_bounded(self, setup):
+        from paddle_operator_tpu.infer import quant as Q
+
+        _, cfg, params = setup
+        w = params["lm_head"]["kernel"]
+        q = Q.quantize_leaf(w)
+        back = np.asarray(Q.dequantize_leaf(q, jnp.float32))
+        w = np.asarray(w)
+        # per-channel absmax/127 quantization: error <= half a step
+        step = np.abs(w).max(axis=0, keepdims=True) / 127.0
+        assert (np.abs(back - w) <= 0.51 * step + 1e-8).all()
