@@ -110,10 +110,12 @@ def measure_llama(cfg, batch: int, seq: int, steps: int, warmup: int,
     }
 
 
-def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int) -> dict:
+def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int,
+                   quantize: bool = False) -> dict:
     """Greedy KV-cache decode throughput (infer/decode.py) for one config
     on the current device.  Decode is HBM-bandwidth-bound (every step
-    streams the full weights); tokens/s/chip is the serving headline."""
+    streams the full weights); tokens/s/chip is the serving headline, and
+    ``quantize`` measures the weight-only-int8 path (infer/quant.py)."""
     import jax
     import jax.numpy as jnp
 
@@ -123,6 +125,12 @@ def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int) -> dict:
     model = L.Llama(cfg)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 8), jnp.int32))["params"]
+    prefix = "decode"
+    if quantize:
+        from paddle_operator_tpu.infer.quant import quantize_params
+
+        params = quantize_params(params)
+        prefix = "decode_int8"
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, cfg.vocab_size, dtype=jnp.int32)
     gen = jax.jit(lambda p, t: D.generate(
@@ -135,10 +143,10 @@ def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int) -> dict:
     int(out[0, -1])
     dt = time.perf_counter() - t0
     return {
-        "decode_batch": batch, "prompt_len": prompt_len,
-        "new_tokens": new_tokens,
-        "decode_tok_per_sec": round(batch * new_tokens / dt, 1),
-        "decode_ms_per_token": round(dt / new_tokens * 1000, 2),
+        f"{prefix}_batch": batch, f"{prefix}_prompt_len": prompt_len,
+        f"{prefix}_new_tokens": new_tokens,
+        f"{prefix}_tok_per_sec": round(batch * new_tokens / dt, 1),
+        f"{prefix}_ms_per_token": round(dt / new_tokens * 1000, 2),
     }
 
 
@@ -235,6 +243,10 @@ def main() -> int:
             cfg_with(dim=2048, n_layers=8, n_heads=16, n_kv_heads=16,
                      ffn_dim=8192),
             batch=8, prompt_len=128, new_tokens=64))
+        decode.update(guarded("decode_int8", lambda: measure_decode(
+            cfg_with(dim=2048, n_layers=8, n_heads=16, n_kv_heads=16,
+                     ffn_dim=8192),
+            batch=8, prompt_len=128, new_tokens=64, quantize=True)))
     else:
         tiny = L.CONFIGS["tiny"]
         flagship = measure_llama(tiny, batch=4, seq=128, steps=3, warmup=1,
