@@ -266,11 +266,14 @@ def main() -> int:
         "sweep": sweep,
         **decode,
         **latency,
-        # end-to-end BASELINE latency: orchestration + compile/first step
-        "submit_to_first_step_s": round(
-            latency["submit_to_configmap_ms"] / 1000
-            + flagship["first_step_s"], 2),
     }
+    # end-to-end BASELINE latency: orchestration + compile/first step.
+    # guarded() may have replaced latency with {"latency_error": ...} —
+    # don't let the derived metric KeyError take down the primary line.
+    if "submit_to_configmap_ms" in latency:
+        detail["submit_to_first_step_s"] = round(
+            latency["submit_to_configmap_ms"] / 1000
+            + flagship["first_step_s"], 2)
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": flagship["tok_per_sec"],

@@ -49,6 +49,11 @@ class BatchBuffer:
     def next(self) -> Dict[str, np.ndarray]:
         item = self._q.get()
         if item is None:
+            # Re-arm the sentinel: every concurrent/subsequent reader
+            # (ThreadingHTTPServer threads, multiple TPU workers sharing
+            # this pod) must also observe exhaustion instead of blocking
+            # forever in Queue.get().
+            self._q.put(None)
             raise StopIteration
         with self._lock:
             self._count += 1

@@ -21,8 +21,9 @@ from __future__ import annotations
 
 import json
 import threading
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -34,12 +35,21 @@ from paddle_operator_tpu.models.llama import LlamaConfig
 
 
 class Generator:
-    """Jit-per-(shape, options) wrapper around decode.generate."""
+    """Jit-per-(shape, options) wrapper around decode.generate.
 
-    def __init__(self, params: Any, cfg: LlamaConfig) -> None:
+    The compile cache is a bounded LRU: a long-lived server facing
+    clients with varied shapes must not grow jitted programs (and XLA
+    compile state) without limit.  Evicted entries simply recompile on
+    next use."""
+
+    MAX_CACHED = 32
+
+    def __init__(self, params: Any, cfg: LlamaConfig,
+                 max_cached: int = MAX_CACHED) -> None:
         self.params = params
         self.cfg = cfg
-        self._fns: Dict[tuple, Any] = {}
+        self._fns: "OrderedDict[tuple, Any]" = OrderedDict()
+        self._max_cached = max_cached
         self._lock = threading.Lock()
 
     def __call__(self, tokens: np.ndarray, *, max_new_tokens: int,
@@ -57,6 +67,10 @@ class Generator:
                     temperature=temperature, top_k=top_k, top_p=top_p,
                     eos_token=eos_token, key=k))
                 self._fns[key] = fn
+                while len(self._fns) > self._max_cached:
+                    self._fns.popitem(last=False)
+            else:
+                self._fns.move_to_end(key)
         out = fn(self.params, jnp.asarray(tokens, jnp.int32),
                  jax.random.PRNGKey(seed))
         return np.asarray(out)

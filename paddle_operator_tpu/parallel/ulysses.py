@@ -22,9 +22,9 @@ across the axis); callers fall back to ring otherwise.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_operator_tpu.ops.attention import attention

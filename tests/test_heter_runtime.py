@@ -86,3 +86,18 @@ class TestLauncherDefaultProgram:
                             lambda: (called.setdefault("ran", True), 0)[1])
         assert launcher.main([]) == 0
         assert called.get("ran")
+
+
+class TestBatchBufferExhaustion:
+    def test_sentinel_rearmed_for_every_reader(self):
+        """Exhaustion must be observable by EVERY reader, not just the
+        first: concurrent ThreadingHTTPServer threads (or multiple TPU
+        workers sharing a heter pod) would otherwise block forever in
+        Queue.get() at end-of-data."""
+        from paddle_operator_tpu.heter.server import BatchBuffer
+
+        buf = BatchBuffer(iter([{"x": np.zeros(1)}]))
+        assert buf.next()["x"].shape == (1,)
+        for _ in range(3):                      # each raises, none blocks
+            with pytest.raises(StopIteration):
+                buf.next()

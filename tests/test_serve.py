@@ -77,3 +77,22 @@ class TestServe:
         # the server keeps working afterwards
         code, _ = _post(base, {"tokens": [[1, 2]], "max_new_tokens": 1})
         assert code == 200
+
+
+class TestGeneratorCacheBound:
+    def test_lru_eviction(self):
+        """The per-(shape, options) compile cache must stay bounded on a
+        long-lived server facing varied client shapes."""
+        from paddle_operator_tpu.infer.serve import Generator
+        from paddle_operator_tpu.models.llama import make_model
+
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        gen = Generator(params, cfg, max_cached=2)
+        for seq in (4, 5, 6):                   # three distinct shapes
+            gen(np.zeros((1, seq), np.int32), max_new_tokens=1)
+        assert len(gen._fns) == 2               # oldest evicted
+        # evicted shape recompiles and still works
+        out = gen(np.zeros((1, 4), np.int32), max_new_tokens=1)
+        assert out.shape == (1, 5)
