@@ -40,9 +40,13 @@ class BatchBuffer:
         self._lock = threading.Lock()
 
         def fill() -> None:
-            for batch in producer:
-                self._q.put(batch)
-            self._q.put(None)
+            # finally: a producer that RAISES (real corpus pipelines do)
+            # must still post the sentinel, or every reader blocks forever.
+            try:
+                for batch in producer:
+                    self._q.put(batch)
+            finally:
+                self._q.put(None)
 
         threading.Thread(target=fill, daemon=True).start()
 

@@ -101,3 +101,18 @@ class TestBatchBufferExhaustion:
         for _ in range(3):                      # each raises, none blocks
             with pytest.raises(StopIteration):
                 buf.next()
+
+    def test_raising_producer_still_posts_sentinel(self):
+        """A corpus pipeline that dies mid-stream must surface as
+        exhaustion, not hang every reader."""
+        from paddle_operator_tpu.heter.server import BatchBuffer
+
+        def bad_producer():
+            yield {"x": np.zeros(1)}
+            raise IOError("corpus gone")
+
+        buf = BatchBuffer(bad_producer())
+        assert buf.next()["x"].shape == (1,)
+        for _ in range(2):
+            with pytest.raises(StopIteration):
+                buf.next()
