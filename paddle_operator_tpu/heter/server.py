@@ -37,14 +37,22 @@ class BatchBuffer:
                  depth: int = 8) -> None:
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._count = 0
+        self._err: BaseException | None = None
         self._lock = threading.Lock()
 
         def fill() -> None:
             # finally: a producer that RAISES (real corpus pipelines do)
-            # must still post the sentinel, or every reader blocks forever.
+            # must still post the sentinel, or every reader blocks
+            # forever — but the error is kept so readers see a FAILURE,
+            # not a clean end-of-data.
             try:
                 for batch in producer:
                     self._q.put(batch)
+            except BaseException as e:
+                # swallowed here: the error reaches every reader via
+                # next() — re-raising would only spam the daemon thread's
+                # excepthook with a duplicate traceback
+                self._err = e
             finally:
                 self._q.put(None)
 
@@ -55,9 +63,12 @@ class BatchBuffer:
         if item is None:
             # Re-arm the sentinel: every concurrent/subsequent reader
             # (ThreadingHTTPServer threads, multiple TPU workers sharing
-            # this pod) must also observe exhaustion instead of blocking
+            # this pod) must also observe the outcome instead of blocking
             # forever in Queue.get().
             self._q.put(None)
+            if self._err is not None:
+                raise RuntimeError(
+                    f"batch producer failed: {self._err!r}") from self._err
             raise StopIteration
         with self._lock:
             self._count += 1
@@ -95,6 +106,9 @@ class _Handler(BaseHTTPRequestHandler):
                 batch = self.buffer.next()
             except StopIteration:
                 self._send(204)        # producer exhausted
+                return
+            except RuntimeError as e:  # producer died mid-stream
+                self._send(500, str(e).encode(), "text/plain")
                 return
             self._send(200, _npz_bytes(**batch))
         else:

@@ -42,9 +42,12 @@ def _rms(x: jax.Array, scale: jax.Array, eps: float, dtype) -> jax.Array:
 
 def _mm(x: jax.Array, kernel_leaf, dtype) -> jax.Array:
     """x @ kernel for a raw or weight-only-int8 kernel leaf
-    (infer/quant.py): quantized weights stream from HBM at half the
-    bytes; the per-output-channel scale applies after the matmul (valid
-    because the scale is constant along the contraction dim)."""
+    (infer/quant.py): the convert-then-dot form lets XLA fuse the
+    dequant into the dot's weight stream (measured fastest — see the
+    "what bounds int8" note in infer/quant.py; a hand-written pallas
+    dequant-in-register kernel LOST to this lowering at model level).
+    The per-output-channel scale applies after the matmul (valid because
+    the scale is constant along the contraction dim)."""
     if isinstance(kernel_leaf, dict) and "q" in kernel_leaf:
         out = x @ kernel_leaf["q"].astype(dtype)
         return out * kernel_leaf["s"][..., 0, :].astype(dtype)

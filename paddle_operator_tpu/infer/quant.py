@@ -12,6 +12,30 @@ infer/decode.py's matmul helper consumes either form, so all decode entry
 points (prefill / decode_step / generate / serve) work unchanged on
 quantized params.  Accuracy is config-dependent; tests bound the logit
 error on the tiny model.
+
+**What bounds the speedup** (measured, one v5e chip via axon, jax 0.9,
+dim-2048/L8/ffn-8192 model in bf16 serving dtype, greedy decode,
+steady-state ms/token via bench.py's two-length differencing — relay RTT
+and prefill cancel; e2e tok/s ratios are smaller because RTT is common):
+
+    batch  8: int8 ~1.4-1.5x over bf16   batch 32: ~1.1x   batch 64: ~1.1x
+
+not the ~2x the byte count suggests, because the int8→bf16 dequant feeding
+the MXU caps the weight stream at ~220 GB/s of int8 bytes while the plain
+bf16 stream runs ~340-400 GB/s (isolated-dot measurements) — past batch 8
+the dot is dequant/MXU-bound, not HBM-bound.  Alternatives measured and
+rejected on the same hardware:
+
+- a pallas dequant-in-register kernel (int8 tiles HBM→VMEM, convert on
+  the way into the MXU): ties bf16 on an isolated [8,2048]x[2048,8192]
+  dot (83 vs 84 us) but LOSES to XLA's fused astype-then-dot inside the
+  full decode step (2463 vs 2919 tok/s at batch 8);
+- a native int8xint8 ``dot_general`` with dynamic activation quant
+  (w8a8): 2x slower than bf16 (159 vs 75 us on the isolated dot) — the
+  MXU path here gains nothing from int8 operands;
+- scale folded as f32 after an f32 dot: within noise of astype-then-dot.
+
+At batch 64 the dot is MXU-compute-bound and int8 buys nothing.
 """
 
 from __future__ import annotations
@@ -48,6 +72,19 @@ def dequantize_leaf(leaf, dtype) -> jax.Array:
     if isinstance(leaf, dict) and "q" in leaf:
         return (leaf["q"].astype(dtype) * leaf["s"].astype(dtype))
     return leaf.astype(dtype)
+
+
+def serving_params(params: Dict[str, Any], dtype) -> Dict[str, Any]:
+    """Cast float leaves to the serving/compute dtype (normally bf16).
+
+    Training keeps f32 master params (train/trainer.py); serving them
+    directly would stream 4 bytes/param from HBM in the decode hot loop —
+    decode._mm converts at use, so storage dtype IS the streamed dtype.
+    Every serving entry point (bench, infer/serve.py) should cast once
+    up front.  Integer leaves (e.g. already-quantized int8) pass through."""
+    return jax.tree.map(
+        lambda x: x.astype(dtype)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
 
 
 def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:

@@ -102,9 +102,10 @@ class TestBatchBufferExhaustion:
             with pytest.raises(StopIteration):
                 buf.next()
 
-    def test_raising_producer_still_posts_sentinel(self):
-        """A corpus pipeline that dies mid-stream must surface as
-        exhaustion, not hang every reader."""
+    def test_raising_producer_surfaces_error_not_exhaustion(self):
+        """A corpus pipeline that dies mid-stream must surface as a
+        FAILURE to every reader — neither a hang nor a clean end-of-data
+        (which would end training early while looking successful)."""
         from paddle_operator_tpu.heter.server import BatchBuffer
 
         def bad_producer():
@@ -113,6 +114,6 @@ class TestBatchBufferExhaustion:
 
         buf = BatchBuffer(bad_producer())
         assert buf.next()["x"].shape == (1,)
-        for _ in range(2):
-            with pytest.raises(StopIteration):
+        for _ in range(2):                   # every reader, repeatedly
+            with pytest.raises(RuntimeError, match="corpus gone"):
                 buf.next()
