@@ -86,6 +86,8 @@ class TPUJobReconciler:
         # job key -> generation whose InvalidSpec event was already emitted
         # (dedupe; re-emitted once after controller restart, which is fine)
         self._invalid_warned: Dict[str, int] = {}
+        # job key -> generation whose ElasticParked event was already emitted
+        self._parked_warned: Dict[str, int] = {}
 
     # ------------------------------------------------------------------ API
 
@@ -118,10 +120,20 @@ class TPUJobReconciler:
         # -- elastic clamp (improvement 4) ---------------------------------
         # Runs before the status sync so ready ratios, completion checks and
         # gang sizing all use the effective (clamped) replica counts.
-        bounded = self._clamp_elastic(job)
+        bounded, parked = self._clamp_elastic(job)
+        if parked:
+            key = f"{namespace}/{name}"
+            if self._parked_warned.get(key) != job.generation:
+                self._parked_warned[key] = job.generation
+                self.api.record_event(
+                    raw, "Warning", "ElasticParked",
+                    "elastic limits clamp worker count below one whole TPU "
+                    "slice; job parked at 0 workers (raise worker.limits to "
+                    "a multiple of the slice size)",
+                )
 
         # -- status sync (reference controller.go:103-112) ----------------
-        new_status = self._current_status(job, child_pods, bounded)
+        new_status = self._current_status(job, child_pods, bounded, parked)
         if new_status.to_dict() != job.status.to_dict():
             job.status = new_status
             try:
@@ -309,6 +321,7 @@ class TPUJobReconciler:
                 self.allocator.release(int(port))
             self._adopted.pop(f"{job.namespace}/{job.name}", None)
             self._invalid_warned.pop(f"{job.namespace}/{job.name}", None)
+            self._parked_warned.pop(f"{job.namespace}/{job.name}", None)
             job.finalizers.remove(FINALIZER)
             try:
                 self.api.update(KIND_JOB, job.to_dict())
@@ -317,7 +330,8 @@ class TPUJobReconciler:
         return True
 
     def _current_status(self, job: TPUJob, child_pods: List[Dict[str, Any]],
-                        bounded: bool = False) -> TPUJobStatus:
+                        bounded: bool = False,
+                        parked: bool = False) -> TPUJobStatus:
         """Reference getCurrentStatus (controller.go:238-294)."""
         status = TPUJobStatus(
             restart_count=job.status.restart_count,
@@ -379,10 +393,16 @@ class TPUJobReconciler:
         if bounded:
             want = sum(r.replicas for r in
                        (job.spec.ps, job.spec.worker, job.spec.heter) if r)
-            status.elastic = (
-                ElasticStatus.DONE if len(child_pods) == want
-                else ElasticStatus.DOING
-            )
+            if parked:
+                # Slice-atomic snap-down zeroed the workers: the clamp is
+                # working as designed, but the user's job will never make
+                # progress — ERROR, not a quietly-converged DONE.
+                status.elastic = ElasticStatus.ERROR
+            else:
+                status.elastic = (
+                    ElasticStatus.DONE if len(child_pods) == want
+                    else ElasticStatus.DOING
+                )
 
         # phase/mode/times derive from the *new* counters
         probe = job.deepcopy()
@@ -392,6 +412,14 @@ class TPUJobReconciler:
         probe.status.completion_time = job.status.completion_time
         status.mode = builders.get_job_mode(job)
         status.phase = builders.get_job_phase(probe)
+        if (parked and status.phase == Phase.COMPLETED
+                and job.status.phase not in (Phase.COMPLETED, Phase.SUCCEED)):
+            # A parked job (clamped to 0 workers) has 0 replicas whose
+            # 0 succeeded pods would read as COMPLETED; it is actually
+            # waiting for the user to widen the elastic bounds.  A job
+            # that already finished (sticky COMPLETED) keeps its phase,
+            # as do in-flight RESTARTING/SCALING cycles.
+            status.phase = Phase.PENDING
         probe.status.phase = status.phase
         now = _now()
         status.start_time = builders.get_start_time(probe, now)
@@ -501,14 +529,19 @@ class TPUJobReconciler:
             pass
         return Result(requeue_after=1.0)
 
-    def _clamp_elastic(self, job: TPUJob) -> bool:
+    def _clamp_elastic(self, job: TPUJob) -> tuple:
         """Clamp each role's replicas into [requests, limits] on the
         in-memory job so every later computation (status, gang size,
         completion) uses the effective count; the stored spec keeps the
-        user's ask.  Returns whether any role is elastically bounded (the
-        DOING/DONE distinction is made in _current_status from observed
-        pod counts, so it converges instead of sticking at DOING)."""
+        user's ask.  Returns ``(bounded, parked)``: whether any role is
+        elastically bounded (the DOING/DONE distinction is made in
+        _current_status from observed pod counts, so it converges instead
+        of sticking at DOING), and whether the slice-atomicity snap-down
+        left a non-zero worker ask at 0 replicas (the job is parked — the
+        caller surfaces that as a Warning event + elastic ERROR instead of
+        leaving the user staring at a pod-less job)."""
         bounded = False
+        parked = False
         for role in (job.spec.ps, job.spec.worker, job.spec.heter):
             if role is None:
                 continue
@@ -530,7 +563,9 @@ class TPUJobReconciler:
                     continue
                 if wps > 1 and role.replicas % wps:
                     role.replicas -= role.replicas % wps
-        return bounded
+                    if role.replicas == 0:
+                        parked = True
+        return bounded, parked
 
     def _alloc_host_port(self, job: TPUJob) -> bool:
         """Annotate the job with a host-port block base (reference

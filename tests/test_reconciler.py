@@ -432,6 +432,30 @@ class TestSliceAtomicClamp:
         cm = api.get(KIND_CM, NS, "tj")
         assert cm["data"]["TPUJOB_NUM_SLICES"] == "1"
 
+    def test_parked_at_zero_workers_surfaces_error(self, env):
+        # limits=1 on a 2-worker slice snaps down to 0: the clamp is
+        # correct, but the user must be told why their job has no pods —
+        # a Warning event (once per generation) and elastic=ERROR
+        api, rec, fleet = env
+        submit(api, workers=4,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4, slice_count=2))
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["limits"] = 1
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        run_to_settled(rec, NS, "tj")
+        assert api.list_owned(KIND_POD, NS, "tj") == []
+        assert job_status(api).elastic == "ERROR"
+        parked = [e for e in api.events if e["reason"] == "ElasticParked"]
+        assert len(parked) == 1 and parked[0]["type"] == "Warning"
+        # raising the limit to a whole slice un-parks the job
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["limits"] = 2
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        assert len(api.list_owned(KIND_POD, NS, "tj")) == 2
+        assert job_status(api).elastic == "DONE"
+
 
 class TestScaleDownServices:
     def test_services_pruned_with_pods(self, env):
