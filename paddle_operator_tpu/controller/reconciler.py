@@ -86,8 +86,9 @@ class TPUJobReconciler:
         # job key -> generation whose InvalidSpec event was already emitted
         # (dedupe; re-emitted once after controller restart, which is fine)
         self._invalid_warned: Dict[str, int] = {}
-        # job key -> generation whose ElasticParked event was already emitted
-        self._parked_warned: Dict[str, int] = {}
+        # job key (or (key, "min")) -> generation whose ElasticParked /
+        # ElasticSliceClamp event was already emitted
+        self._parked_warned: Dict[Any, int] = {}
 
     # ------------------------------------------------------------------ API
 
@@ -120,17 +121,24 @@ class TPUJobReconciler:
         # -- elastic clamp (improvement 4) ---------------------------------
         # Runs before the status sync so ready ratios, completion checks and
         # gang sizing all use the effective (clamped) replica counts.
-        bounded, parked = self._clamp_elastic(job)
-        if parked:
-            key = f"{namespace}/{name}"
-            if self._parked_warned.get(key) != job.generation:
-                self._parked_warned[key] = job.generation
-                self.api.record_event(
-                    raw, "Warning", "ElasticParked",
-                    "elastic limits clamp worker count below one whole TPU "
-                    "slice; job parked at 0 workers (raise worker.limits to "
-                    "a multiple of the slice size)",
-                )
+        bounded, parked, below_min = self._clamp_elastic(job)
+        if job.status.phase in (Phase.COMPLETED, Phase.SUCCEED, Phase.FAILED):
+            # A finished job edited into a parking configuration is not
+            # broken — it stays terminal; don't brand it ERROR or warn.
+            parked = False
+        key = f"{namespace}/{name}"
+        if parked and self._parked_warned.get(key) != job.generation:
+            self._parked_warned[key] = job.generation
+            self.api.record_event(
+                raw, "Warning", "ElasticParked",
+                "elastic limits clamp worker count to 0; job parked "
+                "(raise worker.limits to a whole multiple of the TPU "
+                "slice size)",
+            )
+        if below_min and self._parked_warned.get((key, "min")) != job.generation:
+            self._parked_warned[(key, "min")] = job.generation
+            self.api.record_event(raw, "Warning", "ElasticSliceClamp",
+                                  below_min)
 
         # -- status sync (reference controller.go:103-112) ----------------
         new_status = self._current_status(job, child_pods, bounded, parked)
@@ -253,6 +261,16 @@ class TPUJobReconciler:
         if job.status.phase in (Phase.FAILED, Phase.COMPLETED):
             return Result()
 
+        # -- parked elastic job: create neither pods nor the rendezvous
+        #    ConfigMap.  Sealing an empty world would force a spurious
+        #    SCALING teardown cycle on un-park, and PS/heter pods for a
+        #    worker-less job would resolve envFrom against that empty CM.
+        #    Status (PENDING + elastic ERROR) and the ElasticParked event
+        #    were recorded above; teardown of any pre-park pods happened
+        #    in the scale-down / gang paths before this point. ------------
+        if parked:
+            return Result()
+
         # -- gang pod creation (improvement 1; reference creates one per
         #    pass, controller.go:176-208, PS-first ordering kept) ----------
         existing = {p["metadata"]["name"] for p in child_pods}
@@ -322,6 +340,8 @@ class TPUJobReconciler:
             self._adopted.pop(f"{job.namespace}/{job.name}", None)
             self._invalid_warned.pop(f"{job.namespace}/{job.name}", None)
             self._parked_warned.pop(f"{job.namespace}/{job.name}", None)
+            self._parked_warned.pop((f"{job.namespace}/{job.name}", "min"),
+                                    None)
             job.finalizers.remove(FINALIZER)
             try:
                 self.api.update(KIND_JOB, job.to_dict())
@@ -533,21 +553,30 @@ class TPUJobReconciler:
         """Clamp each role's replicas into [requests, limits] on the
         in-memory job so every later computation (status, gang size,
         completion) uses the effective count; the stored spec keeps the
-        user's ask.  Returns ``(bounded, parked)``: whether any role is
-        elastically bounded (the DOING/DONE distinction is made in
-        _current_status from observed pod counts, so it converges instead
-        of sticking at DOING), and whether the slice-atomicity snap-down
-        left a non-zero worker ask at 0 replicas (the job is parked — the
-        caller surfaces that as a Warning event + elastic ERROR instead of
-        leaving the user staring at a pod-less job)."""
+        user's ask.  Returns ``(bounded, parked, below_min)``:
+
+        - ``bounded``: any role is elastically bounded (the DOING/DONE
+          distinction is made in _current_status from observed pod
+          counts, so it converges instead of sticking at DOING);
+        - ``parked``: a non-zero worker ask ended at 0 effective replicas
+          — via the slice-atomicity snap-down OR an explicit limits=0 —
+          so the job cannot progress and 0-of-0 succeeded pods would
+          otherwise read as COMPLETED.  The caller surfaces this as a
+          Warning event + elastic ERROR + held PENDING phase instead of
+          leaving the user staring at a pod-less "Completed" job;
+        - ``below_min``: a warning message when the snap-down landed the
+          worker count under the user's declared ``requests`` floor (but
+          above 0) — the job runs, just below the contracted minimum."""
         bounded = False
         parked = False
+        below_min = None
         for role in (job.spec.ps, job.spec.worker, job.spec.heter):
             if role is None:
                 continue
             if role.requests is None and role.limits is None:
                 continue
             bounded = True
+            ask = role.replicas
             lo = role.requests if role.requests is not None else 0
             hi = role.limits if role.limits is not None else role.replicas
             role.replicas = min(max(role.replicas, lo), hi)
@@ -563,9 +592,14 @@ class TPUJobReconciler:
                     continue
                 if wps > 1 and role.replicas % wps:
                     role.replicas -= role.replicas % wps
-                    if role.replicas == 0:
-                        parked = True
-        return bounded, parked
+                    if 0 < role.replicas < lo:
+                        below_min = (
+                            f"slice-atomic clamp reduced workers to "
+                            f"{role.replicas}, below the declared "
+                            f"requests minimum of {lo}")
+            if role is job.spec.worker and ask > 0 and role.replicas == 0:
+                parked = True
+        return bounded, parked, below_min
 
     def _alloc_host_port(self, job: TPUJob) -> bool:
         """Annotate the job with a host-port block base (reference

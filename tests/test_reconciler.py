@@ -20,6 +20,7 @@ from paddle_operator_tpu.api import (
     TPUSpec,
 )
 from paddle_operator_tpu.api.types import HOSTPORT_ANNOTATION
+from paddle_operator_tpu.controller.api_client import NotFound
 from paddle_operator_tpu.controller.fake_api import FakeAPI, FakeFleet
 from paddle_operator_tpu.controller.hostport import PyHostPortAllocator
 from paddle_operator_tpu.controller.reconciler import (
@@ -455,6 +456,76 @@ class TestSliceAtomicClamp:
         drive(api, rec, fleet)
         assert len(api.list_owned(KIND_POD, NS, "tj")) == 2
         assert job_status(api).elastic == "DONE"
+
+    def test_parked_job_creates_no_configmap(self, env):
+        # sealing an empty world would force a spurious SCALING cycle on
+        # un-park — a parked job must leave the rendezvous CM uncreated
+        api, rec, fleet = env
+        submit(api, workers=4,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4, slice_count=2))
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["limits"] = 1
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        with pytest.raises(NotFound):
+            api.get(KIND_CM, NS, "tj")
+        # un-park: normal bring-up, no Scaling event from a stale empty CM
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["limits"] = 2
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        assert api.get(KIND_CM, NS, "tj")["data"]["TPUJOB_NUM_WORKERS"] == "2"
+        assert not any(e["reason"] == "Scaling" for e in api.events)
+
+    def test_explicit_limits_zero_parks_instead_of_completing(self, env):
+        # limits=0 lands exactly on 0 without the snap-down remainder;
+        # the job must still park (PENDING), not report Completed
+        api, rec, fleet = env
+        submit(api, workers=4,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4, slice_count=2))
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["limits"] = 0
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        run_to_settled(rec, NS, "tj")
+        st = job_status(api)
+        assert st.phase == Phase.PENDING
+        assert st.elastic == "ERROR"
+
+    def test_snap_below_requests_warns(self, env):
+        # requests=3 limits=3 on a 2-per-slice topology snaps to 2: the
+        # job runs, but below the user's contracted floor — warn once
+        api, rec, fleet = env
+        submit(api, workers=4,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4, slice_count=2))
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["requests"] = 3
+        raw["spec"]["worker"]["limits"] = 3
+        api.update(KIND_JOB, raw)
+        drive(api, rec, fleet)
+        run_to_settled(rec, NS, "tj")
+        assert len(api.list_owned(KIND_POD, NS, "tj")) == 2
+        clamped = [e for e in api.events if e["reason"] == "ElasticSliceClamp"]
+        assert len(clamped) == 1 and clamped[0]["type"] == "Warning"
+
+    def test_parking_edit_on_completed_job_keeps_it_terminal(self, env):
+        # a finished job later edited into a parking configuration stays
+        # Completed — no ElasticParked warning, no elastic ERROR branding
+        api, rec, fleet = env
+        submit(api, workers=4,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4, slice_count=2))
+        drive(api, rec, fleet)
+        fleet.succeed_all()
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.COMPLETED
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["limits"] = 1
+        api.update(KIND_JOB, raw)
+        run_to_settled(rec, NS, "tj")
+        st = job_status(api)
+        assert st.phase == Phase.COMPLETED
+        assert st.elastic != "ERROR"
+        assert not any(e["reason"] == "ElasticParked" for e in api.events)
 
 
 class TestScaleDownServices:
