@@ -14,13 +14,22 @@ only injects endpoints; the server itself ships with Paddle —
   bodies — no extra dependencies inside pods, human-debuggable with curl;
 - init is deterministic from ``(seed, table, shard)`` so a restarted PS
   pod regenerates identical *fresh* rows, and ``ensure``-style init is
-  idempotent for concurrently starting workers.
+  idempotent for concurrently starting workers;
+- **durability**: the shard periodically snapshots its tables (rows +
+  Adagrad accumulators) to ``checkpointPath`` and restores them on start,
+  so a restarted PS pod resumes *trained* state rather than fresh rows —
+  realizing the reference's "parameters periodically saved into
+  distributed file system" loop for the tier this repo now owns
+  (/root/reference/docs/design-fault-tolerant.md:19).  Snapshots are
+  atomic (tmp + rename) and per-shard files, so any subset of PS pods
+  can fail and restart independently.
 
 Endpoints (all under ``/v1``):
 
     POST /v1/init?table=T&vocab=V&dim=D[&seed=S]   create-if-absent
     POST /v1/pull?table=T      body npz{ids}    -> npz{rows}
     POST /v1/push?table=T&lr=L body npz{ids,grads}  apply row update
+    POST /v1/snapshot                              force a snapshot now
     GET  /healthz
 
 Run in a PS pod via the launcher shim (launch/launcher.py dispatches PS
@@ -31,6 +40,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import threading
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -84,6 +94,15 @@ class EmbeddingStore:
         self.shard, self.num_shards = shard, num_shards
         self.tables: Dict[str, Table] = {}
         self._lock = threading.Lock()
+        # one snapshot at a time: the periodic Snapshotter, /v1/snapshot
+        # handler threads and stop()'s final save would otherwise share a
+        # tmp file and publish interleaved bytes
+        self._save_lock = threading.Lock()
+        # push idempotency: request ids already applied (bounded FIFO) —
+        # a client retrying a push whose RESPONSE was lost must not
+        # double-apply the gradient
+        self._applied: "Dict[str, None]" = {}
+        self._applied_limit = 4096
 
     def ensure(self, name: str, vocab: int, dim: int, seed: int) -> Table:
         with self._lock:
@@ -101,6 +120,93 @@ class EmbeddingStore:
                     f"table {name} exists with vocab={t.vocab} dim={t.dim}")
             return t
 
+    # -- durability --------------------------------------------------------
+
+    def snapshot_file(self, checkpoint_path: str) -> str:
+        return os.path.join(checkpoint_path, f"ps-shard-{self.shard}.npz")
+
+    def save(self, checkpoint_path: str) -> str:
+        """Atomic per-shard snapshot: every table's rows + Adagrad state,
+        written tmp-then-rename so a crash mid-write never corrupts the
+        last good snapshot."""
+        os.makedirs(checkpoint_path, exist_ok=True)
+        arrays: Dict[str, np.ndarray] = {}
+        meta: Dict[str, Dict] = {}
+        with self._lock:
+            tables = dict(self.tables)
+        for name, t in tables.items():
+            with t.lock:
+                arrays[f"{name}/rows"] = t.rows.copy()
+                arrays[f"{name}/accum"] = t.accum.copy()
+            meta[name] = {"vocab": t.vocab, "dim": t.dim,
+                          "lo": t.lo, "hi": t.hi}
+        arrays["__meta__"] = np.frombuffer(
+            json.dumps({"shard": self.shard, "num_shards": self.num_shards,
+                        "tables": meta}).encode(), np.uint8)
+        final = self.snapshot_file(checkpoint_path)
+        tmp = f"{final}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with self._save_lock:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, final)
+        return final
+
+    def push_once(self, req_id: Optional[str], table: Table,
+                  ids: np.ndarray, grads: np.ndarray, lr: float) -> None:
+        """Apply a push at most once per request id (client retries may
+        re-deliver a push whose response was lost)."""
+        if req_id:
+            with self._lock:
+                if req_id in self._applied:
+                    return
+                self._applied[req_id] = None
+                while len(self._applied) > self._applied_limit:
+                    self._applied.pop(next(iter(self._applied)))
+        table.push(ids, grads, lr)
+
+    def restore(self, checkpoint_path: str) -> bool:
+        """Load the shard's snapshot if one exists.  Returns whether state
+        was restored.  A snapshot written by a different (shard,
+        num_shards) layout is ignored — after a PS-tier rescale the row
+        ranges moved, so resuming it would serve wrong rows."""
+        path = self.snapshot_file(checkpoint_path)
+        if not os.path.exists(path):
+            return False
+        data = dict(np.load(path))
+        meta = json.loads(bytes(data.pop("__meta__")).decode())
+        if (meta["shard"], meta["num_shards"]) != (self.shard,
+                                                   self.num_shards):
+            return False
+        with self._lock:
+            for name, m in meta["tables"].items():
+                t = Table.__new__(Table)
+                t.vocab, t.dim = m["vocab"], m["dim"]
+                t.lo, t.hi = m["lo"], m["hi"]
+                t.rows = data[f"{name}/rows"]
+                t.accum = data[f"{name}/accum"]
+                t.lock = threading.Lock()
+                self.tables[name] = t
+        return True
+
+
+class Snapshotter(threading.Thread):
+    """Background periodic snapshot loop; ``stop()`` writes a final one."""
+
+    def __init__(self, store: EmbeddingStore, checkpoint_path: str,
+                 interval_s: float) -> None:
+        super().__init__(daemon=True)
+        self.store, self.path, self.interval = store, checkpoint_path, interval_s
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.store.save(self.path)
+
+    def stop(self, final_snapshot: bool = True) -> None:
+        self._stop.set()
+        if final_snapshot:
+            self.store.save(self.path)
+
 
 def _read_npz(body: bytes) -> Dict[str, np.ndarray]:
     return dict(np.load(io.BytesIO(body)))
@@ -113,7 +219,8 @@ def _npz_bytes(**arrays) -> bytes:
 
 
 class _Handler(BaseHTTPRequestHandler):
-    store: EmbeddingStore  # injected by make_server
+    store: EmbeddingStore                  # injected by make_server
+    checkpoint_path: Optional[str] = None  # injected by make_server
 
     def log_message(self, *a):  # quiet
         pass
@@ -154,38 +261,74 @@ class _Handler(BaseHTTPRequestHandler):
             elif url.path == "/v1/push":
                 t = self.store.tables[q["table"]]
                 d = _read_npz(body)
-                t.push(d["ids"].astype(np.int64), d["grads"],
-                       float(q.get("lr", 0.01)))
+                self.store.push_once(q.get("req"), t,
+                                     d["ids"].astype(np.int64), d["grads"],
+                                     float(q.get("lr", 0.01)))
                 self._send(200, b"{}", "application/json")
+            elif url.path == "/v1/snapshot":
+                if not self.checkpoint_path:
+                    raise ValueError("server has no checkpointPath")
+                path = self.store.save(self.checkpoint_path)
+                self._send(200, json.dumps({"path": path}).encode(),
+                           "application/json")
             else:
                 self._send(404)
         except Exception as e:  # surface to the client, keep serving
             self._error(e)
 
 
-def make_server(host: str, port: int, shard: int,
-                num_shards: int) -> ThreadingHTTPServer:
+def make_server(host: str, port: int, shard: int, num_shards: int,
+                checkpoint_path: Optional[str] = None,
+                snapshot_interval_s: Optional[float] = None,
+                ) -> ThreadingHTTPServer:
+    """With ``checkpoint_path``: restore the shard's snapshot on start and
+    (when ``snapshot_interval_s``) keep snapshotting in the background.
+    The returned server carries ``.store``, ``.restored`` and
+    ``.snapshotter`` (None unless periodic) for callers that manage the
+    lifecycle (tests, the serve() entrypoint)."""
     store = EmbeddingStore(shard, num_shards)
-    handler = type("Handler", (_Handler,), {"store": store})
-    return ThreadingHTTPServer((host, port), handler)
+    restored = bool(checkpoint_path) and store.restore(checkpoint_path)
+    handler = type("Handler", (_Handler,),
+                   {"store": store, "checkpoint_path": checkpoint_path})
+    srv = ThreadingHTTPServer((host, port), handler)
+    srv.store = store
+    srv.restored = restored
+    srv.snapshotter = None
+    if checkpoint_path and snapshot_interval_s:
+        srv.snapshotter = Snapshotter(store, checkpoint_path,
+                                      snapshot_interval_s)
+        srv.snapshotter.start()
+    return srv
 
 
-def serve(port: int, shard: int, num_shards: int,
-          host: str = "0.0.0.0") -> None:
-    srv = make_server(host, port, shard, num_shards)
-    print(f"ps server: shard {shard}/{num_shards} on {host}:{port}",
-          flush=True)
-    srv.serve_forever()
+def serve(port: int, shard: int, num_shards: int, host: str = "0.0.0.0",
+          checkpoint_path: Optional[str] = None,
+          snapshot_interval_s: float = 30.0) -> None:
+    srv = make_server(host, port, shard, num_shards,
+                      checkpoint_path=checkpoint_path,
+                      snapshot_interval_s=(snapshot_interval_s
+                                           if checkpoint_path else None))
+    print(f"ps server: shard {shard}/{num_shards} on {host}:{port} "
+          f"(restored={srv.restored} "
+          f"checkpoint={checkpoint_path or 'none'})", flush=True)
+    try:
+        srv.serve_forever()
+    finally:
+        if srv.snapshotter is not None:
+            srv.snapshotter.stop()   # final snapshot on graceful exit
 
 
 def main() -> int:
     """PS-pod entrypoint: shard index / world come from the same env
-    contract the launcher parses (TPUJOB_ROLE_RANK, TPUJOB_PS_ENDPOINTS)."""
+    contract the launcher parses (TPUJOB_ROLE_RANK, TPUJOB_PS_ENDPOINTS);
+    durability rides TPUJOB_CHECKPOINT_PATH when the job sets one."""
     from paddle_operator_tpu.launch.launcher import JobEnv
 
     env = JobEnv.from_env()
     num = max(1, len(env.ps_endpoints))
-    serve(env.port, env.role_rank, num)
+    ckpt = (os.path.join(env.checkpoint_path, "ps")
+            if env.checkpoint_path else None)
+    serve(env.port, env.role_rank, num, checkpoint_path=ckpt)
     return 0
 
 

@@ -226,3 +226,178 @@ def test_one_ps_two_workers_train_wide_deep():
     finally:
         ps_proc.kill()
         ps_proc.wait()
+
+
+# --------------------------------------------------------------------------
+# Durability (VERDICT r4 item 4): snapshots, restore, client failover
+# --------------------------------------------------------------------------
+
+
+class TestDurability:
+    def test_snapshot_restore_preserves_trained_rows(self, tmp_path):
+        """A restarted PS shard must resume *trained* rows + Adagrad
+        state from its snapshot, not regenerate fresh ones."""
+        from paddle_operator_tpu.ps.server import make_server
+
+        ckpt = str(tmp_path)
+        port = _free_port()
+        srv = make_server("127.0.0.1", port, 0, 1, checkpoint_path=ckpt)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        client = PSClient([f"127.0.0.1:{port}"], retry_deadline_s=5.0)
+        client.ensure_table("t", 16, 4, seed=1)
+        ids = np.arange(8)
+        fresh = client.pull("t", ids)
+        client.push("t", ids, np.ones((8, 4), np.float32))
+        trained = client.pull("t", ids)
+        assert not np.allclose(fresh, trained)
+        client.snapshot()
+        srv.shutdown()
+        srv.server_close()
+
+        srv2 = make_server("127.0.0.1", port, 0, 1, checkpoint_path=ckpt)
+        assert srv2.restored
+        threading.Thread(target=srv2.serve_forever, daemon=True).start()
+        try:
+            client.ensure_table("t", 16, 4, seed=1)   # idempotent re-init
+            after = client.pull("t", ids)
+            np.testing.assert_array_equal(after, trained)
+            # Adagrad accumulators survived too: same push shrinks the
+            # update (denominator grew), instead of repeating it
+            client.push("t", ids, np.ones((8, 4), np.float32))
+            after2 = client.pull("t", ids)
+            step1 = np.abs(trained - fresh)
+            step2 = np.abs(after2 - after)
+            assert (step2 < step1).all()
+        finally:
+            srv2.shutdown()
+            client.close()
+
+    def test_mid_train_ps_restart_resumes_not_resets(self, tmp_path):
+        """Kill the PS mid-train, restart it from the snapshot: training
+        continues (client retries through the outage) and the loss keeps
+        improving from where it was — no fresh-row reset."""
+        from paddle_operator_tpu.models.wide_deep import make_model
+        from paddle_operator_tpu.ps.server import make_server
+        from paddle_operator_tpu.ps.wide_deep import PSTrainer, synthetic_batch
+
+        ckpt = str(tmp_path)
+        port = _free_port()
+        srv = make_server("127.0.0.1", port, 0, 1, checkpoint_path=ckpt,
+                          snapshot_interval_s=0.05)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        client = PSClient([f"127.0.0.1:{port}"], retry_deadline_s=10.0)
+        _, cfg = make_model("tiny")
+        tr = PSTrainer(cfg, client, seed=0)
+        batch = synthetic_batch(cfg, 64, seed=0)
+        first = [tr.train_step(batch) for _ in range(6)]
+        srv.snapshotter.stop()               # final snapshot
+        srv.shutdown()                       # preemption
+        srv.server_close()
+
+        def restart():
+            import time as _t
+            _t.sleep(0.5)                    # outage window
+            srv2 = make_server("127.0.0.1", port, 0, 1,
+                               checkpoint_path=ckpt)
+            assert srv2.restored
+            threading.Thread(target=srv2.serve_forever, daemon=True).start()
+            restart.srv = srv2
+
+        t = threading.Thread(target=restart)
+        t.start()
+        second = [tr.train_step(batch) for _ in range(6)]  # retries ride out
+        t.join()
+        try:
+            assert all(np.isfinite(l) for l in first + second)
+            assert first[-1] < first[0]
+            # resumed, not reset: post-restart losses continue from the
+            # trained state instead of jumping back to the fresh-init loss
+            assert second[0] < first[0]
+            assert second[-1] <= second[0]
+        finally:
+            restart.srv.shutdown()
+            client.close()
+
+    def test_snapshot_from_other_layout_is_ignored(self, tmp_path):
+        from paddle_operator_tpu.ps.server import EmbeddingStore
+
+        store = EmbeddingStore(0, 2)
+        store.ensure("t", 10, 4, seed=0)
+        store.save(str(tmp_path))
+        # same shard index, different world size -> ranges moved: refuse
+        other = EmbeddingStore(0, 3)
+        assert other.restore(str(tmp_path)) is False
+        same = EmbeddingStore(0, 2)
+        assert same.restore(str(tmp_path)) is True
+        assert same.tables["t"].rows.shape == (5, 4)
+
+    def test_periodic_snapshotter_writes_without_requests(self, tmp_path):
+        from paddle_operator_tpu.ps.server import EmbeddingStore, Snapshotter
+
+        store = EmbeddingStore(0, 1)
+        store.ensure("t", 8, 2, seed=0)
+        snap = Snapshotter(store, str(tmp_path), 0.02)
+        snap.start()
+        import time as _t
+        deadline = _t.monotonic() + 5.0
+        while (not os.path.exists(store.snapshot_file(str(tmp_path)))
+               and _t.monotonic() < deadline):
+            _t.sleep(0.01)
+        snap.stop()
+        assert os.path.exists(store.snapshot_file(str(tmp_path)))
+
+    def test_fail_fast_without_deadline(self):
+        client = PSClient([f"127.0.0.1:{_free_port()}"],
+                          retry_deadline_s=0.0)
+        with pytest.raises(RuntimeError, match="unreachable"):
+            client._call_shard(0, "/v1/init?table=t&vocab=4&dim=2", b"")
+        client.close()
+
+    def test_endpoint_reresolution_on_moved_shard(self, tmp_path):
+        """PodIP failover: the shard comes back at a NEW address; the
+        client re-resolves via endpoints_fn and the request succeeds."""
+        from paddle_operator_tpu.ps.server import make_server
+
+        srv = make_server("127.0.0.1", 0, 0, 1,
+                          checkpoint_path=str(tmp_path))
+        port1 = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        current = [f"127.0.0.1:{port1}"]
+        client = PSClient(list(current), retry_deadline_s=0.5,
+                          endpoints_fn=lambda: list(current))
+        client.ensure_table("t", 8, 2, seed=0)
+        client.push("t", np.arange(4), np.ones((4, 2), np.float32))
+        client.snapshot()
+        srv.shutdown()
+        # replacement pod: same shard, different port (new IP analogue)
+        srv2 = make_server("127.0.0.1", 0, 0, 1,
+                           checkpoint_path=str(tmp_path))
+        assert srv2.restored
+        port2 = srv2.server_address[1]
+        threading.Thread(target=srv2.serve_forever, daemon=True).start()
+        current[0] = f"127.0.0.1:{port2}"
+        try:
+            rows = client.pull("t", np.arange(4))   # old address dead
+            assert rows.shape == (4, 2)
+            assert client.endpoints == [f"127.0.0.1:{port2}"]
+        finally:
+            srv2.shutdown()
+            client.close()
+
+    def test_push_dedup_on_request_id(self):
+        """A retried push whose original was applied (response lost) must
+        not double-apply: the server dedups on the request id."""
+        from paddle_operator_tpu.ps.server import EmbeddingStore
+
+        store = EmbeddingStore(0, 1)
+        t = store.ensure("t", 8, 2, seed=0)
+        before = t.rows.copy()
+        ids = np.arange(4)
+        g = np.ones((4, 2), np.float32)
+        store.push_once("rid-1", t, ids, g, lr=0.1)
+        once = t.rows.copy()
+        store.push_once("rid-1", t, ids, g, lr=0.1)   # retry: no-op
+        np.testing.assert_array_equal(t.rows, once)
+        assert not np.allclose(once, before)
+        store.push_once("rid-2", t, ids, g, lr=0.1)   # new id applies
+        assert not np.allclose(t.rows, once)
