@@ -105,28 +105,39 @@ def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
 
-    # GQA: group query heads onto kv heads; single-query (or prefill-
-    # block) attention against the cache with a causal+fill mask.  The
-    # einsums read the cache in its storage dtype and accumulate in f32
-    # (preferred_element_type) — upcasting the cache itself would stream
-    # a full f32 copy of it from HBM every step, doubling the bandwidth
-    # of the decode hot loop.
-    n_rep = hq // hkv
-    max_len = k_cache.shape[1]
-    qg = q.reshape(b, t, hkv, n_rep, d)
-    # scores [B, T, Hkv, n_rep, max_len]; rows may attend cache cols up to
-    # their own absolute position (causal + cache-fill mask in one)
-    scores = jnp.einsum("bthrd,bshd->bthrs", qg, k_cache,
-                        preferred_element_type=jnp.float32) / jnp.sqrt(
-        jnp.float32(d))
-    cols = jnp.arange(max_len)                           # [S]
-    rows = pos + jnp.arange(t)                           # [T]
-    mask = cols[None, :] <= rows[:, None]                # [T, S]
-    scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bthrs,bshd->bthrd", probs.astype(cfg.dtype), v_cache,
-                     preferred_element_type=jnp.float32)
-    out = out.reshape(b, t, hq * d).astype(cfg.dtype)
+    if t == 1 and cfg.decode_attn != "xla":
+        # hot decode path: the pallas single-query kernel reads only the
+        # FILLED cache prefix (ops/decode_attention.py)
+        from paddle_operator_tpu.ops.decode_attention import decode_attention
+
+        out = decode_attention(
+            q[:, 0], k_cache, v_cache,
+            jnp.broadcast_to(pos + 1, (b,)),
+            interpret=(cfg.decode_attn == "pallas-interpret"))
+        out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+    else:
+        # GQA: group query heads onto kv heads; single-query (or prefill-
+        # block) attention against the cache with a causal+fill mask.  The
+        # einsums read the cache in its storage dtype and accumulate in f32
+        # (preferred_element_type) — upcasting the cache itself would
+        # stream a full f32 copy of it from HBM every step, doubling the
+        # bandwidth of the decode hot loop.
+        n_rep = hq // hkv
+        max_len = k_cache.shape[1]
+        qg = q.reshape(b, t, hkv, n_rep, d)
+        # scores [B, T, Hkv, n_rep, max_len]; rows may attend cache cols
+        # up to their own absolute position (causal + fill mask in one)
+        scores = jnp.einsum("bthrd,bshd->bthrs", qg, k_cache,
+                            preferred_element_type=jnp.float32) / jnp.sqrt(
+            jnp.float32(d))
+        cols = jnp.arange(max_len)                           # [S]
+        rows = pos + jnp.arange(t)                           # [T]
+        mask = cols[None, :] <= rows[:, None]                # [T, S]
+        scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bthrs,bshd->bthrd", probs.astype(cfg.dtype),
+                         v_cache, preferred_element_type=jnp.float32)
+        out = out.reshape(b, t, hq * d).astype(cfg.dtype)
     attn_out = _mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
 
     x = x + attn_out

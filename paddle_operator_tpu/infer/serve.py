@@ -10,11 +10,19 @@ transport discipline as the ps/ and heter/ tiers — no web framework).
        "temperature": 0.7, "top_k": 40, "top_p": 0.9, "eos_token": 2}
     -> {"tokens": [[...], ...]}   (prompt + continuation per row)
 
-Each distinct (batch, prompt-length, options) combination jits once and
-is cached — exact semantics always (no pad tokens entering the context).
-Production callers should bucket requests to a few prompt lengths to
-bound the compile set; this server is the framework's serving reference,
-not a batching scheduler.
+Two modes:
+
+- **batch mode** (:class:`Generator`): each distinct (batch,
+  prompt-length, options) combination jits once (bounded LRU) and whole
+  batches run synchronously — exact, simple, but staggered requests
+  serialize behind each other.
+- **continuous mode** (``make_server(..., continuous=True)``): requests
+  are admitted into a fixed ring of decode lanes sharing ONE resident
+  compiled step (infer/batcher.py) — staggered concurrent requests
+  decode side by side, lanes recycle on eos/budget, and the compile set
+  is fixed regardless of arrival pattern.  Per-request knobs:
+  max_new_tokens, temperature, seed, eos_token; top-k/top-p are
+  server-global statics of the resident program.
 """
 
 from __future__ import annotations
@@ -76,6 +84,42 @@ class Generator:
         return np.asarray(out)
 
 
+class ContinuousGenerator:
+    """Adapter giving the decode ring the Generator call surface: rows
+    of one HTTP request become independent ring requests (they may land
+    in different decode waves), and the call blocks until all rows
+    finish.  Concurrent HTTP threads interleave in the ring — that is
+    the point."""
+
+    def __init__(self, params: Any, cfg: LlamaConfig, **ring_kw) -> None:
+        from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+
+        self.batcher = ContinuousBatcher(params, cfg, **ring_kw)
+        self.cfg = cfg
+
+    def __call__(self, tokens: np.ndarray, *, max_new_tokens: int,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_token: Optional[int] = None,
+                 seed: int = 0) -> list:
+        if (top_k, top_p) != (self.batcher._top_k, self.batcher._top_p) \
+                and (top_k is not None or top_p is not None):
+            raise ValueError(
+                "top_k/top_p are fixed per continuous server "
+                f"(configured: top_k={self.batcher._top_k} "
+                f"top_p={self.batcher._top_p})")
+        reqs = [self.batcher.submit(
+                    row, max_new_tokens=max_new_tokens,
+                    temperature=temperature, seed=seed + i,
+                    eos_token=eos_token)
+                for i, row in enumerate(tokens)]
+        # ragged rows: sequences stop at eos, so no rectangular array
+        return [r.result(timeout=600) for r in reqs]
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator  # injected
 
@@ -114,16 +158,26 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=req.get("top_p"),
                 eos_token=req.get("eos_token"),
                 seed=int(req.get("seed", 0)))
-            self._send(200, {"tokens": out.tolist()})
+            out = out if isinstance(out, list) else out.tolist()
+            self._send(200, {"tokens": out})
         except Exception as e:
             self._send(400, {"error": str(e)})
 
 
-def make_server(host: str, port: int, params: Any,
-                cfg: LlamaConfig) -> ThreadingHTTPServer:
-    gen = Generator(params, cfg)
+def make_server(host: str, port: int, params: Any, cfg: LlamaConfig,
+                *, continuous: bool = False,
+                **ring_kw) -> ThreadingHTTPServer:
+    """``continuous=True`` serves through the decode ring
+    (infer/batcher.py; ``ring_kw``: slots, max_len, chunk_tokens,
+    prefill_buckets, top_k, top_p).  The returned server carries
+    ``.generator`` — call its ``close()`` when tearing a continuous
+    server down to stop the ring thread."""
+    gen = (ContinuousGenerator(params, cfg, **ring_kw) if continuous
+           else Generator(params, cfg))
     handler = type("Handler", (_Handler,), {"generator": gen})
-    return ThreadingHTTPServer((host, port), handler)
+    srv = ThreadingHTTPServer((host, port), handler)
+    srv.generator = gen
+    return srv
 
 
 def main() -> int:
@@ -162,11 +216,22 @@ def main() -> int:
         from paddle_operator_tpu.infer.quant import quantize_params
 
         params = quantize_params(params)   # ~1.4-1.5x decode at batch 8
+    # opt-in: continuous mode fixes top_k/top_p server-side, so flipping
+    # it on by default would 400 existing clients that pass them
+    continuous = os.environ.get("SERVE_CONTINUOUS", "0") == "1"
+    ring_kw = {}
+    if continuous:
+        ring_kw = {"slots": int(os.environ.get("SERVE_SLOTS", "8")),
+                   "chunk_tokens": int(os.environ.get("SERVE_CHUNK", "8"))}
+        if os.environ.get("SERVE_MAX_LEN"):
+            ring_kw["max_len"] = int(os.environ["SERVE_MAX_LEN"])
     print(f"serving {os.environ.get('MODEL_PRESET', '7b')} "
           f"(resumed={resumed}, "
-          f"quantize={os.environ.get('QUANTIZE', 'off')}) on :{env.port}",
+          f"quantize={os.environ.get('QUANTIZE', 'off')}, "
+          f"mode={'continuous' if continuous else 'batch'}) on :{env.port}",
           flush=True)
-    srv = make_server("0.0.0.0", env.port, params, cfg)
+    srv = make_server("0.0.0.0", env.port, params, cfg,
+                      continuous=continuous, **ring_kw)
     srv.serve_forever()
     return 0
 

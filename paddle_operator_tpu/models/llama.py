@@ -61,6 +61,13 @@ class LlamaConfig:
     n_experts: int = 0
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Single-query attention implementation for the DECODE path
+    # (infer/decode.py, infer/batcher.py; training is untouched):
+    # "xla" (dense einsum over the full allocated cache), "pallas"
+    # (ops/decode_attention.py — reads only the FILLED prefix; the
+    # long-context serving kernel), "pallas-interpret" (same kernel in
+    # interpreter mode — CPU tests).
+    decode_attn: str = "xla"
 
     @property
     def head_dim(self) -> int:
