@@ -37,7 +37,6 @@ from __future__ import annotations
 
 import queue
 import threading
-from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -386,7 +385,10 @@ class ContinuousBatcher:
         self._lane_out[slot] = [first]
         self._lane_left[slot] = req.max_new - 1
         self.stats["admitted"] += 1
-        if req.eos is not None and first == req.eos:
+        if self._lane_left[slot] <= 0 or (req.eos is not None
+                                          and first == req.eos):
+            # done at admission (budget 1 or immediate eos): free the
+            # lane now instead of riding a wasted chunk
             self._evict(slot)
 
     def _evict(self, slot: int) -> None:

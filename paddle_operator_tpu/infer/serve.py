@@ -160,8 +160,14 @@ class _Handler(BaseHTTPRequestHandler):
                 seed=int(req.get("seed", 0)))
             out = out if isinstance(out, list) else out.tolist()
             self._send(200, {"tokens": out})
-        except Exception as e:
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
             self._send(400, {"error": str(e)})
+        except Exception as e:
+            # server-side failure (dead decode ring, generation timeout):
+            # 503 tells clients to retry/fail over, not to blame their
+            # request
+            self._send(503, {"error": str(e)})
 
 
 def make_server(host: str, port: int, params: Any, cfg: LlamaConfig,

@@ -76,11 +76,7 @@ class TestRingEquivalence:
             first.append(int(logits.argmax()))
         assert first == [r[0] for r in refs]     # prefill logits agree
 
-        step = make_chunk_step(cfg, 1)
         tok = jnp.asarray(first, jnp.int32)
-        temp = jnp.zeros((3,), jnp.float32)
-        keys = jnp.zeros((3, 2), jnp.uint32)
-        active = jnp.ones((3,), bool)
         from paddle_operator_tpu.infer.batcher import _ring_forward
         ring_logits, _ = _ring_forward(cfg, params, tok, cache)
         for i in range(3):
