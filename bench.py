@@ -50,9 +50,12 @@ def peak_flops_for(device) -> float:
 
 
 def measure_llama(cfg, batch: int, seq: int, steps: int, warmup: int,
-                  peak: float) -> dict:
+                  peak: float, offload_opt_state: bool = False) -> dict:
     """Train-step throughput for one config on the current default device.
-    Returns tok/s, MFU, first-step (compile+run) seconds, loss."""
+    Returns tok/s, MFU, first-step (compile+run) seconds, loss.
+    ``offload_opt_state`` parks the AdamW moments in host memory
+    (trainer.state_shardings) — what lets dim-4096 run at real depth on
+    one chip instead of OOMing on 2x-params f32 moments."""
     import jax.numpy as jnp
 
     from paddle_operator_tpu.models import llama as L
@@ -67,8 +70,10 @@ def measure_llama(cfg, batch: int, seq: int, steps: int, warmup: int,
     # the seq short so init stays within the RoPE table (seq+1 would not).
     example = (jnp.zeros((batch, 8), jnp.int32),)
 
-    shardings, _ = T.state_shardings(model, opt, mesh, pats, example)
-    state = T.create_state(model, opt, mesh, pats, example)
+    shardings, _ = T.state_shardings(model, opt, mesh, pats, example,
+                                     offload_opt_state=offload_opt_state)
+    state = T.create_state(model, opt, mesh, pats, example,
+                           offload_opt_state=offload_opt_state)
     step = T.make_train_step(model, opt, mesh, shardings)
 
     batches = [T.synthetic_batch(batch, seq + 1, cfg.vocab_size, seed=i)
@@ -107,6 +112,7 @@ def measure_llama(cfg, batch: int, seq: int, steps: int, warmup: int,
         "step_time_s": round(dt / steps, 4),
         "first_step_s": round(first_step_s, 2),
         "loss": round(loss_val, 4),
+        **({"offload_opt_state": True} if offload_opt_state else {}),
     }
 
 
@@ -115,8 +121,8 @@ HBM_GBPS = 819.0
 
 
 def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int,
-                   quantize: bool = False, params=None, repeats: int = 3
-                   ) -> dict:
+                   quantize: bool = False, params=None, repeats: int = 3,
+                   cache_len: int = None) -> dict:
     """Greedy KV-cache decode throughput (infer/decode.py) for one config
     on the current device.  Decode is memory-bound (every step streams
     the full weights + the KV cache); tokens/s/chip is the serving
@@ -136,10 +142,12 @@ def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int,
 
     Reports ``hbm_util``: (weight + KV-cache bytes per step) / step time
     as a fraction of the chip's peak HBM bandwidth — how close the decode
-    loop runs to its memory-bound roofline.  Cache bytes use the FULL
-    allocated cache length: the masked attention einsums contract over
-    the whole buffer every step (decode.py _layer), not just the filled
-    prefix."""
+    loop runs to its memory-bound roofline.  Cache bytes depend on the
+    attention impl: the XLA einsum path contracts over the FULL allocated
+    buffer every step (decode.py _layer), while the pallas kernel
+    (cfg.decode_attn="pallas", ops/decode_attention.py) fetches only the
+    filled prefix — its estimate uses the mean filled length over the
+    differenced step window."""
     import jax
     import jax.numpy as jnp
 
@@ -165,7 +173,9 @@ def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int,
     prompt = jax.random.randint(jax.random.PRNGKey(1), (batch, prompt_len),
                                 0, cfg.vocab_size, dtype=jnp.int32)
     n_small = max(new_tokens // 4, 1)
-    max_len = prompt_len + new_tokens    # same cache size for BOTH calls
+    # cache_len > prompt+new models the serving ring: a mostly-empty
+    # long cache, where the pallas filled-prefix kernel earns its keep
+    max_len = cache_len or (prompt_len + new_tokens)
     gen = jax.jit(lambda p, t: D.generate(
         p, cfg, t, max_new_tokens=new_tokens, max_len=max_len))
     gen_small = jax.jit(lambda p, t: D.generate(
@@ -201,12 +211,20 @@ def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int,
         quantized_frac = qcount / n_params
     else:
         weight_bytes = n_params * bpe
-    cache_bytes = (2 * cfg.n_layers * batch * max_len
+    if cfg.decode_attn == "xla":
+        streamed_len = max_len
+    else:
+        # pallas kernel reads only the filled prefix; the differenced
+        # steps span fills prompt+n_small .. prompt+new_tokens
+        streamed_len = prompt_len + (n_small + new_tokens) / 2
+    cache_bytes = (2 * cfg.n_layers * batch * streamed_len
                    * cfg.n_kv_heads * cfg.head_dim * bpe)
     hbm_util = (weight_bytes + cache_bytes) / step_s / (HBM_GBPS * 1e9)
     result = {
         f"{prefix}_batch": batch, f"{prefix}_prompt_len": prompt_len,
         f"{prefix}_new_tokens": new_tokens,
+        f"{prefix}_cache_len": max_len,
+        f"{prefix}_attn": cfg.decode_attn,
         f"{prefix}_tok_per_sec": round(batch * new_tokens / dt, 1),
         f"{prefix}_ms_per_token": round(step_s * 1000, 2),
         f"{prefix}_hbm_util": round(hbm_util, 3),
@@ -214,6 +232,47 @@ def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int,
     if quantize:
         result[f"{prefix}_quantized_frac"] = round(quantized_frac, 3)
     return result
+
+
+def measure_ring_throughput(cfg, params, *, slots: int, requests: int,
+                            prompt_len: int, new_tokens: int,
+                            max_len: int, chunk: int = 16) -> dict:
+    """Served throughput through the continuous-batching decode ring
+    (infer/batcher.py) under saturation: `requests` concurrent clients
+    over `slots` lanes.  The VERDICT r3 item-5 'done' bar is served
+    throughput within ~20% of the raw decode bench at the same batch —
+    this measures it as artifact data.  Includes admission (bucketed
+    prefill) and the per-chunk host round-trip, so it is an END-TO-END
+    serving number, not a steady-state step time."""
+    import numpy as np
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+
+    b = ContinuousBatcher(params, cfg, slots=slots, max_len=max_len,
+                          chunk_tokens=chunk,
+                          prefill_buckets=(prompt_len,))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+               for _ in range(requests)]
+    try:
+        # warmup: compile prefill + the resident chunk step
+        b.submit(prompts[0], max_new_tokens=chunk).result(timeout=600)
+        warm_chunks = b.stats["chunks"]     # exclude warmup from stats
+        t0 = time.perf_counter()
+        reqs = [b.submit(p, max_new_tokens=new_tokens) for p in prompts]
+        outs = [r.result(timeout=600) for r in reqs]
+        dt = time.perf_counter() - t0
+    finally:
+        b.close()
+    generated = sum(len(o) - prompt_len for o in outs)
+    return {
+        "ring_slots": slots, "ring_requests": requests,
+        "ring_prompt_len": prompt_len, "ring_new_tokens": new_tokens,
+        "ring_chunk": chunk,
+        "ring_tok_per_sec": round(generated / dt, 1),
+        "ring_max_active": b.stats["max_active"],
+        "ring_chunks": b.stats["chunks"] - warm_chunks,
+    }
 
 
 def measure_submit_latency() -> dict:
@@ -267,6 +326,7 @@ def measure_submit_latency() -> dict:
 
 def main() -> int:
     import jax
+    import jax.numpy as jnp
 
     from paddle_operator_tpu.models import llama as L
 
@@ -296,6 +356,15 @@ def main() -> int:
         # sweep: the round-2 comment as data, plus TRUE 7B width (dim 4096,
         # ffn 11008, 32 heads) at the depth that fits with optimizer state
         sweep = [
+            # dim-1024 sweeps ~0.33 MFU — expected, not a regression: at
+            # ffn 4096 the MLP matmuls are 1024-wide GEMMs whose K dim
+            # underfills the 128x128 MXU pipeline relative to launch +
+            # HBM-stream overheads, and the per-layer weights are small
+            # enough that weight streaming (not compute) paces the step;
+            # the flash-attention q512/k512 tiles also leave less
+            # fusion headroom at head_dim 64.  Wider shapes amortize all
+            # three, which is why MFU climbs monotonically with dim in
+            # this sweep.
             guarded("sweep", lambda: measure_llama(
                 cfg_with(dim=1024, n_layers=16, n_heads=16,
                          n_kv_heads=16, ffn_dim=4096),
@@ -304,6 +373,27 @@ def main() -> int:
                 cfg_with(dim=4096, n_layers=2, n_heads=32,
                          n_kv_heads=32, ffn_dim=11008),
                 batch=8, seq=2048, steps=5, warmup=2, peak=peak)),
+            # 7B width at DEPTH (VERDICT r3 weak #3): AdamW moments
+            # parked in host memory so 8 layers of dim-4096 fit one
+            # chip — per-layer MFU at depth measured, not extrapolated
+            # from the 2-layer proxy above.  Master weights are bf16
+            # here: f32 masters + f32 grads alone are 15.2 GiB at this
+            # shape (measured OOM), so no moment placement can rescue
+            # f32 — bf16 weights + host-resident moments (first moment
+            # f32 via mu_dtype, second in the param dtype) is the
+            # single-chip depth recipe.
+            guarded("sweep", lambda: measure_llama(
+                cfg_with(dim=4096, n_layers=8, n_heads=32,
+                         n_kv_heads=32, ffn_dim=11008,
+                         param_dtype=jnp.bfloat16),
+                batch=8, seq=2048, steps=5, warmup=2, peak=peak,
+                offload_opt_state=True)),
+            guarded("sweep", lambda: measure_llama(
+                cfg_with(dim=4096, n_layers=12, n_heads=32,
+                         n_kv_heads=32, ffn_dim=11008,
+                         param_dtype=jnp.bfloat16),
+                batch=8, seq=2048, steps=5, warmup=2, peak=peak,
+                offload_opt_state=True)),
         ]
         # decode: bf16 + int8 at the headline point (batch 8), plus a
         # batch sweep and long-context points so ms/token vs batch and
@@ -338,14 +428,38 @@ def main() -> int:
             decode.update(guarded("decode_int8", lambda: measure_decode(
                 dcfg, batch=8, prompt_len=128, new_tokens=192,
                 quantize=True, params=dqparams)))
+            import dataclasses as _dc
+
+            pcfg = _dc.replace(dcfg, decode_attn="pallas")
             decode_sweep = [
-                guarded("decode_sweep", lambda b=b, p=p, q=q: measure_decode(
-                    dcfg, batch=b, prompt_len=p, new_tokens=192,
-                    quantize=q, params=dqparams if q else dparams))
-                for b, p, q in [(32, 128, False), (32, 128, True),
-                                (64, 128, False), (64, 128, True),
-                                (8, 1024, False), (8, 2048, False)]
+                guarded("decode_sweep", lambda b=b, p=p, q=q, c=c, cl=cl:
+                        measure_decode(
+                    c, batch=b, prompt_len=p, new_tokens=192,
+                    quantize=q, params=dqparams if q else dparams,
+                    cache_len=cl))
+                for b, p, q, c, cl in [
+                    (32, 128, False, dcfg, None), (32, 128, True, dcfg, None),
+                    (64, 128, False, dcfg, None), (64, 128, True, dcfg, None),
+                    # long context, cache ~full: einsum's regime
+                    (8, 1024, False, dcfg, None), (8, 2048, False, dcfg, None),
+                    (8, 2048, False, pcfg, None),
+                    # long cache ~6% filled (the serving ring's regime):
+                    # the pallas filled-prefix kernel vs the einsum that
+                    # must read the whole allocation
+                    (8, 128, False, dcfg, 2240), (8, 128, False, pcfg, 2240),
+                ]
             ]
+            # served throughput through the continuous-batching ring,
+            # saturated (2x requests per lane), vs the raw decode bench
+            # at the same batch (the decode_batch=8 entry above).
+            # chunk=48: the axon relay adds ~100-250 ms RTT per host
+            # round-trip, so the bench amortizes it over a larger chunk
+            # than a real deployment would need (8-16 on direct-attached
+            # chips).
+            decode_sweep.append(guarded(
+                "ring", lambda: measure_ring_throughput(
+                    dcfg, dparams, slots=8, requests=16, prompt_len=128,
+                    new_tokens=192, max_len=2240, chunk=48)))
     else:
         tiny = L.CONFIGS["tiny"]
         flagship = measure_llama(tiny, batch=4, seq=128, steps=3, warmup=1,

@@ -6,7 +6,7 @@ behind each other.  This module is the serving scheduler that fixes
 that, TPU-style:
 
 - **One resident compiled step.** A fixed ring of ``slots`` decode
-  lanes shares a single KV cache ``[L, slots, max_len, H_kv, D]`` and
+  lanes shares a single KV cache ``[L, slots, H_kv, max_len, D]`` and
   ONE jitted multi-token decode step (a ``lax.scan`` over
   ``chunk_tokens`` ticks).  No per-request compiles in the decode loop,
   ever — shapes are static regardless of arrival pattern.
@@ -55,12 +55,12 @@ from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
 
 def init_ring_cache(cfg: LlamaConfig, slots: int,
                     max_len: int) -> Dict[str, jax.Array]:
-    """KV ring: like decode.init_cache but with a per-lane fill position
-    vector instead of one scalar."""
+    """KV ring: like decode.init_cache (same head-major layout) but with
+    a per-lane fill position vector instead of one scalar."""
     if max_len > cfg.max_seq_len:
         raise ValueError(f"max_len {max_len} exceeds the RoPE table "
                          f"(cfg.max_seq_len={cfg.max_seq_len})")
-    shape = (cfg.n_layers, slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, slots, cfg.n_kv_heads, max_len, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -70,9 +70,9 @@ def init_ring_cache(cfg: LlamaConfig, slots: int,
 
 def _write_lane(cache_l: jax.Array, kv: jax.Array,
                 pos: jax.Array) -> jax.Array:
-    """[B, S, H, D] cache layer <- [B, 1, H, D] new row at per-lane pos."""
+    """[B, H, S, D] cache layer <- [B, H, 1, D] new row at per-lane pos."""
     return jax.vmap(
-        lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (p, 0, 0))
+        lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (0, p, 0))
     )(cache_l, kv, pos)
 
 
@@ -104,8 +104,8 @@ def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
             axis=-1).astype(t.dtype)
 
     q, k = rot(q), rot(k)
-    k_cache = _write_lane(k_cache, k, pos)
-    v_cache = _write_lane(v_cache, v, pos)
+    k_cache = _write_lane(k_cache, k.transpose(0, 2, 1, 3), pos)
+    v_cache = _write_lane(v_cache, v.transpose(0, 2, 1, 3), pos)
 
     if cfg.decode_attn != "xla":
         from paddle_operator_tpu.ops.decode_attention import decode_attention
@@ -116,16 +116,16 @@ def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
         out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
     else:
         n_rep = hq // hkv
-        max_len = k_cache.shape[1]
+        max_len = k_cache.shape[2]
         qg = q.reshape(b, 1, hkv, n_rep, d)
-        scores = jnp.einsum("bthrd,bshd->bthrs", qg, k_cache,
+        scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
                             preferred_element_type=jnp.float32) / jnp.sqrt(
             jnp.float32(d))
         # lane b may attend cache cols [0, pos_b] (its own new row incl.)
         mask = jnp.arange(max_len)[None, :] <= pos[:, None]      # [B, S]
         scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bthrs,bshd->bthrd", probs.astype(cfg.dtype),
+        out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
                          v_cache, preferred_element_type=jnp.float32)
         out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
     x = x + D._mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)

@@ -1,0 +1,200 @@
+"""Single-query (decode) attention over the KV cache — pallas TPU kernel.
+
+VERDICT r3 item 3: training attention is a tuned flash kernel
+(ops/pallas_attention.py) but decode ran XLA einsums over the FULL
+cache.  At serving-realistic contexts the decode hot loop is bound by
+reading the KV cache from HBM, and the XLA path reads all ``max_len``
+allocated positions every step no matter how few are filled.
+
+This kernel makes decode cost proportional to the FILLED context:
+
+- **Grid** ``(B, key-blocks)`` with the per-lane fill length as a
+  scalar-prefetch operand, so the kernel's *index map* — not just its
+  compute — depends on it: key blocks past the lane's fill length are
+  remapped to the last live block.  Pallas/Mosaic skips the DMA when a
+  block window repeats, so unfilled cache tail blocks are never fetched
+  — the bandwidth win XLA cannot express with a dense einsum (it would
+  need dynamic shapes).
+- **Head-major cache layout** ``[B, H_kv, S, D]`` (the decode caches
+  are stored this way, infer/decode.py init_cache): each grid cell
+  reads one CONTIGUOUS ``[block_k, D]`` tile for its kv head.  The
+  token-major layout was measured 0.64x vs XLA at long fill — Mosaic
+  relayouts every strided per-head slice; head-major makes the block
+  the natural DMA unit and the per-cell work a single grouped matmul.
+- **Online softmax** accumulation in f32 VMEM scratch, cache tiles read
+  in storage dtype (bf16 native MXU rate), same discipline as the
+  training kernel; GQA queries of one kv head form the [n_rep, D] tile
+  of the grouped matmul — the repeat is never materialized.
+- Per-lane lengths [B] serve both decode.py (scalar position broadcast)
+  and the continuous-batching ring (infer/batcher.py, ragged lanes).
+
+Equivalence is pinned against the XLA einsum path by
+tests/test_decode_attention.py (interpret mode on CPU is exact).
+Compiled on TPU, kernel and einsum logits agree only to MXU rounding
+(~1e-2 on f32 standard-normal logits — both paths multiply in bf16 on
+the MXU but round differently), so greedy generations may diverge at
+near-tie argmax positions; that is cross-implementation fp behavior,
+not an error.
+
+Measured (v5e, dim-2048/L8 model, batch 8, steady-state ms/token by the
+bench.py differencing method):  at 6%-filled cache (prompt 128 in a
+2240-slot cache — the continuous-batching ring's regime) the kernel is
+**1.15x faster** than the XLA einsum; at a fully-filled cache (prompt
+2048/2240) it is 0.69x — there is nothing to skip and the einsum's
+fusion wins.  Hence ``decode_attn`` defaults to "xla"; enable "pallas"
+for ring serving with long max_len and typical prompts well short of
+it.  (Three layouts were measured to get here: token-major per-head
+strided slices 0.64x, per-head grid cells 0.42x — 1152 tiny cells/layer
+drown in cell overhead — and this few-cells head-major form.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_K = 256
+
+
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+            *, scale: float, block_k: int, n_rep: int):
+    b = pl.program_id(0)
+    ik, nk = pl.program_id(1), pl.num_programs(1)
+    length = len_ref[b]
+    hkv = k_ref.shape[1]
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Blocks at/after the fill boundary were index-remapped to the last
+    # live block (no new DMA); their compute is skipped outright.
+    @pl.when(ik * block_k < length)
+    def _compute():
+        cols = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (n_rep, block_k), 1)
+        live = cols < length
+        # static head unroll; every slice below is on a LEADING dim of a
+        # head-major tile, i.e. contiguous — no Mosaic relayouts
+        for h in range(hkv):
+            q = q_ref[0, h]                        # [n_rep, D]
+            k = k_ref[0, h]                        # [block_k, D]
+            v = v_ref[0, h]                        # [block_k, D]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            s = jnp.where(live, s, NEG_INF)
+
+            m_prev = m_ref[h, :n_rep, :1]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(s, axis=-1, keepdims=True))
+            corr = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)                 # [n_rep, block_k]
+            l_ref[h, :n_rep, :] = jnp.broadcast_to(
+                l_ref[h, :n_rep, :1] * corr
+                + jnp.sum(p, axis=-1, keepdims=True),
+                (n_rep, l_ref.shape[2]))
+            acc_ref[h] = acc_ref[h] * corr + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_ref[h, :n_rep, :] = jnp.broadcast_to(
+                m_new, (n_rep, m_ref.shape[2]))
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        # length == 0 (an idle ring lane): every block skipped, l == 0 —
+        # emit zeros rather than 0/0
+        l = l_ref[:, :n_rep, :1]
+        o = acc_ref[:, :n_rep] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = jnp.where(m_ref[:, :n_rep, :1] <= NEG_INF / 2, 0.0,
+                             o).astype(o_ref.dtype)
+
+
+def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     lengths: jax.Array, *, scale: Optional[float] = None,
+                     block_k: int = DEFAULT_BLOCK_K,
+                     interpret: bool = False) -> jax.Array:
+    """One query per head against the filled prefix of the KV cache.
+
+    q: [B, Hq, D]; k_cache/v_cache: [B, Hkv, S, D] (head-major, the
+    decode cache layout); lengths: [B] int32 — lane b attends cache
+    cols [0, lengths[b]).  Returns [B, Hq, D].  Hq must be a multiple
+    of Hkv (GQA); S a multiple of the (possibly shrunk) key block."""
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    if hq % hkv:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    n_rep = hq // hkv
+    while s % block_k:
+        block_k //= 2
+    nk = s // block_k
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    qg = q.reshape(b, hkv, n_rep, d)
+    lengths = lengths.astype(jnp.int32)
+    # scratch sublane floor: n_rep rows padded to the 8-row tile
+    rows = max(n_rep, 8)
+
+    def clamp(ik, lane_len):
+        # last live block for this lane; repeat it for dead tail blocks
+        # (repeated window => Mosaic skips the fetch)
+        return jnp.minimum(ik, jnp.maximum(lane_len - 1, 0) // block_k)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nk),
+        in_specs=[
+            pl.BlockSpec((1, hkv, n_rep, d),
+                         lambda b, ik, lens: (b, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, block_k, d),
+                         lambda b, ik, lens: (b, 0, clamp(ik, lens[b]), 0)),
+            pl.BlockSpec((1, hkv, block_k, d),
+                         lambda b, ik, lens: (b, 0, clamp(ik, lens[b]), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, n_rep, d),
+                               lambda b, ik, lens: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, n_rep, d), jnp.float32),
+            pltpu.VMEM((hkv, rows, 128), jnp.float32),
+            pltpu.VMEM((hkv, rows, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, block_k=block_k,
+                          n_rep=n_rep),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, n_rep, d), q.dtype),
+        interpret=interpret,
+    )(lengths, qg, k_cache, v_cache)
+    return out.reshape(b, hq, d)
+
+
+def decode_attention_reference(q: jax.Array, k_cache: jax.Array,
+                               v_cache: jax.Array,
+                               lengths: jax.Array) -> jax.Array:
+    """XLA einsum ground truth (the decode._layer math, lifted out) —
+    what the kernel is equivalence-pinned against.  Same head-major
+    [B, Hkv, S, D] cache layout as the kernel."""
+    b, hq, d = q.shape
+    _, hkv, s, _ = k_cache.shape
+    n_rep = hq // hkv
+    qg = q.reshape(b, hkv, n_rep, d)
+    scores = jnp.einsum("bhrd,bhsd->bhrs", qg, k_cache,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    mask = jnp.arange(s)[None, :] < lengths[:, None]          # [B, S]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # fully-masked lanes (length 0): emit zeros like the kernel
+    probs = jnp.where(mask[:, None, None, :], probs, 0.0)
+    out = jnp.einsum("bhrs,bhsd->bhrd", probs.astype(q.dtype), v_cache,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(b, hq, d).astype(q.dtype)

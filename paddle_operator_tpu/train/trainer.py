@@ -50,7 +50,11 @@ def make_optimizer(learning_rate: float = 3e-4,
     )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay),
+        # mu_dtype pins the first moment to f32 even under bf16 master
+        # weights (the host-offload depth recipe); optax stores nu in the
+        # param dtype — it has no nu_dtype knob
+        optax.adamw(schedule, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mu_dtype=jnp.float32),
     )
 
 
