@@ -108,7 +108,13 @@ def tree_shardings(tree: Any, mesh: Mesh,
     arrays and ShapeDtypeStructs (use with jax.eval_shape to pre-plan)."""
 
     def leaf_sharding(path, leaf):
-        lspec = spec_for_path(path_patterns, _path_str(path))
+        pstr = _path_str(path)
+        # block-quantized optimizer-state leaves (train/opt8bit.py _Q8):
+        # their [n_blocks, BLOCK] layout has no correspondence to any
+        # param axis, so param partition patterns must not apply
+        if pstr.endswith(("q8_codes", "q8_scale")):
+            return NamedSharding(mesh, P())
+        lspec = spec_for_path(path_patterns, pstr)
         pspec = logical_to_mesh(lspec, rules, mesh)
         # drop trailing/overflow axes if the leaf has fewer dims
         ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))

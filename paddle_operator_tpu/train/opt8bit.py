@@ -1,0 +1,227 @@
+"""Block-wise 8-bit AdamW moments (bitsandbytes-style, TPU-native).
+
+At 7B-width depth the f32 Adam moments (2x params) dominate single-chip
+memory: host offload (trainer.state_shardings) moves them off HBM but
+pays PCIe per step, and at dim-4096 L12 even the transfer temps OOM
+(measured).  8-bit moments attack the size itself: each moment tensor is
+stored as int8 codes plus one f32 absmax scale per 256-value block —
+a ~3.9x shrink — and dequantized/requantized inside the (jitted) update,
+so full-precision moments exist only as fusion-local temps.
+
+Quantization choices (validated by tests/test_opt8bit.py against the
+f32 trajectory):
+
+- ``mu`` (first moment, signed): linear absmax per block.
+- ``nu`` (second moment, nonnegative, huge dynamic range): linear absmax
+  on **sqrt(nu)** — the Adam denominator IS sqrt(nu), so quantizing in
+  the root domain spends the bits where the update actually reads
+  them; linear quantization of nu itself would zero small second
+  moments and blow up their steps.  sqrt(nu) never goes negative, so
+  its codes use the full [0, 254] range (offset -127 riding int8) —
+  twice the resolution of signed absmax.
+- Scales are per-block f32; block boundaries ride the flattened tensor,
+  so layouts/shardings don't affect the math.
+
+Scope: a SINGLE-CHIP memory lever.  The blocked layout has no
+correspondence to any parameter axis, so the codes replicate on a
+multi-device mesh (parallel/sharding.py) and the flattened update would
+gather sharded gradients — trainer.state_shardings warns if int8
+moments meet a multi-device mesh.  Sharded 8-bit moments would need
+per-shard blocking; use f32 moments (sharded like params) there.
+
+``adamw8bit`` mirrors optax.adamw's update rule (bias correction,
+decoupled weight decay, schedule support) and composes with
+clip_by_global_norm and the host-offload path (the int8 codes offload
+like any other opt-state leaf, at a quarter of the traffic).
+
+Reference scope note: the reference operator has no training runtime at
+all (user containers own it); this realizes the "int8 Adam moments"
+depth recipe from the round-3 review.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+BLOCK = 256
+# scan-chunk rows of the blocked update: 16384 rows x BLOCK = 4M values,
+# so dequantized f32 chunk temps stay ~16 MiB regardless of leaf size
+CHUNK_ROWS = 16384
+
+
+class _Q8(NamedTuple):
+    """One block-quantized tensor: int8 codes + per-block f32 scales.
+    Field names are load-bearing: parallel/sharding.py tree_shardings
+    replicates leaves named q8_codes/q8_scale — block layout does not
+    correspond to any param axis, so param partition patterns must not
+    apply to it."""
+
+    q8_codes: jax.Array   # [n_blocks, BLOCK] int8
+    q8_scale: jax.Array   # [n_blocks, 1] f32
+
+
+def _to_blocks(x: jax.Array) -> jax.Array:
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % BLOCK
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, BLOCK)
+
+
+def quantize_q8(x: jax.Array) -> _Q8:
+    """Signed symmetric absmax encoding (mu: values carry sign)."""
+    blocks = _to_blocks(x)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.round(blocks / scale).astype(jnp.int8)
+    return _Q8(q8_codes=q, q8_scale=scale)
+
+
+def quantize_q8u(x: jax.Array) -> _Q8:
+    """Unsigned encoding for NONNEGATIVE values (sqrt(nu)): the full
+    [0, 254] code range rides int8 via a -127 offset — twice the
+    resolution signed absmax would give a value that never goes
+    negative."""
+    blocks = _to_blocks(x)
+    scale = jnp.max(blocks, axis=1, keepdims=True) / 254.0
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = (jnp.round(blocks / scale) - 127.0).astype(jnp.int8)
+    return _Q8(q8_codes=q, q8_scale=scale)
+
+
+def _from_blocks(flat: jax.Array, shape, dtype) -> jax.Array:
+    n = 1
+    for s in shape:
+        n *= s
+    return flat.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def dequantize_q8(qt: _Q8, shape, dtype=jnp.float32) -> jax.Array:
+    return _from_blocks(qt.q8_codes.astype(jnp.float32) * qt.q8_scale,
+                        shape, dtype)
+
+
+def dequantize_q8u(qt: _Q8, shape, dtype=jnp.float32) -> jax.Array:
+    return _from_blocks(
+        (qt.q8_codes.astype(jnp.float32) + 127.0) * qt.q8_scale,
+        shape, dtype)
+
+
+class ScaleByAdam8bitState(NamedTuple):
+    count: jax.Array
+    mu: any               # pytree of _Q8
+    nu: any               # pytree of _Q8 (sqrt domain)
+
+
+def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.999,
+                      eps: float = 1e-8) -> optax.GradientTransformation:
+    """optax.scale_by_adam with block-quantized persistent state."""
+
+    def init_fn(params):
+        return ScaleByAdam8bitState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(
+                lambda p: quantize_q8(jnp.zeros(p.shape)), params),
+            nu=jax.tree_util.tree_map(
+                lambda p: quantize_q8u(jnp.zeros(p.shape)), params),
+        )
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        b1c = 1 - b1 ** count.astype(jnp.float32)
+        b2c = 1 - b2 ** count.astype(jnp.float32)
+
+        def requant(x):
+            # signed: x [rows, BLOCK] f32 -> (int8 codes, f32 scales)
+            s = jnp.max(jnp.abs(x), axis=1, keepdims=True) / 127.0
+            s = jnp.where(s == 0.0, 1.0, s)
+            return jnp.round(x / s).astype(jnp.int8), s
+
+        def requant_u(x):
+            # unsigned (nonnegative x): codes span [0, 254] via -127
+            s = jnp.max(x, axis=1, keepdims=True) / 254.0
+            s = jnp.where(s == 0.0, 1.0, s)
+            return (jnp.round(x / s) - 127.0).astype(jnp.int8), s
+
+        def one(g, mu_q, nu_q):
+            # The whole update is elementwise, so it runs in the BLOCKED
+            # domain under a lax.scan over row chunks: dequantized f32
+            # moments exist only at chunk size, never as full-leaf temps
+            # (a stacked dim-4096 MLP leaf is 1.34 GiB in f32 — measured
+            # OOM when the update materialized it whole).
+            shape, dtype = g.shape, g.dtype
+            size = 1
+            for s in shape:
+                size *= s
+            flat = g.astype(jnp.float32).reshape(-1)
+            pad = (-size) % BLOCK
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            gb = flat.reshape(-1, BLOCK)
+            n = gb.shape[0]
+            chunk = min(CHUNK_ROWS, n)
+            rpad = (-n) % chunk
+            mu_c, mu_s = mu_q.q8_codes, mu_q.q8_scale
+            nu_c, nu_s = nu_q.q8_codes, nu_q.q8_scale
+            if rpad:
+                gb = jnp.pad(gb, ((0, rpad), (0, 0)))
+                mu_c = jnp.pad(mu_c, ((0, rpad), (0, 0)))
+                nu_c = jnp.pad(nu_c, ((0, rpad), (0, 0)))
+                mu_s = jnp.pad(mu_s, ((0, rpad), (0, 0)),
+                               constant_values=1.0)
+                nu_s = jnp.pad(nu_s, ((0, rpad), (0, 0)),
+                               constant_values=1.0)
+            steps = (n + rpad) // chunk
+
+            def body(_, xs):
+                gq, mc, ms, nc, ns = xs
+                mu = b1 * (mc.astype(jnp.float32) * ms) + (1 - b1) * gq
+                nu_root = (nc.astype(jnp.float32) + 127.0) * ns
+                nu = b2 * (nu_root * nu_root) + (1 - b2) * (gq * gq)
+                upd = (mu / b1c) / (jnp.sqrt(nu / b2c) + eps)
+                new_mc, new_ms = requant(mu)
+                new_nc, new_ns = requant_u(jnp.sqrt(nu))
+                return None, (upd, new_mc, new_ms, new_nc, new_ns)
+
+            def resh(a):
+                return a.reshape(steps, chunk, *a.shape[1:])
+
+            _, (upd, mc2, ms2, nc2, ns2) = jax.lax.scan(
+                body, None,
+                (resh(gb), resh(mu_c), resh(mu_s), resh(nu_c),
+                 resh(nu_s)))
+            upd = upd.reshape(-1)[:size].reshape(shape).astype(dtype)
+
+            def unpad(a):
+                return a.reshape(-1, *a.shape[2:])[:n]
+
+            return (upd,
+                    _Q8(q8_codes=unpad(mc2), q8_scale=unpad(ms2)),
+                    _Q8(q8_codes=unpad(nc2), q8_scale=unpad(ns2)))
+
+        flat_g, treedef = jax.tree_util.tree_flatten(updates)
+        flat_mu = treedef.flatten_up_to(state.mu)
+        flat_nu = treedef.flatten_up_to(state.nu)
+        out = [one(g, m, n) for g, m, n in zip(flat_g, flat_mu, flat_nu)]
+        upds = treedef.unflatten([o[0] for o in out])
+        mus = treedef.unflatten([o[1] for o in out])
+        nus = treedef.unflatten([o[2] for o in out])
+        return upds, ScaleByAdam8bitState(count=count, mu=mus, nu=nus)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adamw8bit(learning_rate, b1: float = 0.9, b2: float = 0.999,
+              eps: float = 1e-8,
+              weight_decay: float = 1e-4) -> optax.GradientTransformation:
+    """AdamW with 8-bit moments: same chain shape as optax.adamw
+    (adam scaling -> decoupled weight decay -> learning rate)."""
+    return optax.chain(
+        scale_by_adam8bit(b1=b1, b2=b2, eps=eps),
+        optax.add_decayed_weights(weight_decay),
+        optax.scale_by_learning_rate(learning_rate),
+    )

@@ -1,0 +1,163 @@
+"""Block-wise 8-bit Adam moments (train/opt8bit.py): quantizer error
+bounds, update-rule agreement with f32 optax.adamw, end-to-end training
+quality, and composition with the host-offload path.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from paddle_operator_tpu.api.types import MeshSpec
+from paddle_operator_tpu.models import llama as L
+from paddle_operator_tpu.parallel.mesh import make_mesh
+from paddle_operator_tpu.train import trainer as T
+from paddle_operator_tpu.train.opt8bit import (
+    BLOCK,
+    adamw8bit,
+    dequantize_q8,
+    quantize_q8,
+)
+
+
+class TestQuantizer:
+    def test_roundtrip_error_bounded_per_block(self):
+        rng = np.random.default_rng(0)
+        # blocks with wildly different magnitudes: per-block scales must
+        # keep the RELATIVE error small everywhere
+        x = np.concatenate([rng.standard_normal(BLOCK) * 10.0 ** e
+                            for e in (-6, -2, 0, 3)]).astype(np.float32)
+        back = np.asarray(dequantize_q8(quantize_q8(jnp.asarray(x)),
+                                        x.shape))
+        for i, e in enumerate((-6, -2, 0, 3)):
+            blk = slice(i * BLOCK, (i + 1) * BLOCK)
+            err = np.abs(back[blk] - x[blk]).max()
+            assert err <= 10.0 ** e * 10 / 127 + 1e-12, (e, err)
+
+    def test_odd_sizes_and_shapes(self):
+        rng = np.random.default_rng(1)
+        for shape in ((7,), (3, 5), (1, BLOCK + 1), (2, 3, 11)):
+            x = jnp.asarray(rng.standard_normal(shape), jnp.float32)
+            back = dequantize_q8(quantize_q8(x), shape)
+            assert back.shape == shape
+            np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                                       atol=float(jnp.abs(x).max()) / 100)
+
+    def test_zeros_stay_zero(self):
+        z = jnp.zeros((BLOCK * 2,))
+        back = dequantize_q8(quantize_q8(z), z.shape)
+        assert not np.any(np.asarray(back))
+
+
+class TestUpdateRule:
+    def test_single_step_matches_f32_adamw(self):
+        """From zero moments, the FIRST update has no quantization
+        history — it must match optax.adamw almost exactly."""
+        rng = np.random.default_rng(2)
+        params = {"w": jnp.asarray(rng.standard_normal((4, 8)),
+                                   jnp.float32)}
+        g = {"w": jnp.asarray(rng.standard_normal((4, 8)), jnp.float32)}
+        ref_opt = optax.adamw(1e-2, b1=0.9, b2=0.999, weight_decay=1e-4)
+        q_opt = adamw8bit(1e-2, b1=0.9, b2=0.999, weight_decay=1e-4)
+        ref_upd, _ = ref_opt.update(g, ref_opt.init(params), params)
+        q_upd, _ = q_opt.update(g, q_opt.init(params), params)
+        np.testing.assert_allclose(np.asarray(q_upd["w"]),
+                                   np.asarray(ref_upd["w"]),
+                                   rtol=0.05, atol=1e-6)
+
+    def test_trajectory_tracks_f32(self):
+        """Quadratic bowl: 8-bit moments must converge to the same
+        optimum the f32 optimizer reaches (requantization noise must not
+        bias the trajectory)."""
+        target = jnp.asarray(np.random.default_rng(3).standard_normal(64),
+                             jnp.float32)
+
+        def run(opt):
+            p = jnp.zeros(64)
+            state = opt.init(p)
+            for _ in range(200):
+                g = 2 * (p - target)
+                upd, state = opt.update(g, state, p)
+                p = p + upd
+            return p
+
+        ref = run(optax.adamw(5e-2, weight_decay=0.0))
+        got = run(adamw8bit(5e-2, weight_decay=0.0))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0.02, atol=0.02)
+
+
+class TestTraining:
+    def _run(self, moments, offload=False, steps=8):
+        mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+        model, cfg = L.make_model("tiny", dtype=jnp.float32)
+        opt = T.make_optimizer(1e-3, warmup_steps=2, decay_steps=20,
+                               moments=moments)
+        pats = L.partition_patterns(cfg)
+        example = (jnp.zeros((8, 16), jnp.int32),)
+        sh, _ = T.state_shardings(model, opt, mesh, pats, example,
+                                  offload_opt_state=offload)
+        state = T.create_state(model, opt, mesh, pats, example,
+                               offload_opt_state=offload)
+        step = T.make_train_step(model, opt, mesh, sh)
+        losses = []
+        for i in range(steps):
+            state, m = step(state, T.synthetic_batch(
+                8, 17, cfg.vocab_size, seed=i))
+            losses.append(float(m["loss"]))
+        return losses, state
+
+    def test_llama_trains_with_int8_moments(self):
+        ref, _ = self._run("f32")
+        got, state = self._run("int8")
+        assert all(np.isfinite(l) for l in got)
+        assert got[-1] < got[0]
+        # close to the f32 trajectory, not bit-equal (requantization)
+        np.testing.assert_allclose(got, ref, rtol=0.02)
+        # the persistent moments really are int8
+        kinds = {x.dtype for x in jax.tree_util.tree_leaves(
+            state.opt_state) if hasattr(x, "dtype")}
+        assert np.dtype(np.int8) in kinds
+
+    def test_composes_with_host_offload(self):
+        got, state = self._run("int8", offload=True)
+        assert all(np.isfinite(l) for l in got) and got[-1] < got[0]
+        mem = {getattr(x.sharding, "memory_kind", None)
+               for x in jax.tree_util.tree_leaves(state.opt_state)
+               if hasattr(x, "sharding")}
+        assert mem == {"pinned_host"}
+
+    def test_checkpointable(self, tmp_path):
+        """int8 moments must round-trip through orbax (preemption
+        recovery must not care how the moments are encoded)."""
+        from paddle_operator_tpu.train.checkpoint import CheckpointManager
+
+        _, state = self._run("int8", steps=2)
+        mgr = CheckpointManager(path=str(tmp_path))
+        mgr.save(1, state, force=True)
+        mgr.wait()
+        restored = mgr.restore(state)
+        for x, y in zip(jax.tree_util.tree_leaves(state.opt_state),
+                        jax.tree_util.tree_leaves(restored.opt_state)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_multi_device_mesh_warns(self):
+        import warnings as W
+
+        mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+        model, cfg = L.make_model("tiny", dtype=jnp.float32)
+        opt = T.make_optimizer(1e-3, moments="int8")
+        pats = L.partition_patterns(cfg)
+        with W.catch_warnings(record=True) as w:
+            W.simplefilter("always")
+            T.state_shardings(model, opt, mesh, pats,
+                              (jnp.zeros((8, 16), jnp.int32),))
+        assert any("int8 Adam moments replicate" in str(x.message)
+                   for x in w)
+
+    def test_unknown_moments_rejected(self):
+        import pytest as _pt
+
+        with _pt.raises(ValueError, match="unknown moments"):
+            T.make_optimizer(1e-3, moments="Int8")
