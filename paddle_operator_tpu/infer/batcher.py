@@ -249,9 +249,10 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int):
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
-                 "done", "out", "error")
+                 "done", "out", "error", "wants_stream", "_stream")
 
-    def __init__(self, prompt, max_new, temperature, seed, eos):
+    def __init__(self, prompt, max_new, temperature, seed, eos,
+                 wants_stream=False):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -260,6 +261,12 @@ class _Request:
         self.done = threading.Event()
         self.out: Optional[List[int]] = None
         self.error: Optional[Exception] = None
+        # token streaming is opt-in (submit(stream=True)): the dominant
+        # result()-only path must not pay per-token queue puts inside
+        # the decode-ring thread that gates every lane's throughput
+        self.wants_stream = wants_stream
+        self._stream: Optional["queue.Queue"] = (
+            queue.Queue() if wants_stream else None)
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -267,6 +274,25 @@ class _Request:
         if self.error is not None:
             raise self.error
         return self.out
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield generated tokens as the ring emits them (one int at a
+        time, arriving in chunk-sized bursts).  Raises the request's
+        error at the point of failure; `timeout` bounds the wait for
+        EACH burst, not the whole generation."""
+        if self._stream is None:
+            raise RuntimeError("request was not submitted with "
+                               "stream=True")
+        while True:
+            try:
+                item = self._stream.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError("no tokens within timeout") from None
+            if item is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
 
 
 class ContinuousBatcher:
@@ -319,7 +345,8 @@ class ContinuousBatcher:
 
     def submit(self, prompt, *, max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
-               eos_token: Optional[int] = None) -> _Request:
+               eos_token: Optional[int] = None,
+               stream: bool = False) -> _Request:
         prompt = list(map(int, prompt))
         if not prompt:
             raise ValueError("empty prompt")
@@ -336,13 +363,13 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(prompt)}) + chunk-rounded budget ({budget}) "
                 f"exceeds max_len ({self.max_len})")
-        req = _Request(prompt, max_new_tokens, temperature, seed, eos_token)
+        req = _Request(prompt, max_new_tokens, temperature, seed,
+                       eos_token, wants_stream=stream)
         self._pending.put(req)
         if self._stop.is_set() and not req.done.is_set():
             # loop died between the liveness check above and the put:
             # fail the request instead of letting result() hang
-            req.error = RuntimeError("batcher closed")
-            req.done.set()
+            self._finish(req, RuntimeError("batcher closed"))
             return req
         self._wake.set()
         return req
@@ -383,6 +410,8 @@ class ContinuousBatcher:
             jax.random.PRNGKey(req.seed))
         self.lane[slot] = req
         self._lane_out[slot] = [first]
+        if req._stream is not None:
+            req._stream.put(first)
         self._lane_left[slot] = req.max_new - 1
         self.stats["admitted"] += 1
         if self._lane_left[slot] <= 0 or (req.eos is not None
@@ -391,6 +420,16 @@ class ContinuousBatcher:
             # lane now instead of riding a wasted chunk
             self._evict(slot)
 
+    @staticmethod
+    def _finish(req: _Request, error: Optional[Exception] = None) -> None:
+        if error is not None and req.error is None:
+            req.error = error
+        # done BEFORE the stream sentinel: a stream() consumer that sees
+        # the close must find result() already resolvable
+        req.done.set()
+        if req._stream is not None:
+            req._stream.put(None)
+
     def _evict(self, slot: int) -> None:
         req = self.lane[slot]
         self.lane[slot] = None
@@ -398,7 +437,7 @@ class ContinuousBatcher:
         self.stats["evicted"] += 1
         if req is not None:
             req.out = req.prompt + self._lane_out[slot]
-            req.done.set()
+            self._finish(req)
 
     def _loop(self) -> None:
         try:
@@ -406,23 +445,20 @@ class ContinuousBatcher:
         except Exception as e:       # device/compile failure: fail loudly
             for req in self.lane:
                 if req is not None:
-                    req.error = e
-                    req.done.set()
+                    self._finish(req, e)
             self.lane = [None] * self.slots
             self._stop.set()
         # drain: fail whatever is still queued or resident
         for i, req in enumerate(self.lane):
             if req is not None:
-                req.error = RuntimeError("batcher closed")
-                req.done.set()
+                self._finish(req, RuntimeError("batcher closed"))
                 self.lane[i] = None
         while True:
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
-            req.error = RuntimeError("batcher closed")
-            req.done.set()
+            self._finish(req, RuntimeError("batcher closed"))
 
     def _loop_body(self) -> None:
         while not self._stop.is_set():
@@ -436,8 +472,7 @@ class ContinuousBatcher:
                 try:
                     self._admit(slot, req)
                 except Exception as e:          # bad request: fail it only
-                    req.error = e
-                    req.done.set()
+                    self._finish(req, e)
                     self.lane[slot] = None
 
             active_idx = [i for i, r in enumerate(self.lane)
@@ -462,6 +497,8 @@ class ContinuousBatcher:
                     if self._lane_left[i] <= 0:
                         break
                     self._lane_out[i].append(int(t))
+                    if req._stream is not None:
+                        req._stream.put(int(t))
                     self._lane_left[i] -= 1
                     if req.eos is not None and int(t) == req.eos:
                         self._lane_left[i] = 0

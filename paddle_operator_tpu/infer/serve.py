@@ -122,6 +122,9 @@ class ContinuousGenerator:
 
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator  # injected
+    # chunked transfer (the streaming path) requires HTTP/1.1; plain
+    # responses carry Content-Length so keep-alive stays correct
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *a):
         pass
@@ -140,13 +143,61 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {})
 
+    def _stream_generate(self, req) -> None:
+        """``"stream": true`` (continuous mode, single row): emit
+        newline-delimited JSON events as the ring produces tokens —
+        {"token": t} per generated token, then {"done": true, "tokens":
+        [full sequence]}.  Chunked transfer; tokens arrive in
+        chunk-sized bursts (the ring's decode granularity)."""
+        gen = self.generator
+        if not isinstance(gen, ContinuousGenerator):
+            raise ValueError("streaming requires the continuous server "
+                             "(SERVE_CONTINUOUS=1)")
+        tokens = np.asarray(req["tokens"], np.int32)
+        if tokens.ndim != 2 or tokens.shape[0] != 1:
+            raise ValueError("streaming takes tokens [1, seq]")
+        handle = gen.batcher.submit(
+            tokens[0], max_new_tokens=int(req.get("max_new_tokens", 32)),
+            temperature=float(req.get("temperature", 0.0)),
+            seed=int(req.get("seed", 0)), eos_token=req.get("eos_token"),
+            stream=True)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(obj) -> None:
+            body = json.dumps(obj).encode() + b"\n"
+            self.wfile.write(f"{len(body):x}\r\n".encode() + body
+                             + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for tok in handle.stream(timeout=600):
+                emit({"token": tok})
+            emit({"done": True, "tokens": handle.result(timeout=5)})
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            return        # client disconnected mid-stream: nothing to say
+        except Exception as e:
+            try:
+                emit({"error": str(e)})
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
     def do_POST(self):
+        # drain the body before ANY response: under HTTP/1.1 keep-alive
+        # an unread body would be parsed as the next request's start line
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n) if n else b""
         if self.path != "/v1/generate":
             self._send(404, {})
             return
-        n = int(self.headers.get("Content-Length", 0))
         try:
-            req = json.loads(self.rfile.read(n))
+            req = json.loads(body)
+            if req.get("stream"):
+                return self._stream_generate(req)
             tokens = np.asarray(req["tokens"], np.int32)
             if tokens.ndim != 2:
                 raise ValueError("tokens must be [batch, seq]")

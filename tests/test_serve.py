@@ -161,3 +161,54 @@ class TestContinuousServe:
         assert ei.value.code == 400
         assert "fixed per continuous server" in json.loads(
             ei.value.read())["error"]
+
+    def test_streaming_tokens_arrive_incrementally(self, cserver):
+        base, params, cfg, _ = cserver
+        prompt = np.random.default_rng(9).integers(
+            0, cfg.vocab_size, (6,)).tolist()
+        ref = D.generate(params, cfg, jnp.asarray([prompt], jnp.int32),
+                         max_new_tokens=8, max_len=64)
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"tokens": [prompt], "max_new_tokens": 8,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        import time as _time
+
+        events, stamps = [], []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "application/x-ndjson"
+            for line in resp:
+                line = line.strip()
+                if line:
+                    events.append(json.loads(line))
+                    stamps.append(_time.perf_counter())
+        toks = [e["token"] for e in events if "token" in e]
+        final = events[-1]
+        assert final.get("done") is True
+        assert final["tokens"] == np.asarray(ref[0]).tolist()
+        assert toks == final["tokens"][len(prompt):]
+        # INCREMENTAL arrival, not one buffered flush at completion: the
+        # first token must land measurably before the done event (the
+        # ring decodes 8 tokens in 4-token chunk bursts between them)
+        assert stamps[-1] - stamps[0] > 0.003, stamps[-1] - stamps[0]
+
+    def test_streaming_rejected_on_batch_server(self):
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        srv = make_server("127.0.0.1", 0, params, cfg)   # batch mode
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.server_address[1]}/v1/generate",
+                data=json.dumps({"tokens": [[1, 2]], "stream": True,
+                                 "max_new_tokens": 2}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+            assert "continuous" in json.loads(ei.value.read())["error"]
+        finally:
+            srv.shutdown()
