@@ -249,7 +249,7 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int):
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
-                 "done", "out", "error", "wants_stream", "_stream")
+                 "done", "out", "error", "_stream")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
                  wants_stream=False):
@@ -264,7 +264,6 @@ class _Request:
         # token streaming is opt-in (submit(stream=True)): the dominant
         # result()-only path must not pay per-token queue puts inside
         # the decode-ring thread that gates every lane's throughput
-        self.wants_stream = wants_stream
         self._stream: Optional["queue.Queue"] = (
             queue.Queue() if wants_stream else None)
 
@@ -331,6 +330,9 @@ class ContinuousBatcher:
         self.lane: List[Optional[_Request]] = [None] * slots
         self._lane_out: List[List[int]] = [[] for _ in range(slots)]
         self._lane_left = [0] * slots
+        # per-lane device future of the admission-sampled first token,
+        # materialized at the next chunk consume (async admission)
+        self._lane_first: List[Optional[jax.Array]] = [None] * slots
 
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._wake = threading.Event()
@@ -388,6 +390,12 @@ class ContinuousBatcher:
         raise ValueError(f"no bucket fits prompt length {n}")
 
     def _admit(self, slot: int, req: _Request) -> None:
+        """Admission never blocks on the device: the prefill dispatch and
+        the first-token sample stay device-side futures, so back-to-back
+        admissions pipeline on the accelerator instead of paying one
+        host round-trip EACH (measured to dominate served throughput on
+        relayed chips).  The first token materializes at the next chunk
+        consume (:meth:`_materialize_first`)."""
         b = self._bucket_for(len(req.prompt))
         padded = np.zeros((1, b), np.int32)
         padded[0, :len(req.prompt)] = req.prompt
@@ -395,30 +403,45 @@ class ContinuousBatcher:
             self.params, self.cache, jnp.asarray(padded),
             jnp.int32(len(req.prompt)), jnp.int32(slot))
         # sample the FIRST new token from the prefill logits with the
-        # same rule the chunk step uses
+        # same rule the chunk step uses — on device, no sync
         if req.temperature > 0:
             key = jax.random.fold_in(jax.random.PRNGKey(req.seed),
                                      len(req.prompt) - 1)
             filt = D._filter_logits(logits[None] / req.temperature,
                                     self._top_k, self._top_p)[0]
-            first = int(jax.random.categorical(key, filt))
+            first = jax.random.categorical(key, filt).astype(jnp.int32)
         else:
-            first = int(logits.argmax())
+            first = logits.argmax().astype(jnp.int32)
         self.tok = self.tok.at[slot].set(first)
         self.temp = self.temp.at[slot].set(req.temperature)
         self.keys = self.keys.at[slot].set(
             jax.random.PRNGKey(req.seed))
         self.lane[slot] = req
-        self._lane_out[slot] = [first]
-        if req._stream is not None:
-            req._stream.put(first)
-        self._lane_left[slot] = req.max_new - 1
+        self._lane_out[slot] = []
+        self._lane_first[slot] = first
+        self._lane_left[slot] = req.max_new
         self.stats["admitted"] += 1
-        if self._lane_left[slot] <= 0 or (req.eos is not None
-                                          and first == req.eos):
-            # done at admission (budget 1 or immediate eos): free the
-            # lane now instead of riding a wasted chunk
+        if req.max_new == 1:
+            # degenerate budget: sync now and free the lane immediately
+            # rather than riding a whole wasted chunk
+            self._materialize_first(slot, req)
             self._evict(slot)
+
+    def _materialize_first(self, i: int, req: _Request) -> None:
+        """Bring the admission-sampled first token to the host (the only
+        per-request sync, folded into a chunk consume) and run it through
+        the same budget/eos/stream bookkeeping as chunk tokens."""
+        fd = self._lane_first[i]
+        if fd is None:
+            return
+        self._lane_first[i] = None
+        t = int(fd)
+        self._lane_out[i].append(t)
+        if req._stream is not None:
+            req._stream.put(t)
+        self._lane_left[i] -= 1
+        if req.eos is not None and t == req.eos:
+            self._lane_left[i] = 0
 
     @staticmethod
     def _finish(req: _Request, error: Optional[Exception] = None) -> None:
@@ -436,6 +459,8 @@ class ContinuousBatcher:
         self.temp = self.temp.at[slot].set(0.0)
         self.stats["evicted"] += 1
         if req is not None:
+            # error-path evictions can race ahead of the first consume
+            self._materialize_first(slot, req)
             req.out = req.prompt + self._lane_out[slot]
             self._finish(req)
 
@@ -460,7 +485,36 @@ class ContinuousBatcher:
                 break
             self._finish(req, RuntimeError("batcher closed"))
 
+    def _consume(self, chunk_reqs, toks) -> None:
+        """Apply one finished chunk's tokens ([chunk, slots] on host).
+        ``chunk_reqs`` pins each lane to the REQUEST the chunk was
+        dispatched for: under pipelining a lane may have been evicted
+        (and even re-admitted) since dispatch — such in-flight tokens
+        belong to the old request and are dropped."""
+        for i, req in chunk_reqs:
+            if req is None or self.lane[i] is not req:
+                continue
+            self._materialize_first(i, req)
+            for t in toks[:, i]:
+                if self._lane_left[i] <= 0:
+                    break
+                self._lane_out[i].append(int(t))
+                if req._stream is not None:
+                    req._stream.put(int(t))
+                self._lane_left[i] -= 1
+                if req.eos is not None and int(t) == req.eos:
+                    self._lane_left[i] = 0
+            if self._lane_left[i] <= 0:
+                self._evict(i)
+
     def _loop_body(self) -> None:
+        # One chunk in flight at all times (when lanes are active): the
+        # host consumes chunk N's tokens — per-token queue pushes, evict
+        # bookkeeping, and crucially the device->host transfer latency —
+        # WHILE the device decodes chunk N+1.  Without this the ring
+        # serializes RTT with compute and served throughput halves on
+        # relayed chips (measured by bench.py measure_ring_throughput).
+        pending = None                  # (chunk_reqs, device toks)
         while not self._stop.is_set():
             # admit into free lanes
             while any(r is None for r in self.lane):
@@ -478,6 +532,11 @@ class ContinuousBatcher:
             active_idx = [i for i, r in enumerate(self.lane)
                           if r is not None]
             if not active_idx:
+                if pending is not None:
+                    chunk_reqs, toks_dev = pending
+                    pending = None
+                    self._consume(chunk_reqs, np.asarray(toks_dev))
+                    continue            # eviction may have freed lanes
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
                 continue
@@ -486,24 +545,16 @@ class ContinuousBatcher:
 
             active = jnp.asarray(
                 [r is not None for r in self.lane], bool)
-            self.cache, self.tok, toks = self._step(
+            # async dispatch: returns device futures immediately
+            self.cache, self.tok, toks_dev = self._step(
                 self.params, self.cache, self.tok, self.temp, self.keys,
                 active)
             self.stats["chunks"] += 1
-            toks = np.asarray(toks)                     # [chunk, slots]
-            for i in active_idx:
-                req = self.lane[i]
-                for t in toks[:, i]:
-                    if self._lane_left[i] <= 0:
-                        break
-                    self._lane_out[i].append(int(t))
-                    if req._stream is not None:
-                        req._stream.put(int(t))
-                    self._lane_left[i] -= 1
-                    if req.eos is not None and int(t) == req.eos:
-                        self._lane_left[i] = 0
-                if self._lane_left[i] <= 0:
-                    self._evict(i)
+            chunk_reqs = [(i, self.lane[i]) for i in active_idx]
+            if pending is not None:
+                prev_reqs, prev_toks = pending
+                self._consume(prev_reqs, np.asarray(prev_toks))
+            pending = (chunk_reqs, toks_dev)
 
 
 def _default_buckets(max_len: int) -> Tuple[int, ...]:

@@ -123,8 +123,11 @@ class ContinuousGenerator:
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator  # injected
     # chunked transfer (the streaming path) requires HTTP/1.1; plain
-    # responses carry Content-Length so keep-alive stays correct
+    # responses carry Content-Length so keep-alive stays correct, and
+    # the socket timeout reaps idle/half-dead keep-alive connections
+    # that would otherwise pin a server thread forever
     protocol_version = "HTTP/1.1"
+    timeout = 120
 
     def log_message(self, *a):
         pass
@@ -153,6 +156,14 @@ class _Handler(BaseHTTPRequestHandler):
         if not isinstance(gen, ContinuousGenerator):
             raise ValueError("streaming requires the continuous server "
                              "(SERVE_CONTINUOUS=1)")
+        if ((req.get("top_k"), req.get("top_p"))
+                != (gen.batcher._top_k, gen.batcher._top_p)
+                and (req.get("top_k") is not None
+                     or req.get("top_p") is not None)):
+            raise ValueError(
+                "top_k/top_p are fixed per continuous server "
+                f"(configured: top_k={gen.batcher._top_k} "
+                f"top_p={gen.batcher._top_p})")
         tokens = np.asarray(req["tokens"], np.int32)
         if tokens.ndim != 2 or tokens.shape[0] != 1:
             raise ValueError("streaming takes tokens [1, seq]")

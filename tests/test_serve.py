@@ -167,10 +167,10 @@ class TestContinuousServe:
         prompt = np.random.default_rng(9).integers(
             0, cfg.vocab_size, (6,)).tolist()
         ref = D.generate(params, cfg, jnp.asarray([prompt], jnp.int32),
-                         max_new_tokens=8, max_len=64)
+                         max_new_tokens=32, max_len=64)
         req = urllib.request.Request(
             f"{base}/v1/generate",
-            data=json.dumps({"tokens": [prompt], "max_new_tokens": 8,
+            data=json.dumps({"tokens": [prompt], "max_new_tokens": 32,
                              "stream": True}).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
         import time as _time
@@ -188,10 +188,24 @@ class TestContinuousServe:
         assert final.get("done") is True
         assert final["tokens"] == np.asarray(ref[0]).tolist()
         assert toks == final["tokens"][len(prompt):]
-        # INCREMENTAL arrival, not one buffered flush at completion: the
-        # first token must land measurably before the done event (the
-        # ring decodes 8 tokens in 4-token chunk bursts between them)
-        assert stamps[-1] - stamps[0] > 0.003, stamps[-1] - stamps[0]
+        # INCREMENTAL arrival, not one buffered flush at completion:
+        # 32 tokens take 8+ pipelined chunk waves, so the first token
+        # must land measurably before the done event (a single buffered
+        # flush would read all lines within ~100us)
+        assert stamps[-1] - stamps[0] > 0.001, stamps[-1] - stamps[0]
+
+    def test_streaming_rejects_fixed_sampling_statics(self, cserver):
+        base, _, _, _ = cserver
+        req = urllib.request.Request(
+            f"{base}/v1/generate",
+            data=json.dumps({"tokens": [[1, 2, 3]], "max_new_tokens": 2,
+                             "stream": True, "top_p": 0.5}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+        assert "fixed per continuous server" in json.loads(
+            ei.value.read())["error"]
 
     def test_streaming_rejected_on_batch_server(self):
         model, cfg = make_model("tiny", dtype=jnp.float32)
