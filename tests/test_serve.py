@@ -163,36 +163,50 @@ class TestContinuousServe:
             ei.value.read())["error"]
 
     def test_streaming_tokens_arrive_incrementally(self, cserver):
+        import time as _time
+
         base, params, cfg, _ = cserver
         prompt = np.random.default_rng(9).integers(
             0, cfg.vocab_size, (6,)).tolist()
         ref = D.generate(params, cfg, jnp.asarray([prompt], jnp.int32),
                          max_new_tokens=32, max_len=64)
-        req = urllib.request.Request(
-            f"{base}/v1/generate",
-            data=json.dumps({"tokens": [prompt], "max_new_tokens": 32,
-                             "stream": True}).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
-        import time as _time
 
-        events, stamps = [], []
-        with urllib.request.urlopen(req, timeout=120) as resp:
-            assert resp.headers["Content-Type"] == "application/x-ndjson"
-            for line in resp:
-                line = line.strip()
-                if line:
-                    events.append(json.loads(line))
-                    stamps.append(_time.perf_counter())
-        toks = [e["token"] for e in events if "token" in e]
-        final = events[-1]
-        assert final.get("done") is True
-        assert final["tokens"] == np.asarray(ref[0]).tolist()
-        assert toks == final["tokens"][len(prompt):]
+        def run_once():
+            req = urllib.request.Request(
+                f"{base}/v1/generate",
+                data=json.dumps({"tokens": [prompt], "max_new_tokens": 32,
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            events, stamps = [], []
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert (resp.headers["Content-Type"]
+                        == "application/x-ndjson")
+                for line in resp:
+                    line = line.strip()
+                    if line:
+                        events.append(json.loads(line))
+                        stamps.append(_time.perf_counter())
+            toks = [e["token"] for e in events if "token" in e]
+            final = events[-1]
+            assert final.get("done") is True
+            assert final["tokens"] == np.asarray(ref[0]).tolist()
+            assert toks == final["tokens"][len(prompt):]
+            return stamps[-1] - stamps[0]
+
         # INCREMENTAL arrival, not one buffered flush at completion:
         # 32 tokens take 8+ pipelined chunk waves, so the first token
         # must land measurably before the done event (a single buffered
-        # flush would read all lines within ~100us)
-        assert stamps[-1] - stamps[0] > 0.001, stamps[-1] - stamps[0]
+        # flush would read all lines within ~100us).  Receiver-side
+        # timestamps collapse when the whole suite saturates the CPU and
+        # this reader thread is starved, so retry a couple of times — a
+        # server that truly buffers until completion fails EVERY attempt.
+        gaps = []
+        for _ in range(3):
+            gaps.append(run_once())
+            if gaps[-1] > 0.001:
+                break
+        assert gaps[-1] > 0.001, gaps
 
     def test_streaming_rejects_fixed_sampling_statics(self, cserver):
         base, _, _, _ = cserver
