@@ -124,8 +124,11 @@ class TPUJobReconciler:
         bounded, parked, below_min = self._clamp_elastic(job)
         if job.status.phase in (Phase.COMPLETED, Phase.SUCCEED, Phase.FAILED):
             # A finished job edited into a parking configuration is not
-            # broken — it stays terminal; don't brand it ERROR or warn.
+            # broken — it stays terminal; don't brand it ERROR or warn
+            # (and a below-minimum clamp on a finished job is equally
+            # moot — no pods will run at the clamped count).
             parked = False
+            below_min = None
         key = f"{namespace}/{name}"
         if parked and self._parked_warned.get(key) != job.generation:
             self._parked_warned[key] = job.generation
@@ -566,10 +569,12 @@ class TPUJobReconciler:
           leaving the user staring at a pod-less "Completed" job;
         - ``below_min``: a warning message when the snap-down landed the
           worker count under the user's declared ``requests`` floor (but
-          above 0) — the job runs, just below the contracted minimum."""
+          above 0) — the job runs, just below the contracted minimum.
+          Per-role messages are collected (joined), not overwritten, so
+          if more roles ever gain a snap rule none is silently lost."""
         bounded = False
         parked = False
-        below_min = None
+        below_msgs = []
         for role in (job.spec.ps, job.spec.worker, job.spec.heter):
             if role is None:
                 continue
@@ -593,13 +598,13 @@ class TPUJobReconciler:
                 if wps > 1 and role.replicas % wps:
                     role.replicas -= role.replicas % wps
                     if 0 < role.replicas < lo:
-                        below_min = (
+                        below_msgs.append(
                             f"slice-atomic clamp reduced workers to "
                             f"{role.replicas}, below the declared "
                             f"requests minimum of {lo}")
             if role is job.spec.worker and ask > 0 and role.replicas == 0:
                 parked = True
-        return bounded, parked, below_min
+        return bounded, parked, "; ".join(below_msgs) or None
 
     def _alloc_host_port(self, job: TPUJob) -> bool:
         """Annotate the job with a host-port block base (reference

@@ -249,7 +249,7 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int):
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
-                 "done", "out", "error", "_stream")
+                 "done", "out", "error", "_stream", "_cancel")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
                  wants_stream=False):
@@ -261,6 +261,7 @@ class _Request:
         self.done = threading.Event()
         self.out: Optional[List[int]] = None
         self.error: Optional[Exception] = None
+        self._cancel = False
         # token streaming is opt-in (submit(stream=True)): the dominant
         # result()-only path must not pay per-token queue puts inside
         # the decode-ring thread that gates every lane's throughput
@@ -273,6 +274,14 @@ class _Request:
         if self.error is not None:
             raise self.error
         return self.out
+
+    def cancel(self) -> None:
+        """Stop decoding this request: the ring evicts its lane at the
+        next chunk boundary (or drops it from the queue if not yet
+        admitted) and ``result()`` returns the tokens produced so far.
+        A disconnect-abandoned long stream must not keep occupying a
+        decode lane to its full token budget."""
+        self._cancel = True
 
     def stream(self, timeout: Optional[float] = None):
         """Yield generated tokens as the ring emits them (one int at a
@@ -360,7 +369,12 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds the largest prefill "
                 f"bucket ({self.buckets[-1]})")
-        budget = -(-max_new_tokens // self.chunk) * self.chunk
+        # the FIRST token is sampled from the prefill logits, so only
+        # max_new-1 tokens ride chunk steps; the worst-case cache position
+        # is prompt + ceil((max_new-1)/chunk)*chunk (validating with
+        # ceil(max_new/chunk) rejected requests up to chunk-1 tokens
+        # INSIDE capacity)
+        budget = -(-(max_new_tokens - 1) // self.chunk) * self.chunk
         if len(prompt) + budget > self.max_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + chunk-rounded budget ({budget}) "
@@ -516,12 +530,23 @@ class ContinuousBatcher:
         # relayed chips (measured by bench.py measure_ring_throughput).
         pending = None                  # (chunk_reqs, device toks)
         while not self._stop.is_set():
+            # cancelled lanes leave at the chunk boundary: the request
+            # resolves with whatever tokens it has, the lane frees for
+            # the next admission (serve.py calls cancel() when a stream
+            # consumer disconnects mid-generation)
+            for i, r in enumerate(self.lane):
+                if r is not None and r._cancel:
+                    self._evict(i)
             # admit into free lanes
             while any(r is None for r in self.lane):
                 try:
                     req = self._pending.get_nowait()
                 except queue.Empty:
                     break
+                if req._cancel:                 # cancelled while queued
+                    req.out = list(req.prompt)
+                    self._finish(req)
+                    continue
                 slot = self.lane.index(None)
                 try:
                     self._admit(slot, req)

@@ -172,10 +172,6 @@ class _Handler(BaseHTTPRequestHandler):
             temperature=float(req.get("temperature", 0.0)),
             seed=int(req.get("seed", 0)), eos_token=req.get("eos_token"),
             stream=True)
-        self.send_response(200)
-        self.send_header("Content-Type", "application/x-ndjson")
-        self.send_header("Transfer-Encoding", "chunked")
-        self.end_headers()
 
         def emit(obj) -> None:
             body = json.dumps(obj).encode() + b"\n"
@@ -183,19 +179,34 @@ class _Handler(BaseHTTPRequestHandler):
                              + b"\r\n")
             self.wfile.flush()
 
+        # everything from the first socket write onward sits inside the
+        # try: a disconnect raising in send_response/end_headers must
+        # still reach the finally's cancel, or the abandoned request
+        # holds its decode lane to the full token budget
         try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
             for tok in handle.stream(timeout=600):
                 emit({"token": tok})
             emit({"done": True, "tokens": handle.result(timeout=5)})
             self.wfile.write(b"0\r\n\r\n")
         except OSError:
-            return        # client disconnected mid-stream: nothing to say
+            return   # client disconnected mid-stream: nothing to say
         except Exception as e:
             try:
                 emit({"error": str(e)})
                 self.wfile.write(b"0\r\n\r\n")
             except OSError:
                 pass
+        finally:
+            # on ANY abandoning exit (disconnect, stream timeout, …) the
+            # ring must stop decoding for this request — without the
+            # cancel a few abandoned long streams would occupy all
+            # decode lanes to their full max_new_tokens budget.  A no-op
+            # when the generation already finished.
+            handle.cancel()
 
     def do_POST(self):
         # drain the body before ANY response: under HTTP/1.1 keep-alive

@@ -42,6 +42,7 @@ import io
 import json
 import os
 import threading
+import time
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
@@ -98,11 +99,20 @@ class EmbeddingStore:
         # handler threads and stop()'s final save would otherwise share a
         # tmp file and publish interleaved bytes
         self._save_lock = threading.Lock()
-        # push idempotency: request ids already applied (bounded FIFO) —
-        # a client retrying a push whose RESPONSE was lost must not
-        # double-apply the gradient
-        self._applied: "Dict[str, None]" = {}
-        self._applied_limit = 4096
+        # push idempotency: request ids already applied — a client
+        # retrying a push whose RESPONSE was lost must not double-apply
+        # the gradient.  Value is the monotonic completion time, or a
+        # threading.Event while the push is in flight (a racing duplicate
+        # WAITS on it: answering 200 before the original's outcome is
+        # known would ack a gradient that may yet fail).  Entries are
+        # evicted by AGE, with a retention comfortably past the client's
+        # retry deadline (ps/client.py retry_deadline_s=30 default) — a
+        # pure size-FIFO could evict an id within a retrier's window
+        # under high push rates and re-apply its gradient.  The size cap
+        # is a memory backstop only (reached at >500 pushes/s sustained).
+        self._applied: "Dict[str, object]" = {}
+        self._applied_retention_s = 120.0
+        self._applied_limit = 65536
 
     def ensure(self, name: str, vocab: int, dim: int, seed: int) -> Table:
         with self._lock:
@@ -154,15 +164,62 @@ class EmbeddingStore:
     def push_once(self, req_id: Optional[str], table: Table,
                   ids: np.ndarray, grads: np.ndarray, lr: float) -> None:
         """Apply a push at most once per request id (client retries may
-        re-deliver a push whose response was lost)."""
-        if req_id:
+        re-deliver a push whose response was lost).
+
+        The id is recorded as applied only AFTER ``table.push`` succeeds
+        — recording first would turn a push that raised into a permanent
+        silent drop (the retry would be deduped against nothing).  A
+        concurrent duplicate arriving while the first attempt is still in
+        flight WAITS for that attempt's outcome: returning early would
+        ack (200) a gradient the original may still fail to apply — if
+        it then raised, the client would never retry and the gradient
+        would be lost.  If the original fails, the waiting duplicate
+        applies the push itself."""
+        if not req_id:
+            table.push(ids, grads, lr)
+            return
+        while True:
             with self._lock:
-                if req_id in self._applied:
+                st = self._applied.get(req_id)
+                if isinstance(st, float):       # applied: dedup
                     return
-                self._applied[req_id] = None
-                while len(self._applied) > self._applied_limit:
-                    self._applied.pop(next(iter(self._applied)))
-        table.push(ids, grads, lr)
+                if st is None:                  # ours to apply
+                    marker = threading.Event()
+                    self._applied[req_id] = marker
+                    break
+            # in flight on another thread: wait for its outcome, then
+            # re-check (applied -> return; failed/evicted -> we apply)
+            st.wait(timeout=60.0)
+        try:
+            table.push(ids, grads, lr)
+        except BaseException:
+            with self._lock:                    # let the retry re-apply
+                self._applied.pop(req_id, None)
+            marker.set()
+            raise
+        now = time.monotonic()
+        with self._lock:
+            self._applied[req_id] = now
+            # age-based eviction from the front (insertion order == start
+            # order, and dict reassignment keeps the original position);
+            # stop at the first young or still-in-flight entry
+            while self._applied:
+                k = next(iter(self._applied))
+                v = self._applied[k]
+                if not isinstance(v, float) \
+                        or now - v <= self._applied_retention_s:
+                    break
+                del self._applied[k]
+            # size backstop: evict oldest COMPLETED entries only — popping
+            # an in-flight Event marker would let a retry double-apply
+            # concurrently with the still-running original
+            while len(self._applied) > self._applied_limit:
+                victim = next((k for k, v in self._applied.items()
+                               if isinstance(v, float)), None)
+                if victim is None:
+                    break
+                del self._applied[victim]
+        marker.set()
 
     def restore(self, checkpoint_path: str) -> bool:
         """Load the shard's snapshot if one exists.  Returns whether state

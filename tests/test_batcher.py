@@ -214,3 +214,77 @@ class TestScheduler:
             # either it finished before close (fine) or it errors
             out = r.result(timeout=10)
             pytest.skip("finished before close")
+
+    def test_capacity_bound_counts_prefill_first_token(self, setup):
+        """The FIRST token is sampled from prefill logits, so only
+        max_new-1 ride chunk steps: prompt 59 + max_new 5 at chunk 4
+        needs cache positions through 59 + ceil(4/4)*4 = 63 < max_len.
+        The old ceil(max_new/chunk) bound (59 + 8 = 67 > 64) rejected
+        this in-capacity request (ADVICE r4)."""
+        model, cfg, params = setup
+        p = _prompt(cfg, 59, seed=21)
+        b = _batcher(cfg, params)
+        try:
+            out = b.submit(np.asarray(p[0]),
+                           max_new_tokens=5).result(timeout=120)
+            ref = D.generate(params, cfg, p, max_new_tokens=5,
+                             max_len=MAX_LEN)
+            assert out == np.asarray(ref[0]).tolist()
+            # past the worst-case position it must still be rejected
+            with pytest.raises(ValueError, match="exceeds max_len"):
+                b.submit(list(range(1, 62)), max_new_tokens=5)
+        finally:
+            b.close()
+
+    @staticmethod
+    def _slow_step(b, delay=0.05):
+        """Pace the ring's chunk step so 'cancel observed before the
+        budget runs out' is a multi-second window, not a scheduler race
+        (the tiny CPU model can otherwise decode a whole budget in the
+        gap between stream() yielding and cancel() being set)."""
+        orig = b._step
+
+        def paced(*a):
+            time.sleep(delay)
+            return orig(*a)
+
+        b._step = paced
+
+    def test_cancel_evicts_lane_and_frees_capacity(self, setup):
+        """cancel() mid-generation: the request resolves with a partial
+        sequence at the next chunk boundary and its lane admits the next
+        queued request (a disconnect-abandoned stream must not hold its
+        lane to the full token budget — ADVICE r4)."""
+        model, cfg, params = setup
+        b = _batcher(cfg, params, slots=1, chunk_tokens=2)
+        self._slow_step(b)
+        try:
+            long = b.submit([3, 1, 4, 1, 5], max_new_tokens=40,
+                            stream=True)
+            it = long.stream(timeout=120)
+            next(it)                      # generation is under way
+            long.cancel()
+            out = long.result(timeout=120)
+            assert 5 <= len(out) < 5 + 40   # partial, prompt included
+            # the freed lane serves the next request to completion
+            nxt = b.submit([2, 7, 1], max_new_tokens=4)
+            ref = D.generate(params, cfg,
+                             jnp.asarray([[2, 7, 1]], jnp.int32),
+                             max_new_tokens=4, max_len=MAX_LEN)
+            assert nxt.result(timeout=120) == np.asarray(ref[0]).tolist()
+        finally:
+            b.close()
+
+    def test_cancel_before_admission_resolves_immediately(self, setup):
+        model, cfg, params = setup
+        b = _batcher(cfg, params, slots=1)
+        self._slow_step(b)
+        try:
+            hog = b.submit([1, 2, 3], max_new_tokens=24)
+            queued = b.submit([4, 5], max_new_tokens=24)
+            queued.cancel()
+            out = queued.result(timeout=120)
+            assert out[:2] == [4, 5] and len(out) < 2 + 24
+            hog.result(timeout=120)
+        finally:
+            b.close()

@@ -401,3 +401,58 @@ class TestDurability:
         assert not np.allclose(once, before)
         store.push_once("rid-2", t, ids, g, lr=0.1)   # new id applies
         assert not np.allclose(t.rows, once)
+
+    def test_failed_push_is_not_recorded_as_applied(self):
+        """If table.push raises, the request id must NOT be recorded —
+        the retry would otherwise be deduped against a push that never
+        happened, silently dropping the gradient (ADVICE r4)."""
+        from paddle_operator_tpu.ps.server import EmbeddingStore
+
+        store = EmbeddingStore(0, 1)
+        t = store.ensure("t", 8, 2, seed=0)
+        ids = np.arange(4)
+        g = np.ones((4, 2), np.float32)
+        real_push, calls = t.push, []
+
+        def flaky(*a, **kw):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("transient")
+            return real_push(*a, **kw)
+
+        t.push = flaky
+        before = t.rows.copy()
+        with pytest.raises(RuntimeError, match="transient"):
+            store.push_once("rid-x", t, ids, g, lr=0.1)
+        np.testing.assert_array_equal(t.rows, before)
+        store.push_once("rid-x", t, ids, g, lr=0.1)     # retry applies
+        assert not np.allclose(t.rows, before)
+        store.push_once("rid-x", t, ids, g, lr=0.1)     # now deduped
+        assert len(calls) == 2
+
+    def test_dedup_eviction_is_age_bounded(self):
+        """High push rates must not evict a req_id inside the client's
+        retry window: eviction is by age (retention > retry deadline),
+        not position in a small FIFO."""
+        from paddle_operator_tpu.ps.server import EmbeddingStore
+
+        store = EmbeddingStore(0, 1)
+        t = store.ensure("t", 8, 2, seed=0)
+        ids = np.arange(4)
+        g = np.ones((4, 2), np.float32)
+        store.push_once("rid-old", t, ids, g, lr=0.1)
+        once = t.rows.copy()
+        # a flood of fresh ids far beyond the old 4096-entry FIFO cap
+        for i in range(5000):
+            store._applied[f"flood-{i}"] = store._applied["rid-old"]
+        store.push_once("rid-new", t, ids, g, lr=0.1)
+        # rid-old is young (just pushed): still deduped after the flood
+        after = t.rows.copy()
+        store.push_once("rid-old", t, ids, g, lr=0.1)
+        np.testing.assert_array_equal(t.rows, after)
+        assert not np.allclose(after, once)
+        # aged-out entries ARE evicted once past retention
+        past = __import__("time").monotonic() - 1000.0
+        store._applied = {k: past for k in list(store._applied)[:100]}
+        store.push_once("rid-evict-trigger", t, ids, g, lr=0.1)
+        assert not any(v == past for v in store._applied.values())

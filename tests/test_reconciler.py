@@ -527,6 +527,26 @@ class TestSliceAtomicClamp:
         assert st.elastic != "ERROR"
         assert not any(e["reason"] == "ElasticParked" for e in api.events)
 
+    def test_below_min_edit_on_completed_job_does_not_warn(self, env):
+        # a finished job edited so the slice-atomic snap lands under its
+        # requests floor is equally moot — no pods will run at the
+        # clamped count, so no ElasticSliceClamp warning (ADVICE r4)
+        api, rec, fleet = env
+        submit(api, workers=4,
+               tpu=TPUSpec(topology="2x4", chips_per_worker=4, slice_count=2))
+        drive(api, rec, fleet)
+        fleet.succeed_all()
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.COMPLETED
+        raw = api.get(KIND_JOB, NS, "tj")
+        raw["spec"]["worker"]["requests"] = 3
+        raw["spec"]["worker"]["limits"] = 3
+        api.update(KIND_JOB, raw)
+        run_to_settled(rec, NS, "tj")
+        assert job_status(api).phase == Phase.COMPLETED
+        assert not any(e["reason"] == "ElasticSliceClamp"
+                       for e in api.events)
+
 
 class TestScaleDownServices:
     def test_services_pruned_with_pods(self, env):
