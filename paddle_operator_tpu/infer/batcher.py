@@ -55,12 +55,14 @@ from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
 
 def init_ring_cache(cfg: LlamaConfig, slots: int,
                     max_len: int) -> Dict[str, jax.Array]:
-    """KV ring: like decode.init_cache (same head-major layout) but with
-    a per-lane fill position vector instead of one scalar."""
+    """KV ring: like decode.init_cache (same head-major layout,
+    block-aligned allocation) but with a per-lane fill position vector
+    instead of one scalar."""
     if max_len > cfg.max_seq_len:
         raise ValueError(f"max_len {max_len} exceeds the RoPE table "
                          f"(cfg.max_seq_len={cfg.max_seq_len})")
-    shape = (cfg.n_layers, slots, cfg.n_kv_heads, max_len, cfg.head_dim)
+    alloc = D.cache_alloc_len(max_len)
+    shape = (cfg.n_layers, slots, cfg.n_kv_heads, alloc, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -76,24 +78,17 @@ def _write_lane(cache_l: jax.Array, kv: jax.Array,
     )(cache_l, kv, pos)
 
 
-def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
-                cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
-                v_cache: jax.Array, pos: jax.Array
-                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decoder layer for ONE new token per lane ([B, 1, D] at lane
-    positions ``pos`` [B]).  Same math as decode._layer (which this is
-    pinned against) with the scalar position generalized to a vector."""
+def _qkv_ring(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+              cos: jax.Array, sin: jax.Array, pos: jax.Array):
+    """Pre-attention half for ONE new token per lane at per-lane
+    positions ``pos`` [B]: RMSNorm -> projections -> RoPE at each
+    lane's own position (the table slice is a plain gather cos[pos])."""
     b = x.shape[0]
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-
     h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, 1, hq, d)
     k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
     v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
-
-    # RoPE at each lane's own position: t=1, so the table slice is a
-    # plain gather cos[pos] [B, d/2] (decode._rope's dynamic_slice
-    # specialized to one row per lane)
     cos_b = cos[pos][:, None, None, :]          # [B, 1, 1, d/2]
     sin_b = sin[pos][:, None, None, :]
 
@@ -103,31 +98,37 @@ def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
             [t1 * cos_b - t2 * sin_b, t2 * cos_b + t1 * sin_b],
             axis=-1).astype(t.dtype)
 
-    q, k = rot(q), rot(k)
+    return rot(q), rot(k), v
+
+
+def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+                cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
+                v_cache: jax.Array, pos: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer for ONE new token per lane ([B, 1, D] at lane
+    positions ``pos`` [B]) with the XLA einsum attention.  Same math as
+    decode._layer (which this is pinned against) with the scalar
+    position generalized to a vector.  The pallas path keeps the caches
+    stacked and does not go through here (see _ring_forward)."""
+    b = x.shape[0]
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
     k_cache = _write_lane(k_cache, k.transpose(0, 2, 1, 3), pos)
     v_cache = _write_lane(v_cache, v.transpose(0, 2, 1, 3), pos)
 
-    if cfg.decode_attn != "xla":
-        from paddle_operator_tpu.ops.decode_attention import decode_attention
-
-        out = decode_attention(
-            q[:, 0], k_cache, v_cache, pos + 1,
-            interpret=(cfg.decode_attn == "pallas-interpret"))
-        out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
-    else:
-        n_rep = hq // hkv
-        max_len = k_cache.shape[2]
-        qg = q.reshape(b, 1, hkv, n_rep, d)
-        scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
-                            preferred_element_type=jnp.float32) / jnp.sqrt(
-            jnp.float32(d))
-        # lane b may attend cache cols [0, pos_b] (its own new row incl.)
-        mask = jnp.arange(max_len)[None, :] <= pos[:, None]      # [B, S]
-        scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
-                         v_cache, preferred_element_type=jnp.float32)
-        out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+    n_rep = hq // hkv
+    max_len = k_cache.shape[2]
+    qg = q.reshape(b, 1, hkv, n_rep, d)
+    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    # lane b may attend cache cols [0, pos_b] (its own new row incl.)
+    mask = jnp.arange(max_len)[None, :] <= pos[:, None]      # [B, S]
+    scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
     x = x + D._mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
 
     n = D._rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
@@ -141,23 +142,67 @@ def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     return x + ffn, k_cache, v_cache
 
 
+def _write_lane_stacked(stack: jax.Array, kv: jax.Array, li: jax.Array,
+                        pos: jax.Array) -> jax.Array:
+    """[L, B, H, S, D] stacked cache <- [B, H, 1, D] new rows at layer
+    ``li`` and per-lane positions ``pos``.
+
+    One dynamic_update_slice PER LANE (a static unroll over the slot
+    count), not a vmapped/batched update: vmapping over ragged lane
+    positions lowers to a scatter, and a scatter into the scan-carried
+    stack makes XLA materialize a copy of the whole ring cache per
+    layer per tick — measured 30x slower than raw decode.  Chained
+    single-row dus ops update the carry in place."""
+    b = kv.shape[0]
+    for lane in range(b):
+        stack = jax.lax.dynamic_update_slice(
+            stack, kv[lane][None, None], (li, lane, 0, pos[lane], 0))
+    return stack
+
+
 def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
                   tok: jax.Array, cache: Dict[str, jax.Array]
                   ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """tok [B] at per-lane cache['pos'] -> (logits [B, V], advanced
-    cache).  Counterpart of decode._forward for vector positions."""
+    cache).  Counterpart of decode._forward for vector positions; like
+    it, the pallas path carries the caches STACKED through the layer
+    scan so the kernel reads them copy-free (decode.py _forward has the
+    why)."""
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tok[:, None]]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
 
-    def body(x, layer_in):
-        lp, k_c, v_c = layer_in
-        y, k_c, v_c = _layer_step(cfg, lp, x, cos, sin, k_c, v_c, pos)
-        return y, (k_c, v_c)
+    attn_impl = cfg.resolved_decode_attn()
+    if attn_impl != "xla":
+        from paddle_operator_tpu.ops.decode_attention import decode_attention
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+        b = x.shape[0]
+        hq, d = cfg.n_heads, cfg.head_dim
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
+            vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
+            out = decode_attention(
+                q[:, 0], kc, vc, pos + 1, layer=li,
+                interpret=(attn_impl == "pallas-interpret"))
+            out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+            return (D._finish_layer(cfg, lp, x, out), kc, vc), ()
+
+        (x, k_new, v_new), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    else:
+        def body(x, layer_in):
+            lp, k_c, v_c = layer_in
+            y, k_c, v_c = _layer_step(cfg, lp, x, cos, sin, k_c, v_c, pos)
+            return y, (k_c, v_c)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
     x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     logits = D._mm(x, params["lm_head"]["kernel"],
                    cfg.dtype).astype(jnp.float32)
@@ -249,7 +294,8 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int):
 
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
-                 "done", "out", "error", "_stream", "_cancel")
+                 "done", "out", "error", "_stream", "_cancel",
+                 "dev_prompt", "bucket")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
                  wants_stream=False):
@@ -262,6 +308,12 @@ class _Request:
         self.out: Optional[List[int]] = None
         self.error: Optional[Exception] = None
         self._cancel = False
+        # padded prompt, transferred to device on the SUBMIT thread
+        # (batcher.submit): on relayed chips a host->device copy costs a
+        # full round-trip, and paying it on the decode-ring thread
+        # stalls every lane; caller threads pay it concurrently instead
+        self.dev_prompt: Optional[jax.Array] = None
+        self.bucket: int = 0
         # token streaming is opt-in (submit(stream=True)): the dominant
         # result()-only path must not pay per-token queue puts inside
         # the decode-ring thread that gates every lane's throughput
@@ -319,12 +371,21 @@ class ContinuousBatcher:
                  max_len: Optional[int] = None, chunk_tokens: int = 8,
                  prefill_buckets: Tuple[int, ...] = (),
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None) -> None:
+                 top_p: Optional[float] = None,
+                 pipeline_depth: int = 2) -> None:
         self.params = params
         self.cfg = cfg
         self.slots = slots
         self.max_len = max_len or cfg.max_seq_len
         self.chunk = chunk_tokens
+        # max dispatched-but-unconsumed chunks; the oldest is consumed
+        # once `depth` are in flight, so depth 2 = one chunk always
+        # decoding while the host consumes the previous one (depth 1
+        # disables the overlap entirely).  Deeper than 2 delays the
+        # eviction bookkeeping by depth-1 chunks, so freed lanes sit
+        # idle before re-admission — lane turnover costs more than the
+        # extra hidden round-trip saves (measured).
+        self.pipeline_depth = max(1, pipeline_depth)
         self.buckets = tuple(sorted(prefill_buckets)) or _default_buckets(
             self.max_len)
         self._top_k, self._top_p = top_k, top_p
@@ -381,6 +442,12 @@ class ContinuousBatcher:
                 f"exceeds max_len ({self.max_len})")
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        eos_token, wants_stream=stream)
+        # pad + ship the prompt to the device HERE, on the caller's
+        # thread — see _Request.dev_prompt
+        req.bucket = self._bucket_for(len(prompt))
+        padded = np.zeros((1, req.bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        req.dev_prompt = jnp.asarray(padded)
         self._pending.put(req)
         if self._stop.is_set() and not req.done.is_set():
             # loop died between the liveness check above and the put:
@@ -410,11 +477,8 @@ class ContinuousBatcher:
         host round-trip EACH (measured to dominate served throughput on
         relayed chips).  The first token materializes at the next chunk
         consume (:meth:`_materialize_first`)."""
-        b = self._bucket_for(len(req.prompt))
-        padded = np.zeros((1, b), np.int32)
-        padded[0, :len(req.prompt)] = req.prompt
-        self.cache, logits = self._inserts[b](
-            self.params, self.cache, jnp.asarray(padded),
+        self.cache, logits = self._inserts[req.bucket](
+            self.params, self.cache, req.dev_prompt,
             jnp.int32(len(req.prompt)), jnp.int32(slot))
         # sample the FIRST new token from the prefill logits with the
         # same rule the chunk step uses — on device, no sync
@@ -426,6 +490,10 @@ class ContinuousBatcher:
             first = jax.random.categorical(key, filt).astype(jnp.int32)
         else:
             first = logits.argmax().astype(jnp.int32)
+        try:                            # ship the first token host-ward
+            first.copy_to_host_async()  # early: TTFT then needs no
+        except AttributeError:          # extra round-trip at consume
+            pass
         self.tok = self.tok.at[slot].set(first)
         self.temp = self.temp.at[slot].set(req.temperature)
         self.keys = self.keys.at[slot].set(
@@ -522,13 +590,15 @@ class ContinuousBatcher:
                 self._evict(i)
 
     def _loop_body(self) -> None:
-        # One chunk in flight at all times (when lanes are active): the
-        # host consumes chunk N's tokens — per-token queue pushes, evict
-        # bookkeeping, and crucially the device->host transfer latency —
-        # WHILE the device decodes chunk N+1.  Without this the ring
-        # serializes RTT with compute and served throughput halves on
-        # relayed chips (measured by bench.py measure_ring_throughput).
-        pending = None                  # (chunk_reqs, device toks)
+        # Up to ``pipeline_depth`` chunks in flight at all times (when
+        # lanes are active): the host consumes chunk N's tokens — per-
+        # token queue pushes, evict bookkeeping, and crucially the
+        # device->host transfer latency — WHILE the device decodes
+        # chunks N+1..N+depth.  Without this the ring serializes RTT
+        # with compute; depth 1 was still RTT-bound on relayed chips
+        # whose round-trip exceeds a chunk's device time (measured by
+        # bench.py measure_ring_throughput), hence depth 2 by default.
+        pending: List[tuple] = []       # [(chunk_reqs, device toks)]
         while not self._stop.is_set():
             # cancelled lanes leave at the chunk boundary: the request
             # resolves with whatever tokens it has, the lane frees for
@@ -557,9 +627,8 @@ class ContinuousBatcher:
             active_idx = [i for i, r in enumerate(self.lane)
                           if r is not None]
             if not active_idx:
-                if pending is not None:
-                    chunk_reqs, toks_dev = pending
-                    pending = None
+                if pending:
+                    chunk_reqs, toks_dev = pending.pop(0)
                     self._consume(chunk_reqs, np.asarray(toks_dev))
                     continue            # eviction may have freed lanes
                 self._wake.wait(timeout=0.1)
@@ -575,11 +644,19 @@ class ContinuousBatcher:
                 self.params, self.cache, self.tok, self.temp, self.keys,
                 active)
             self.stats["chunks"] += 1
-            chunk_reqs = [(i, self.lane[i]) for i in active_idx]
-            if pending is not None:
-                prev_reqs, prev_toks = pending
-                self._consume(prev_reqs, np.asarray(prev_toks))
-            pending = (chunk_reqs, toks_dev)
+            # kick the device->host copy NOW, before the consume wait:
+            # by consume time the tokens are already on the wire and
+            # np.asarray is a cheap completion wait instead of a full
+            # round-trip on the ring's critical path
+            try:
+                toks_dev.copy_to_host_async()
+            except AttributeError:      # interpret-mode ndarray
+                pass
+            pending.append(([(i, self.lane[i]) for i in active_idx],
+                            toks_dev))
+            if len(pending) >= self.pipeline_depth:
+                chunk_reqs, toks_dev = pending.pop(0)
+                self._consume(chunk_reqs, np.asarray(toks_dev))
 
 
 def _default_buckets(max_len: int) -> Tuple[int, ...]:

@@ -67,21 +67,43 @@ def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array,
     return out.astype(x.dtype)
 
 
+def cache_alloc_len(max_len: int) -> int:
+    """Allocation length for a KV cache of logical capacity ``max_len``:
+    rounded up to a whole number of pallas key blocks
+    (ops/decode_attention.py DEFAULT_BLOCK_K) so the kernel never has to
+    shrink its block to divide an odd length — S=2240 would force
+    64-wide blocks whose per-cell overhead measured 4x slower than
+    256-wide.  Padding is dead weight only to the einsum path (it reads
+    the full allocation), bounded at +255 positions — noise next to the
+    weight stream at short caches and <12% of cache bytes beyond 2k.
+    Lengths within one block stay exact (tiny test caches)."""
+    from paddle_operator_tpu.ops.decode_attention import DEFAULT_BLOCK_K
+
+    if max_len <= DEFAULT_BLOCK_K:
+        return max_len
+    return -(-max_len // DEFAULT_BLOCK_K) * DEFAULT_BLOCK_K
+
+
 def init_cache(cfg: LlamaConfig, batch: int,
                max_len: Optional[int] = None) -> Dict[str, jax.Array]:
-    """Fixed-size KV cache: k/v [L, B, H_kv, max_len, D] in compute
+    """Fixed-size KV cache: k/v [L, B, H_kv, alloc, D] in compute
     dtype, plus the fill position (scalar int32).  Head-major layout:
     per-head rows are contiguous, which is what both the XLA attention
     einsums and the pallas decode kernel (ops/decode_attention.py) want
     as their DMA/contraction unit — token-major measured 0.64x on the
-    kernel from per-head strided relayouts.  max_len may not exceed
-    cfg.max_seq_len: positions past the RoPE table would silently clamp
-    (dynamic_slice semantics) and corrupt the rotary phases."""
+    kernel from per-head strided relayouts.  The allocation is
+    block-aligned (:func:`cache_alloc_len`); positions past the LOGICAL
+    ``max_len`` are never written or attended (the fill mask covers
+    them), so the RoPE bound below checks the requested capacity, not
+    the padded allocation.  max_len may not exceed cfg.max_seq_len:
+    positions past the RoPE table would silently clamp (dynamic_slice
+    semantics) and corrupt the rotary phases."""
     max_len = max_len or cfg.max_seq_len
     if max_len > cfg.max_seq_len:
         raise ValueError(f"cache max_len {max_len} exceeds the RoPE table "
                          f"(cfg.max_seq_len={cfg.max_seq_len})")
-    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    alloc = cache_alloc_len(max_len)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, alloc, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),
@@ -89,66 +111,25 @@ def init_cache(cfg: LlamaConfig, batch: int,
     }
 
 
-def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
-           cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
-           v_cache: jax.Array, pos: jax.Array
-           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decoder layer over [B, T] new positions starting at ``pos``,
-    attending to the cache's [0, pos+T).  Returns (y, k_cache', v_cache').
-    lp is ONE layer's param subtree (unstacked); caches are head-major
-    [B, H_kv, S, D] (init_cache)."""
+def _qkv(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+         cos: jax.Array, sin: jax.Array, pos: jax.Array
+         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pre-attention half of a decoder layer: RMSNorm -> q/k/v
+    projections -> RoPE at offset ``pos``.  Shapes [B, T, H, D]."""
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-
     h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     q = _mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
     k = _mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
     v = _mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
-    q = _rope(q, cos, sin, pos)
-    k = _rope(k, cos, sin, pos)
+    return _rope(q, cos, sin, pos), _rope(k, cos, sin, pos), v
 
-    # [B, T, H, D] -> head-major [B, H, T, D] rows into the cache
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.transpose(0, 2, 1, 3), (0, 0, pos, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.transpose(0, 2, 1, 3), (0, 0, pos, 0))
 
-    if t == 1 and cfg.decode_attn != "xla":
-        # hot decode path: the pallas single-query kernel reads only the
-        # FILLED cache prefix (ops/decode_attention.py)
-        from paddle_operator_tpu.ops.decode_attention import decode_attention
-
-        out = decode_attention(
-            q[:, 0], k_cache, v_cache,
-            jnp.broadcast_to(pos + 1, (b,)),
-            interpret=(cfg.decode_attn == "pallas-interpret"))
-        out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
-    else:
-        # GQA: group query heads onto kv heads; single-query (or prefill-
-        # block) attention against the cache with a causal+fill mask.  The
-        # einsums read the cache in its storage dtype and accumulate in f32
-        # (preferred_element_type) — upcasting the cache itself would
-        # stream a full f32 copy of it from HBM every step, doubling the
-        # bandwidth of the decode hot loop.
-        n_rep = hq // hkv
-        max_len = k_cache.shape[2]
-        qg = q.reshape(b, t, hkv, n_rep, d)
-        # scores [B, T, Hkv, n_rep, max_len]; rows may attend cache cols
-        # up to their own absolute position (causal + fill mask in one)
-        scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
-                            preferred_element_type=jnp.float32) / jnp.sqrt(
-            jnp.float32(d))
-        cols = jnp.arange(max_len)                           # [S]
-        rows = pos + jnp.arange(t)                           # [T]
-        mask = cols[None, :] <= rows[:, None]                # [T, S]
-        scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
-                         v_cache, preferred_element_type=jnp.float32)
-        out = out.reshape(b, t, hq * d).astype(cfg.dtype)
-    attn_out = _mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
-
-    x = x + attn_out
+def _finish_layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+                  out: jax.Array) -> jax.Array:
+    """Post-attention half: output projection + residual, then the
+    (dense SwiGLU or MoE) FFN + residual."""
+    x = x + _mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
     n = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     if cfg.n_experts > 0:
         ffn = _moe_ffn(cfg, lp["moe"], n)
@@ -157,7 +138,52 @@ def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
         up = _mm(n, lp["mlp"]["w3"]["kernel"], cfg.dtype)
         ffn = _mm(jax.nn.silu(gate) * up, lp["mlp"]["w2"]["kernel"],
                   cfg.dtype)
-    return x + ffn, k_cache, v_cache
+    return x + ffn
+
+
+def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+           cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
+           v_cache: jax.Array, pos: jax.Array
+           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer over [B, T] new positions starting at ``pos``,
+    attending to the cache's [0, pos+T), with the XLA einsum attention.
+    Returns (y, k_cache', v_cache').  lp is ONE layer's param subtree
+    (unstacked); caches are head-major [B, H_kv, S, D] (init_cache).
+    The pallas decode path does NOT go through here — it keeps the
+    caches stacked (see _forward) so the kernel reads them copy-free."""
+    b, t, _ = x.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q, k, v = _qkv(cfg, lp, x, cos, sin, pos)
+
+    # [B, T, H, D] -> head-major [B, H, T, D] rows into the cache
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.transpose(0, 2, 1, 3), (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.transpose(0, 2, 1, 3), (0, 0, pos, 0))
+
+    # GQA: group query heads onto kv heads; single-query (or prefill-
+    # block) attention against the cache with a causal+fill mask.  The
+    # einsums read the cache in its storage dtype and accumulate in f32
+    # (preferred_element_type) — upcasting the cache itself would
+    # stream a full f32 copy of it from HBM every step, doubling the
+    # bandwidth of the decode hot loop.
+    n_rep = hq // hkv
+    max_len = k_cache.shape[2]
+    qg = q.reshape(b, t, hkv, n_rep, d)
+    # scores [B, T, Hkv, n_rep, max_len]; rows may attend cache cols
+    # up to their own absolute position (causal + fill mask in one)
+    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    cols = jnp.arange(max_len)                           # [S]
+    rows = pos + jnp.arange(t)                           # [T]
+    mask = cols[None, :] <= rows[:, None]                # [T, S]
+    scores = jnp.where(mask[None, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    out = out.reshape(b, t, hq * d).astype(cfg.dtype)
+    return _finish_layer(cfg, lp, x, out), k_cache, v_cache
 
 
 def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
@@ -204,13 +230,45 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
 
-    def body(x, layer_in):
-        lp, k_c, v_c = layer_in
-        y, k_c, v_c = _layer(cfg, lp, x, cos, sin, k_c, v_c, pos)
-        return y, (k_c, v_c)
+    attn_impl = cfg.resolved_decode_attn()
+    if tokens.shape[1] == 1 and attn_impl != "xla":
+        # pallas decode path: the caches stay STACKED [L, B, H, S, D]
+        # and flow as scan CARRY, with the layer index steering the
+        # kernel's block index map.  Scanning them as xs (the einsum
+        # structure below) would slice each layer out first, and a
+        # dynamic-slice that feeds a pallas custom-call must be
+        # materialized by XLA — a per-layer copy of the layer's whole
+        # cache, measured +170us/layer at b8.
+        from paddle_operator_tpu.ops.decode_attention import decode_attention
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+        b = x.shape[0]
+        hq, d = cfg.n_heads, cfg.head_dim
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv(cfg, lp, x, cos, sin, pos)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
+            out = decode_attention(
+                q[:, 0], kc, vc, jnp.broadcast_to(pos + 1, (b,)),
+                layer=li, interpret=(attn_impl == "pallas-interpret"))
+            out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+            return (_finish_layer(cfg, lp, x, out), kc, vc), ()
+
+        (x, k_new, v_new), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    else:
+        def body(x, layer_in):
+            lp, k_c, v_c = layer_in
+            y, k_c, v_c = _layer(cfg, lp, x, cos, sin, k_c, v_c, pos)
+            return y, (k_c, v_c)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
     if last_only:
         x = x[:, -1:]
     x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)

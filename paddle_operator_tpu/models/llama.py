@@ -63,11 +63,22 @@ class LlamaConfig:
     moe_aux_weight: float = 0.01
     # Single-query attention implementation for the DECODE path
     # (infer/decode.py, infer/batcher.py; training is untouched):
-    # "xla" (dense einsum over the full allocated cache), "pallas"
-    # (ops/decode_attention.py — reads only the FILLED prefix; the
-    # long-context serving kernel), "pallas-interpret" (same kernel in
-    # interpreter mode — CPU tests).
-    decode_attn: str = "xla"
+    # "auto" (pallas on TPU, einsum elsewhere — the default), "xla"
+    # (dense einsum over the full allocated cache), "pallas"
+    # (ops/decode_attention.py — reads only the FILLED prefix; measured
+    # >= the einsum at EVERY fill level on v5e, r5), "pallas-interpret"
+    # (same kernel in interpreter mode — CPU tests).
+    decode_attn: str = "auto"
+
+    def resolved_decode_attn(self) -> str:
+        """Resolve "auto" at trace time: the pallas filled-prefix kernel
+        on TPU, the XLA einsum everywhere else (interpret-mode pallas is
+        orders slower on CPU; the einsum is the CPU-correct path)."""
+        if self.decode_attn == "auto":
+            import jax
+
+            return "pallas" if jax.default_backend() == "tpu" else "xla"
+        return self.decode_attn
 
     @property
     def head_dim(self) -> int:
