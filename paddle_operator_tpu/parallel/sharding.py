@@ -109,17 +109,35 @@ def tree_shardings(tree: Any, mesh: Mesh,
 
     def leaf_sharding(path, leaf):
         pstr = _path_str(path)
-        # block-quantized optimizer-state leaves (train/opt8bit.py _Q8):
-        # their [n_blocks, BLOCK] layout has no correspondence to any
-        # param axis, so param partition patterns must not apply
-        if pstr.endswith(("q8_codes", "q8_scale")):
-            return NamedSharding(mesh, P())
+        # Block-quantized optimizer-state leaves (train/opt8bit.py _Q8)
+        # need NO special case: blocks ride the last param axis only, so
+        # codes are [*param_dims[:-1], n_blocks, BLOCK] and scales
+        # [*param_dims[:-1], n_blocks, 1] — the param's spec (matched
+        # below via the embedded param path) applies verbatim to the
+        # leading axes, a last-axis spec lands on the block-count dim
+        # (which subdivides that axis), and the generic None-padding
+        # covers the trailing block dim.  int8 moments therefore shard
+        # exactly like their params over fsdp/tp.
         lspec = spec_for_path(path_patterns, pstr)
         pspec = logical_to_mesh(lspec, rules, mesh)
         # drop trailing/overflow axes if the leaf has fewer dims
         ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
         parts = list(pspec)[:ndim]
         parts += [None] * (ndim - len(parts))
+        if pstr.endswith(("q8_codes", "q8_scale")):
+            # blocking can shrink an axis below the mesh factor (a 1D
+            # param's codes are [ceil(n/256), 256] — often one block):
+            # replicate any axis the blocked shape can no longer divide
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            shape = getattr(leaf, "shape", ())
+            for i, ax in enumerate(parts):
+                if ax is None or i >= len(shape):
+                    continue
+                n = 1
+                for a in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+                    n *= sizes.get(a, 1)
+                if shape[i] % n:
+                    parts[i] = None
         return NamedSharding(mesh, P(*parts))
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, tree)

@@ -19,15 +19,20 @@ f32 trajectory):
   moments and blow up their steps.  sqrt(nu) never goes negative, so
   its codes use the full [0, 254] range (offset -127 riding int8) —
   twice the resolution of signed absmax.
-- Scales are per-block f32; block boundaries ride the flattened tensor,
-  so layouts/shardings don't affect the math.
 
-Scope: a SINGLE-CHIP memory lever.  The blocked layout has no
-correspondence to any parameter axis, so the codes replicate on a
-multi-device mesh (parallel/sharding.py) and the flattened update would
-gather sharded gradients — trainer.state_shardings warns if int8
-moments meet a multi-device mesh.  Sharded 8-bit moments would need
-per-shard blocking; use f32 moments (sharded like params) there.
+**Shard-aware blocking** (VERDICT r4 item 3): blocks ride the LAST
+parameter axis only — a leaf ``[..., n]`` stores codes
+``[..., ceil(n/256), 256]`` and scales ``[..., ceil(n/256), 1]`` — so
+every LEADING axis of the codes corresponds 1:1 to the same parameter
+axis.  parallel/sharding.py can then apply the param's partition spec
+directly (the spec pads with None for the two trailing block dims, and
+a spec on the last param axis lands on the block-count dim, which
+subdivides it exactly): fsdp/tp-sharded params get fsdp/tp-sharded
+moments, each shard quantizing its own rows shard-locally — no
+replicated optimizer state, no cross-shard block seams.  The r4 layout
+flattened the whole leaf into [n_blocks, 256], which had no
+correspondence to any param axis and forced the codes to replicate on
+multi-device meshes (the r4 trainer warned about exactly this).
 
 ``adamw8bit`` mirrors optax.adamw's update rule (bias correction,
 decoupled weight decay, schedule support) and composes with
@@ -36,49 +41,74 @@ like any other opt-state leaf, at a quarter of the traffic).
 
 Reference scope note: the reference operator has no training runtime at
 all (user containers own it); this realizes the "int8 Adam moments"
-depth recipe from the round-3 review.
+depth recipe from the round-3 review, made mesh-ready in round 5.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
 BLOCK = 256
-# scan-chunk rows of the blocked update: 16384 rows x BLOCK = 4M values,
-# so dequantized f32 chunk temps stay ~16 MiB regardless of leaf size
-CHUNK_ROWS = 16384
+# leaves whose f32 image exceeds this are updated via a lax.scan over
+# leading-axis chunks so dequantized temps stay bounded (a stacked
+# dim-4096 MLP leaf is 1.44 GiB in f32; four such temps at once
+# measured OOM on one 16 GiB chip when the update ran whole-leaf)
+SCAN_BYTES = 64 * 1024 * 1024
+
+
+def _requant_blocks(x: jax.Array):
+    """Signed absmax requantization in the blocked domain — the ONE
+    implementation of the persistent encoding (quantize_q8 and the
+    in-update requant must never diverge)."""
+    s = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 127.0
+    s = jnp.where(s == 0.0, 1.0, s)
+    return jnp.round(x / s).astype(jnp.int8), s
+
+
+def _requant_blocks_u(x: jax.Array):
+    """Unsigned [0, 254]-range requantization for nonnegative blocks
+    (sqrt(nu)); codes ride int8 via the -127 offset."""
+    s = jnp.max(x, axis=-1, keepdims=True) / 254.0
+    s = jnp.where(s == 0.0, 1.0, s)
+    return (jnp.round(x / s) - 127.0).astype(jnp.int8), s
 
 
 class _Q8(NamedTuple):
     """One block-quantized tensor: int8 codes + per-block f32 scales.
     Field names are load-bearing: parallel/sharding.py tree_shardings
-    replicates leaves named q8_codes/q8_scale — block layout does not
-    correspond to any param axis, so param partition patterns must not
-    apply to it."""
+    recognizes q8_codes/q8_scale and extends the PARAM's partition spec
+    over the two trailing block dims (see module docstring)."""
 
-    q8_codes: jax.Array   # [n_blocks, BLOCK] int8
-    q8_scale: jax.Array   # [n_blocks, 1] f32
+    q8_codes: jax.Array   # [..., n_blocks, BLOCK] int8
+    q8_scale: jax.Array   # [..., n_blocks, 1] f32
 
 
 def _to_blocks(x: jax.Array) -> jax.Array:
-    flat = x.astype(jnp.float32).reshape(-1)
-    pad = (-flat.size) % BLOCK
+    """[..., n] -> [..., ceil(n/BLOCK), BLOCK] f32, zero-padded on the
+    last axis only — leading axes (and their shardings) are untouched."""
+    x = x.astype(jnp.float32)
+    if x.ndim == 0:
+        x = x.reshape(1)
+    pad = (-x.shape[-1]) % BLOCK
     if pad:
-        flat = jnp.pad(flat, (0, pad))
-    return flat.reshape(-1, BLOCK)
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    return x.reshape(*x.shape[:-1], -1, BLOCK)
+
+
+def _from_blocks(blocks: jax.Array, shape, dtype) -> jax.Array:
+    """Inverse of _to_blocks: strip last-axis padding, restore shape."""
+    last = shape[-1] if shape else 1
+    flat = blocks.reshape(*blocks.shape[:-2], -1)[..., :last]
+    return flat.reshape(shape).astype(dtype)
 
 
 def quantize_q8(x: jax.Array) -> _Q8:
     """Signed symmetric absmax encoding (mu: values carry sign)."""
-    blocks = _to_blocks(x)
-    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
-    scale = jnp.where(scale == 0.0, 1.0, scale)
-    q = jnp.round(blocks / scale).astype(jnp.int8)
-    return _Q8(q8_codes=q, q8_scale=scale)
+    return _Q8(*_requant_blocks(_to_blocks(x)))
 
 
 def quantize_q8u(x: jax.Array) -> _Q8:
@@ -86,18 +116,7 @@ def quantize_q8u(x: jax.Array) -> _Q8:
     [0, 254] code range rides int8 via a -127 offset — twice the
     resolution signed absmax would give a value that never goes
     negative."""
-    blocks = _to_blocks(x)
-    scale = jnp.max(blocks, axis=1, keepdims=True) / 254.0
-    scale = jnp.where(scale == 0.0, 1.0, scale)
-    q = (jnp.round(blocks / scale) - 127.0).astype(jnp.int8)
-    return _Q8(q8_codes=q, q8_scale=scale)
-
-
-def _from_blocks(flat: jax.Array, shape, dtype) -> jax.Array:
-    n = 1
-    for s in shape:
-        n *= s
-    return flat.reshape(-1)[:n].reshape(shape).astype(dtype)
+    return _Q8(*_requant_blocks_u(_to_blocks(x)))
 
 
 def dequantize_q8(qt: _Q8, shape, dtype=jnp.float32) -> jax.Array:
@@ -135,73 +154,65 @@ def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.999,
         b1c = 1 - b1 ** count.astype(jnp.float32)
         b2c = 1 - b2 ** count.astype(jnp.float32)
 
-        def requant(x):
-            # signed: x [rows, BLOCK] f32 -> (int8 codes, f32 scales)
-            s = jnp.max(jnp.abs(x), axis=1, keepdims=True) / 127.0
-            s = jnp.where(s == 0.0, 1.0, s)
-            return jnp.round(x / s).astype(jnp.int8), s
-
-        def requant_u(x):
-            # unsigned (nonnegative x): codes span [0, 254] via -127
-            s = jnp.max(x, axis=1, keepdims=True) / 254.0
-            s = jnp.where(s == 0.0, 1.0, s)
-            return (jnp.round(x / s) - 127.0).astype(jnp.int8), s
+        def blocked_update(gb, mc, ms, nc, ns):
+            """The Adam math in the blocked domain; all elementwise over
+            [..., nb, BLOCK] plus per-block reductions — partitions
+            shard-locally under any leading-axis sharding."""
+            mu = b1 * (mc.astype(jnp.float32) * ms) + (1 - b1) * gb
+            nu_root = (nc.astype(jnp.float32) + 127.0) * ns
+            nu = b2 * (nu_root * nu_root) + (1 - b2) * (gb * gb)
+            upd = (mu / b1c) / (jnp.sqrt(nu / b2c) + eps)
+            new_mc, new_ms = _requant_blocks(mu)
+            new_nc, new_ns = _requant_blocks_u(jnp.sqrt(nu))
+            return upd, new_mc, new_ms, new_nc, new_ns
 
         def one(g, mu_q, nu_q):
-            # The whole update is elementwise, so it runs in the BLOCKED
-            # domain under a lax.scan over row chunks: dequantized f32
-            # moments exist only at chunk size, never as full-leaf temps
-            # (a stacked dim-4096 MLP leaf is 1.34 GiB in f32 — measured
-            # OOM when the update materialized it whole).
             shape, dtype = g.shape, g.dtype
-            size = 1
-            for s in shape:
-                size *= s
-            flat = g.astype(jnp.float32).reshape(-1)
-            pad = (-size) % BLOCK
-            if pad:
-                flat = jnp.pad(flat, (0, pad))
-            gb = flat.reshape(-1, BLOCK)
-            n = gb.shape[0]
-            chunk = min(CHUNK_ROWS, n)
-            rpad = (-n) % chunk
-            mu_c, mu_s = mu_q.q8_codes, mu_q.q8_scale
-            nu_c, nu_s = nu_q.q8_codes, nu_q.q8_scale
-            if rpad:
-                gb = jnp.pad(gb, ((0, rpad), (0, 0)))
-                mu_c = jnp.pad(mu_c, ((0, rpad), (0, 0)))
-                nu_c = jnp.pad(nu_c, ((0, rpad), (0, 0)))
-                mu_s = jnp.pad(mu_s, ((0, rpad), (0, 0)),
-                               constant_values=1.0)
-                nu_s = jnp.pad(nu_s, ((0, rpad), (0, 0)),
-                               constant_values=1.0)
-            steps = (n + rpad) // chunk
+            gb = _to_blocks(g)
+            size_f32 = 4 * gb.size
+            lead = gb.shape[0] if gb.ndim > 2 else 1
+            n_chunks = min(lead, -(-size_f32 // SCAN_BYTES))
+            if n_chunks > 1:
+                # big leaf (stacked layers [L, d, f], embeddings
+                # [V, d]): chunk the update over the leading axis so
+                # dequantized f32 temps stay ~SCAN_BYTES.  The chunk
+                # COUNT is bounded (<= lead, ~size/SCAN_BYTES) — a raw
+                # per-row scan over a 32k-vocab embedding would
+                # serialize 32000 micro-steps.  Leading-axis chunking
+                # never crosses the blocked last axis, and pp-sharded
+                # layer stacks use the pipeline runtime, not this
+                # optimizer path, so the scanned axis is unsharded.
+                pad = (-lead) % n_chunks
+                per = (lead + pad) // n_chunks
 
-            def body(_, xs):
-                gq, mc, ms, nc, ns = xs
-                mu = b1 * (mc.astype(jnp.float32) * ms) + (1 - b1) * gq
-                nu_root = (nc.astype(jnp.float32) + 127.0) * ns
-                nu = b2 * (nu_root * nu_root) + (1 - b2) * (gq * gq)
-                upd = (mu / b1c) / (jnp.sqrt(nu / b2c) + eps)
-                new_mc, new_ms = requant(mu)
-                new_nc, new_ns = requant_u(jnp.sqrt(nu))
-                return None, (upd, new_mc, new_ms, new_nc, new_ns)
+                def prep(a, fill):
+                    if pad:
+                        a = jnp.pad(
+                            a, [(0, pad)] + [(0, 0)] * (a.ndim - 1),
+                            constant_values=fill)
+                    return a.reshape(n_chunks, per, *a.shape[1:])
 
-            def resh(a):
-                return a.reshape(steps, chunk, *a.shape[1:])
+                def body(_, xs):
+                    return None, blocked_update(*xs)
 
-            _, (upd, mc2, ms2, nc2, ns2) = jax.lax.scan(
-                body, None,
-                (resh(gb), resh(mu_c), resh(mu_s), resh(nu_c),
-                 resh(nu_s)))
-            upd = upd.reshape(-1)[:size].reshape(shape).astype(dtype)
+                _, (upd, mc2, ms2, nc2, ns2) = jax.lax.scan(
+                    body, None,
+                    (prep(gb, 0.0),
+                     prep(mu_q.q8_codes, 0), prep(mu_q.q8_scale, 1.0),
+                     prep(nu_q.q8_codes, 0), prep(nu_q.q8_scale, 1.0)))
 
-            def unpad(a):
-                return a.reshape(-1, *a.shape[2:])[:n]
+                def unprep(a):
+                    return a.reshape(-1, *a.shape[2:])[:lead]
 
-            return (upd,
-                    _Q8(q8_codes=unpad(mc2), q8_scale=unpad(ms2)),
-                    _Q8(q8_codes=unpad(nc2), q8_scale=unpad(ns2)))
+                upd, mc2, ms2, nc2, ns2 = map(
+                    unprep, (upd, mc2, ms2, nc2, ns2))
+            else:
+                upd, mc2, ms2, nc2, ns2 = blocked_update(
+                    gb, mu_q.q8_codes, mu_q.q8_scale,
+                    nu_q.q8_codes, nu_q.q8_scale)
+            return (_from_blocks(upd, shape, dtype),
+                    _Q8(q8_codes=mc2, q8_scale=ms2),
+                    _Q8(q8_codes=nc2, q8_scale=ns2))
 
         flat_g, treedef = jax.tree_util.tree_flatten(updates)
         flat_mu = treedef.flatten_up_to(state.mu)

@@ -101,17 +101,6 @@ def state_shardings(model: nn.Module, optimizer: optax.GradientTransformation,
 
     shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
     shardings = tree_shardings(shapes, mesh, partition_patterns)
-    if mesh.devices.size > 1 and any(
-            "q8_codes" in "/".join(str(k) for k in path)
-            for path, _ in jax.tree_util.tree_flatten_with_path(
-                shapes.opt_state)[0]):
-        import warnings
-
-        warnings.warn(
-            "int8 Adam moments replicate on multi-device meshes (their "
-            "blocked layout has no param-axis correspondence) — a "
-            "single-chip memory lever; prefer moments='f32' here "
-            "(train/opt8bit.py scope note)", stacklevel=2)
     if offload_opt_state:
         shardings = shardings.replace(opt_state=jax.tree.map(
             lambda s: s.with_memory_kind("pinned_host"),

@@ -90,7 +90,11 @@ class TestUpdateRule:
 
 class TestTraining:
     def _run(self, moments, offload=False, steps=8):
-        mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
+        return self._run_on(MeshSpec(dp=4, fsdp=2), moments,
+                            offload=offload, steps=steps)
+
+    def _run_on(self, mesh_spec, moments, offload=False, steps=8):
+        mesh = make_mesh(mesh_spec)
         model, cfg = L.make_model("tiny", dtype=jnp.float32)
         opt = T.make_optimizer(1e-3, warmup_steps=2, decay_steps=20,
                                moments=moments)
@@ -142,19 +146,42 @@ class TestTraining:
                         jax.tree_util.tree_leaves(restored.opt_state)):
             np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
-    def test_multi_device_mesh_warns(self):
-        import warnings as W
-
+    def test_moments_shard_like_params(self):
+        """Shard-aware blocking (VERDICT r4 item 3): q8 codes/scales
+        must carry their PARAM's partition spec over the leading axes —
+        an fsdp/tp-sharded model gets fsdp/tp-sharded moments, not
+        replicated ones (the r4 flat-blocked layout replicated and only
+        worked single-chip)."""
         mesh = make_mesh(MeshSpec(dp=4, fsdp=2))
         model, cfg = L.make_model("tiny", dtype=jnp.float32)
         opt = T.make_optimizer(1e-3, moments="int8")
         pats = L.partition_patterns(cfg)
-        with W.catch_warnings(record=True) as w:
-            W.simplefilter("always")
-            T.state_shardings(model, opt, mesh, pats,
-                              (jnp.zeros((8, 16), jnp.int32),))
-        assert any("int8 Adam moments replicate" in str(x.message)
-                   for x in w)
+        sh, _ = T.state_shardings(model, opt, mesh, pats,
+                                  (jnp.zeros((8, 16), jnp.int32),))
+        flat = jax.tree_util.tree_flatten_with_path(sh.opt_state)[0]
+        q8 = {"/".join(str(k) for k in path): s for path, s in flat
+              if "q8_" in "/".join(str(k) for k in path)}
+        assert q8, "no quantized leaves found"
+        sharded = {p: s for p, s in q8.items()
+                   if s.spec != jax.sharding.PartitionSpec()}
+        # the big matrices (attn/mlp kernels, embeddings) must shard;
+        # tiny norm scales may legitimately replicate
+        assert any("kernel" in p or "embedding" in p for p in sharded), \
+            sorted(q8)
+        # codes and their scales agree on the leading-axis spec
+        for p, s in q8.items():
+            if p.endswith("q8_codes"):
+                twin = q8[p[:-len("q8_codes")] + "q8_scale"]
+                assert s.spec[:-1] == twin.spec[:-1], (p, s, twin)
+
+    def test_sharded_trajectory_matches_replicated(self):
+        """The blocked update must be sharding-transparent: pure-dp
+        (moments replicated) and dp x fsdp (moments SHARDED) runs with
+        the same seeds produce the same losses — shard-local blocks,
+        no cross-shard block seams."""
+        ref, _ = self._run_on(MeshSpec(dp=8), "int8")
+        got, _ = self._run_on(MeshSpec(dp=4, fsdp=2), "int8")
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
     def test_unknown_moments_rejected(self):
         import pytest as _pt
