@@ -254,8 +254,26 @@ if MODE == "multi":
     from paddle_operator_tpu.launch import launcher
     env = launcher.initialize()
     mesh = launcher.job_mesh(env)
-    world, my_ranks = env.num_workers, [env.rank]
+    world = env.num_workers
     assert jax.process_count() == world
+    # Which global batch rows must THIS process supply?  The batch is
+    # sharded over (dp, fsdp) only; along pp (and any other non-batch
+    # axis) it REPLICATES, so every process in a (dp, fsdp) group must
+    # hand make_array_from_process_local_data the IDENTICAL row block
+    # for that group — on a dp x pp mesh each process contributes its
+    # whole dp-group's rows, not just "its rank's" slice.
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    # the block math below reads coords off THE local device — valid
+    # only for 1-chip workers (all current harness jobs); multi-chip
+    # workers would need per-device blocks
+    assert len(jax.local_devices()) == 1, jax.local_devices()
+    my_flat = list(mesh.devices.flat).index(jax.local_devices()[0])
+    coords = dict(zip(mesh.axis_names,
+                      np.unravel_index(my_flat, mesh.devices.shape)))
+    n_blocks = sizes.get("dp", 1) * sizes.get("fsdp", 1)
+    blk = coords.get("dp", 0) * sizes.get("fsdp", 1) + coords.get("fsdp", 0)
+    rpb = world // n_blocks
+    my_ranks = list(range(blk * rpb, (blk + 1) * rpb))
 else:
     world = int(os.environ["TRAIN_WORLD"])
     my_ranks = list(range(world))        # one process plays every rank
@@ -284,7 +302,14 @@ pats = L.partition_patterns(cfg)
 ex = (jnp.zeros((world * B_LOC, 8), jnp.int32),)
 shardings, _ = T.state_shardings(model, opt, mesh, pats, ex)
 state = T.create_state(model, opt, mesh, pats, ex)
-step = T.make_train_step(model, opt, mesh, shardings)
+if T.mesh_axis_sizes(mesh).get("pp", 1) > 1:
+    # pipeline mesh: stages live on DIFFERENT OS processes, so the
+    # schedule's ppermute hops cross the process boundary (the
+    # DCN-pipeline analogue)
+    step = T.make_step_for_mesh(model, cfg, opt, mesh, shardings,
+                                num_microbatches=2)
+else:
+    step = T.make_train_step(model, opt, mesh, shardings)
 losses = []
 for batch in it:
     state, m = step(state, batch)
@@ -408,3 +433,18 @@ def test_sharded_train_step_single_slice_two_processes():
     from paddle_operator_tpu.api.types import MeshSpec
 
     _run_sharded_train(1, MeshSpec(fsdp=2))
+
+
+def test_sharded_pp_train_step_across_processes():
+    """Pipeline parallelism across OS processes (VERDICT r4 item 7): a
+    2-slice 4-process job runs a dp x pp hybrid step where BOTH mesh
+    axes span process boundaries — each pipeline stage lives on a
+    different process, so the schedule's ppermute stage hops ride the
+    cross-process (DCN-analogue) transport — and the losses + trained
+    params must match the same mesh compiled in ONE process with
+    virtual devices.  The fsdp variants above prove the collective
+    path; this proves the pipeline runtime's manual shard_map region
+    composes with a real multi-process world."""
+    from paddle_operator_tpu.api.types import MeshSpec
+
+    _run_sharded_train(2, MeshSpec(dp=2, pp=2))
