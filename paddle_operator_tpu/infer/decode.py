@@ -188,18 +188,22 @@ def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
 def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
              n: jax.Array) -> jax.Array:
-    """Top-1 MoE FFN at inference: exact conditional computation with NO
+    """Top-k MoE FFN at inference: exact conditional computation with NO
     capacity dropping (the capacity buffer of models/moe.py is a
     training-time static-shape device; drops are its approximation, not
     the model).  Experts run under lax.scan so peak memory is one
-    expert's activations, then the router's argmax selects per token."""
+    expert's activations, then each token combines its top-k experts'
+    outputs — raw Switch gate at k=1, GShard-renormalized gates at
+    k>1, mirroring the training layer's routing rule."""
+    from paddle_operator_tpu.models.moe import route_top_k
+
     b, t, d = n.shape
+    kk = cfg.moe_top_k
     tokens = n.reshape(b * t, d)
     probs = jax.nn.softmax(
         tokens.astype(jnp.float32)
         @ mp["router"]["kernel"].astype(jnp.float32), axis=-1)
-    eidx = jnp.argmax(probs, axis=-1)                       # [T]
-    gate = jnp.take_along_axis(probs, eidx[:, None], 1)[:, 0]
+    gates, topi = route_top_k(probs, kk)                    # [T, k]
 
     def one_expert(_, w):
         w1_e, w2_e = w
@@ -208,8 +212,8 @@ def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
 
     _, outs = jax.lax.scan(one_expert, None,
                            (mp["w1"], mp["w2"]))            # [E, T, D]
-    sel = jax.nn.one_hot(eidx, cfg.n_experts,
-                         dtype=jnp.float32) * gate[:, None]
+    sel = jnp.sum(jax.nn.one_hot(topi, cfg.n_experts, dtype=jnp.float32)
+                  * gates[:, :, None], axis=1)              # [T, E]
     out = jnp.einsum("te,etd->td", sel.astype(cfg.dtype), outs)
     return out.reshape(b, t, d)
 

@@ -55,11 +55,13 @@ class LlamaConfig:
     # falls back to ring otherwise)
     cp_impl: str = "ring"
     # Mixture-of-Experts: n_experts > 0 replaces every layer's SwiGLU MLP
-    # with a Switch-style top-1 MoE (models/moe.py), expert-sharded over the
-    # `ep` mesh axis.  The model then returns (logits, aux_loss) where
+    # with a capacity-factor MoE (models/moe.py) — Switch-style top-1 or
+    # GShard-style top-2 via moe_top_k — expert-sharded over the `ep`
+    # mesh axis.  The model then returns (logits, aux_loss) where
     # aux_loss is the load-balancing loss already scaled by moe_aux_weight.
     n_experts: int = 0
     moe_capacity_factor: float = 1.25
+    moe_top_k: int = 1
     moe_aux_weight: float = 0.01
     # Single-query attention implementation for the DECODE path
     # (infer/decode.py, infer/batcher.py; training is untouched):
@@ -95,13 +97,14 @@ class LlamaConfig:
 
     def active_params(self) -> int:
         """Params touched per token: equals num_params() for dense configs;
-        for MoE the per-layer FFN counts router + a single expert."""
+        for MoE the per-layer FFN counts router + the moe_top_k experts
+        each token is routed to."""
         if self.n_experts <= 0:
             return self.num_params()
         d, f = self.dim, self.ffn_dim
         all_experts = self.n_experts * 2 * d * f
-        one_expert = 2 * d * f
-        return self.num_params() - self.n_layers * (all_experts - one_expert)
+        active = self.moe_top_k * 2 * d * f
+        return self.num_params() - self.n_layers * (all_experts - active)
 
     def num_params(self) -> int:
         d, f, v = self.dim, self.ffn_dim, self.vocab_size
@@ -127,10 +130,14 @@ CONFIGS = {
     "tiny-moe": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                             n_kv_heads=2, ffn_dim=128, max_seq_len=128,
                             n_experts=4),
+    "tiny-moe2": LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                             n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                             n_experts=4, moe_top_k=2),
     "1b": LlamaConfig(vocab_size=32000, dim=2048, n_layers=16, n_heads=16,
                       n_kv_heads=16, ffn_dim=5504),
     "7b": LlamaConfig(),
     "7b-moe": LlamaConfig(n_experts=8),   # Switch-style 8-expert variant
+    "7b-moe2": LlamaConfig(n_experts=8, moe_top_k=2),  # GShard-style top-2
     "13b": LlamaConfig(dim=5120, n_layers=40, n_heads=40, n_kv_heads=40,
                        ffn_dim=13824),
 }
@@ -282,6 +289,7 @@ class DecoderLayer(nn.Module):
             ffn_out, aux = MoELayer(MoEConfig(
                 dim=cfg.dim, ffn_dim=cfg.ffn_dim, n_experts=cfg.n_experts,
                 capacity_factor=cfg.moe_capacity_factor,
+                top_k=cfg.moe_top_k,
                 dtype=cfg.dtype, param_dtype=cfg.param_dtype,
             ), name="moe")(normed)
         else:

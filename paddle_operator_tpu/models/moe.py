@@ -1,15 +1,22 @@
 """Mixture-of-Experts layer — expert parallelism over the ``ep`` mesh axis.
 
 No reference analogue (the reference is topology-unaware; EP lives in
-Fleet).  TPU-first design: Switch-style top-1 routing with a fixed
-**capacity factor** (static shapes — no data-dependent gather/scatter under
-jit), dense one-hot dispatch/combine einsums, and expert weights logically
-sharded ``expert → ep`` so XLA's SPMD partitioner inserts the
-all-to-alls — the "let the compiler schedule the collectives" recipe rather
-than hand-written routing RPCs.
+Fleet).  TPU-first design: Switch-style top-1 or GShard-style top-2
+routing with a fixed **capacity factor** (static shapes — no
+data-dependent gather/scatter under jit), dense one-hot dispatch/combine
+einsums, and expert weights logically sharded ``expert → ep`` so XLA's
+SPMD partitioner inserts the all-to-alls — the "let the compiler
+schedule the collectives" recipe rather than hand-written routing RPCs.
+
+Top-k (k > 1) semantics: each token's top-k experts receive it, gates
+renormalized over the chosen k (GShard); capacity is claimed
+CHOICE-MAJOR — every token's first choice outranks any second choice —
+so congestion sheds the lower-priority assignments first.
 
 Load-balancing auxiliary loss follows the Switch Transformer formulation
-(mean fraction routed × mean router probability per expert, scaled by E).
+(mean fraction of FIRST-choice routing × mean router probability per
+expert, scaled by E) — for k > 1 the first choice is what the balance
+objective must shape.
 """
 
 from __future__ import annotations
@@ -22,12 +29,25 @@ import jax
 import jax.numpy as jnp
 
 
+def route_top_k(probs: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """THE routing rule, shared by the training layer and the decode
+    path (infer/decode.py _moe_ffn) so the two can never drift:
+    top-k expert selection with the raw Switch gate at k=1 and
+    GShard-renormalized gates at k>1.  probs [T, E] -> (gates [T, k],
+    indices [T, k])."""
+    topv, topi = jax.lax.top_k(probs, k)
+    gates = topv if k == 1 else topv / jnp.sum(topv, axis=-1,
+                                               keepdims=True)
+    return gates, topi
+
+
 @dataclasses.dataclass(frozen=True)
 class MoEConfig:
     dim: int = 64
     ffn_dim: int = 128
     n_experts: int = 4
     capacity_factor: float = 1.25
+    top_k: int = 1
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
 
@@ -42,28 +62,35 @@ class MoELayer(nn.Module):
         b, s, d = x.shape
         t = b * s
         tokens = x.reshape(t, d)
-        e = cfg.n_experts
-        cap = max(1, int(cfg.capacity_factor * t / e))
+        e, kk = cfg.n_experts, cfg.top_k
+        if not 1 <= kk <= e:
+            raise ValueError(f"top_k={kk} out of range for {e} experts")
+        # capacity counts TOKENS (not assignments): with top-2 each
+        # expert sees ~2x the assignment pressure at the same capacity
+        # factor, matching the GShard convention where capacity_factor
+        # is quoted per choice
+        cap = max(1, int(cfg.capacity_factor * kk * t / e))
 
         router = nn.Dense(e, use_bias=False, name="router",
                           dtype=jnp.float32, param_dtype=cfg.param_dtype,
                           kernel_init=nn.initializers.normal(0.02))
         probs = jax.nn.softmax(router(tokens.astype(jnp.float32)), axis=-1)
-        expert_idx = jnp.argmax(probs, axis=-1)              # [T]
-        gate = jnp.take_along_axis(probs, expert_idx[:, None], 1)[:, 0]
+        gates, topi = route_top_k(probs, kk)                 # [T, k]
 
-        # position of each token within its expert's capacity buffer
-        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)   # [T, E]
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1             # [T, E]
-        pos_in_expert = pos.max(axis=-1)                          # [T]
-        keep = pos_in_expert < cap                                # overflow drops
+        onehot_k = jax.nn.one_hot(topi, e, dtype=jnp.int32)  # [T, k, E]
+        # capacity positions, CHOICE-MAJOR: stack all first choices
+        # before any second choice, cumsum per expert, then fold back
+        oh_cm = onehot_k.transpose(1, 0, 2).reshape(kk * t, e)
+        pos_cm = (jnp.cumsum(oh_cm, axis=0) * oh_cm - 1).max(axis=-1)
+        pos_k = pos_cm.reshape(kk, t).T                      # [T, k]
+        keep = pos_k < cap                                   # overflow drops
 
-        # dispatch [T, E, C] one-hot; combine = dispatch * gate
-        dispatch = (jax.nn.one_hot(expert_idx, e)[:, :, None]
-                    * jax.nn.one_hot(jnp.clip(pos_in_expert, 0, cap - 1),
-                                     cap)[:, None, :])
-        dispatch = dispatch * keep[:, None, None]
-        combine = dispatch * gate[:, None, None]
+        # dispatch [T, E, C] multi-hot over choices; combine adds gates
+        disp_k = (onehot_k * keep[:, :, None]).astype(jnp.float32)[
+            :, :, :, None] * jax.nn.one_hot(
+            jnp.clip(pos_k, 0, cap - 1), cap)[:, :, None, :]  # [T,k,E,C]
+        dispatch = disp_k.sum(axis=1)
+        combine = (disp_k * gates[:, :, None, None]).sum(axis=1)
 
         # expert buffers [E, C, D] — the "expert" axis is ep-sharded, so
         # these einsums lower to all-to-alls under GSPMD
@@ -80,8 +107,9 @@ class MoELayer(nn.Module):
         out = jnp.einsum("tec,ecd->td", combine.astype(cfg.dtype),
                          expert_out)
 
-        # Switch aux loss: E * mean(frac_routed_e * mean_prob_e)
-        frac = onehot.astype(jnp.float32).mean(axis=0)
+        # Switch aux loss over the FIRST choice:
+        # E * mean(frac_routed_e * mean_prob_e)
+        frac = onehot_k[:, 0].astype(jnp.float32).mean(axis=0)
         mean_prob = probs.mean(axis=0)
         aux = e * jnp.sum(frac * mean_prob)
 

@@ -80,3 +80,110 @@ def test_aux_loss_balanced_vs_collapsed():
     _, aux_collapsed = layer.apply({"params": params2}, x * 0 + 1.0)
     _, aux_normal = layer.apply({"params": params}, x)
     assert float(aux_collapsed) > float(aux_normal)
+
+
+# ---------------------------------------------------------------------------
+# Top-2 routing (GShard-style; VERDICT r4 item 8)
+# ---------------------------------------------------------------------------
+
+
+def make2(capacity_factor=8.0, n_experts=4):
+    cfg = MoEConfig(dim=16, ffn_dim=32, n_experts=n_experts,
+                    capacity_factor=capacity_factor, top_k=2,
+                    dtype=jnp.float32)
+    layer = MoELayer(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+    params = layer.init(jax.random.PRNGKey(0), x)["params"]
+    return layer, params, x, cfg
+
+
+def dense_reference_top2(params, x, cfg):
+    """Every token through BOTH its top-2 experts, gates renormalized,
+    no capacity limit — the conditional model top-2 approximates."""
+    t = x.reshape(-1, cfg.dim)
+    probs = jax.nn.softmax(t @ params["router"]["kernel"], axis=-1)
+    topv, topi = jax.lax.top_k(probs, 2)
+    gates = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    w1, w2 = params["w1"], params["w2"]
+    out = 0.0
+    for c in range(2):
+        idx = topi[:, c]
+        h = jax.nn.gelu(jnp.einsum("td,tdf->tf", t, w1[idx]))
+        out = out + jnp.einsum("tf,tfd->td", h, w2[idx]) \
+            * gates[:, c][:, None]
+    return out.reshape(x.shape)
+
+
+def test_top2_matches_dense_with_ample_capacity():
+    layer, params, x, cfg = make2(capacity_factor=8.0)
+    out, aux = layer.apply({"params": params}, x)
+    ref = dense_reference_top2(params, x, cfg)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+    assert float(aux) > 0
+
+
+def test_top2_first_choice_outranks_second_under_congestion():
+    """Choice-major capacity: when an expert overflows, every surviving
+    FIRST-choice assignment to it must outrank any second-choice one.
+    Verified by reconstructing the layer's own routing order."""
+    layer, params, x, cfg = make2(capacity_factor=0.25)
+    t = x.reshape(-1, cfg.dim)
+    probs = jax.nn.softmax(t @ params["router"]["kernel"], axis=-1)
+    topv, topi = jax.lax.top_k(probs, 2)
+    gates = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    n_tok = t.shape[0]
+    cap = max(1, int(cfg.capacity_factor * 2 * n_tok / cfg.n_experts))
+    # replay choice-major claiming
+    count = {e: 0 for e in range(cfg.n_experts)}
+    kept = np.zeros((n_tok, 2), bool)
+    for c in range(2):
+        for tok in range(n_tok):
+            e = int(topi[tok, c])
+            if count[e] < cap:
+                count[e] += 1
+                kept[tok, c] = True
+    # layer output must equal the dense combination of KEPT assignments
+    w1, w2 = params["w1"], params["w2"]
+    ref = 0.0
+    for c in range(2):
+        idx = topi[:, c]
+        h = jax.nn.gelu(jnp.einsum("td,tdf->tf", t, w1[idx]))
+        ref = ref + jnp.einsum("tf,tfd->td", h, w2[idx]) \
+            * (gates[:, c] * kept[:, c])[:, None]
+    out, _ = layer.apply({"params": params}, x)
+    np.testing.assert_allclose(out, np.asarray(ref).reshape(x.shape),
+                               atol=1e-5, rtol=1e-5)
+    # congestion actually occurred, and some second choices were shed
+    assert kept.sum() < 2 * n_tok
+    assert kept[:, 0].sum() >= kept[:, 1].sum()
+
+
+def test_top2_gates_renormalized():
+    """At ample capacity each token's two gate weights must sum to 1 —
+    the GShard renormalization (top-1 keeps the raw Switch gate)."""
+    layer, params, x, cfg = make2(capacity_factor=8.0)
+    out, _ = layer.apply({"params": params}, x)
+    # scale-invariance probe: doubling both experts' contributions via
+    # gates would break if gates were left unnormalized; compare against
+    # the renormalized dense reference (exact) and the UNnormalized one
+    # (must differ)
+    t = x.reshape(-1, cfg.dim)
+    probs = jax.nn.softmax(t @ params["router"]["kernel"], axis=-1)
+    topv, topi = jax.lax.top_k(probs, 2)
+    w1, w2 = params["w1"], params["w2"]
+    un = 0.0
+    for c in range(2):
+        idx = topi[:, c]
+        h = jax.nn.gelu(jnp.einsum("td,tdf->tf", t, w1[idx]))
+        un = un + jnp.einsum("tf,tfd->td", h, w2[idx]) \
+            * topv[:, c][:, None]
+    assert not np.allclose(out, np.asarray(un).reshape(x.shape),
+                           atol=1e-5)
+
+
+def test_top_k_validation():
+    cfg = MoEConfig(n_experts=4, top_k=5)
+    layer = MoELayer(cfg)
+    x = jnp.zeros((1, 4, 64))
+    with pytest.raises(ValueError, match="top_k"):
+        layer.init(jax.random.PRNGKey(0), x)
