@@ -154,6 +154,25 @@ def make_handler(api: FakeAPI):
             finally:
                 api.unsubscribe(sub)
 
+        def _reject_invalid(self, kind, obj) -> bool:
+            """CRD structural-schema validation at admission (what a real
+            apiserver does against the applied CRD — a typo'd pod
+            template must be rejected at CREATE, not surface later as a
+            confusing mid-reconcile pod failure).  Sends the 422 and
+            returns True when the object is invalid."""
+            if kind != "TPUJob":
+                return False
+            from paddle_operator_tpu.api.crd import validate_tpujob_object
+
+            errs = validate_tpujob_object(obj)
+            if not errs:
+                return False
+            # k8s answers schema-invalid objects with 422 Invalid
+            self._send(422, {"kind": "Status", "status": "Failure",
+                             "reason": "Invalid", "code": 422,
+                             "message": "; ".join(errs)})
+            return True
+
         def do_POST(self):  # noqa: N802
             m = self._match()
             if not m:
@@ -161,6 +180,8 @@ def make_handler(api: FakeAPI):
             ns, kind, _, _, _ = m
             obj = self._body()
             obj.setdefault("metadata", {}).setdefault("namespace", ns)
+            if self._reject_invalid(kind, obj):
+                return None
             with lock:
                 try:
                     return self._send(201, api.create(kind, obj))
@@ -173,6 +194,8 @@ def make_handler(api: FakeAPI):
                 return self._send(404, {})
             ns, kind, name, sub, _ = m
             obj = self._body()
+            if sub != "status" and self._reject_invalid(kind, obj):
+                return None
             with lock:
                 try:
                     if sub == "status":

@@ -274,3 +274,106 @@ class TestFinalizerSemantics:
         client.update("ConfigMap", lingering)           # strip -> real delete
         with pytest.raises(NotFound):
             client.get("ConfigMap", NS, "cm")
+
+
+class TestSchemaValidationAtAdmission:
+    """Apply-time pod-template validation (VERDICT r4 item 6): the CRD
+    inlines a partial PodTemplateSpec schema (api/crd.py), and the mock
+    apiserver evaluates it at create/update — a typo'd template is a
+    422 at apply, not a confusing mid-reconcile pod failure."""
+
+    @staticmethod
+    def _job(tmpl):
+        return {"kind": "TPUJob", "apiVersion": "batch.tpu.io/v1",
+                "metadata": {"name": "sv", "namespace": NS},
+                "spec": {"worker": {"replicas": 2, "template": tmpl}}}
+
+    @staticmethod
+    def _expect_422(client, obj, needle):
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.create("TPUJob", obj)
+        assert ei.value.code == 422
+        body = json.loads(ei.value.read())
+        assert body["reason"] == "Invalid"
+        assert needle in body["message"], body["message"]
+
+    def test_valid_template_accepted(self, server):
+        client, api, _ = server
+        tmpl = {"spec": {"containers": [
+            {"name": "m", "image": "jax:latest",
+             "env": [{"name": "A", "value": "b"}],
+             "resources": {"limits": {"google.com/tpu": 4}},
+             "volumeMounts": [{"name": "ckpt", "mountPath": "/ckpt"}]}],
+            "nodeSelector": {"cloud.google.com/gke-tpu-topology": "2x4"},
+            "volumes": [{"name": "ckpt", "emptyDir": {}}]}}
+        client.create("TPUJob", self._job(tmpl))
+        assert ("TPUJob", NS, "sv") in api.store
+
+    def test_containerless_template_rejected(self, server):
+        client, _, _ = server
+        self._expect_422(client, self._job({"spec": {}}),
+                         "missing required field 'containers'")
+        self._expect_422(client, self._job({"spec": {"containers": []}}),
+                         "fewer than 1 items")
+
+    def test_containers_must_be_a_list(self, server):
+        client, _, _ = server
+        tmpl = {"spec": {"containers": {"name": "m", "image": "i"}}}
+        self._expect_422(client, self._job(tmpl),
+                         "containers: expected array")
+
+    def test_container_requires_name(self, server):
+        client, _, _ = server
+        tmpl = {"spec": {"containers": [{"image": "i"}]}}
+        self._expect_422(client, self._job(tmpl),
+                         "missing required field 'name'")
+
+    def test_typod_value_types_rejected(self, server):
+        client, _, _ = server
+        tmpl = {"spec": {"containers": [{"name": "m", "image": 7}]}}
+        self._expect_422(client, self._job(tmpl), "image: expected string")
+        tmpl = {"spec": {"containers": [{"name": "m",
+                                         "command": "python train.py"}]}}
+        self._expect_422(client, self._job(tmpl),
+                         "command: expected array")
+
+    def test_enum_fields_rejected(self, server):
+        client, _, _ = server
+        tmpl = {"spec": {"containers": [{"name": "m"}],
+                         "restartPolicy": "Sometimes"}}
+        self._expect_422(client, self._job(tmpl), "restartPolicy")
+
+    def test_spec_fields_validated_too(self, server):
+        client, _, _ = server
+        job = self._job({"spec": {"containers": [{"name": "m"}]}})
+        job["spec"]["worker"]["replicas"] = "four"
+        self._expect_422(client, job, "replicas: expected integer")
+        job = self._job({"spec": {"containers": [{"name": "m"}]}})
+        job["spec"]["tpu"] = {"topology": "2by4"}
+        self._expect_422(client, job, "topology")
+
+    def test_update_validated_like_create(self, server):
+        import urllib.error
+
+        client, _, _ = server
+        good = self._job({"spec": {"containers": [{"name": "m"}]}})
+        created = client.create("TPUJob", good)
+        created["spec"]["worker"]["template"]["spec"]["containers"] = [
+            {"image": "no-name"}]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.update("TPUJob", created)
+        assert ei.value.code == 422
+
+    def test_status_put_skips_spec_schema(self, server):
+        # status writers (the controller) must not be blocked by a
+        # pre-existing invalid spec: the status subresource path skips
+        # object-schema validation like a real apiserver's status update
+        client, api, _ = server
+        good = self._job({"spec": {"containers": [{"name": "m"}]}})
+        created = client.create("TPUJob", good)
+        created["status"] = {"phase": "Pending"}
+        client.update_status("TPUJob", created)
+        assert api.store[("TPUJob", NS, "sv")]["status"]["phase"] \
+            == "Pending"
