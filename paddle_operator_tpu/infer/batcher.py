@@ -255,21 +255,34 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     return jax.jit(step, donate_argnums=(1,))
 
 
-def make_prefill_insert(cfg: LlamaConfig, bucket: int):
+def make_prefill_insert(cfg: LlamaConfig, bucket: int,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None):
     """Per-prompt-bucket compiled admission: prefill a [1, bucket]
-    (right-padded) prompt and splice its KV into ring lane ``slot``.
+    (right-padded) prompt, splice its KV into ring lane ``slot``, sample
+    the first token, and update EVERY piece of lane state — tok, temp,
+    keys — in the same compiled program.
+
+    One dispatch on purpose: on relayed chips, EAGER ops (``.at[].set``,
+    ``argmax``) block until all in-flight device work drains (measured
+    ~500 ms behind a decoding chunk), so an admission built from eager
+    lane updates stalled the whole ring for ~half a second per request.
+    Everything device-side about admission lives inside this jit; the
+    host's only jobs are bookkeeping lists.
 
     Exactness with padding: pad rows fill cache positions PAST the real
     prompt; the causal mask keeps real rows from attending them, the
-    returned logits are taken at ``prompt_len - 1`` (the last REAL
+    first token samples from ``prompt_len - 1`` (the last REAL
     position), the lane position is set to ``prompt_len`` so decode
     overwrites the pad rows before they ever become attendable.
 
-    ``insert(params, cache, prompt [1,bucket], prompt_len, slot)
-    -> (cache', logits [V])``
+    ``insert(params, cache, tok, temp, keys, prompt [1,bucket],
+    prompt_len, slot, temp_val, seed)
+    -> (cache', tok', temp', keys', first_token)``
     """
 
-    def insert(params, cache, prompt, prompt_len, slot):
+    def insert(params, cache, tok, temp, keys, prompt, prompt_len, slot,
+               temp_val, seed):
         lane = D.init_cache(cfg, 1, bucket)
         logits, lane = D._forward(cfg, params, prompt, lane)
         logits = logits[0, prompt_len - 1]                  # last real row
@@ -282,9 +295,21 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int):
         new_v = jax.lax.dynamic_update_slice(
             cache["v"], v[:, None], (0, slot, 0, 0, 0))
         pos = cache["pos"].at[slot].set(prompt_len)
-        return {"k": new_k, "v": new_v, "pos": pos}, logits
+        # first token, same rule as the chunk step's sample()
+        key = jax.random.PRNGKey(seed)
+        sub = jax.random.fold_in(key, prompt_len - 1)
+        filt = D._filter_logits(
+            logits[None] / jnp.maximum(temp_val, 1e-6), top_k, top_p)[0]
+        drawn = jax.random.categorical(sub, filt).astype(jnp.int32)
+        first = jnp.where(temp_val > 0, drawn,
+                          logits.argmax().astype(jnp.int32))
+        return ({"k": new_k, "v": new_v, "pos": pos},
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
 
-    return jax.jit(insert, donate_argnums=(1,))
+    return jax.jit(insert, donate_argnums=(1, 2, 3, 4))
 
 
 # ---------------------------------------------------------------------------
@@ -390,7 +415,7 @@ class ContinuousBatcher:
             self.max_len)
         self._top_k, self._top_p = top_k, top_p
         self._step = make_chunk_step(cfg, chunk_tokens, top_k, top_p)
-        self._inserts = {b: make_prefill_insert(cfg, b)
+        self._inserts = {b: make_prefill_insert(cfg, b, top_k, top_p)
                          for b in self.buckets}
 
         self.cache = init_ring_cache(cfg, slots, self.max_len)
@@ -471,33 +496,23 @@ class ContinuousBatcher:
         raise ValueError(f"no bucket fits prompt length {n}")
 
     def _admit(self, slot: int, req: _Request) -> None:
-        """Admission never blocks on the device: the prefill dispatch and
-        the first-token sample stay device-side futures, so back-to-back
-        admissions pipeline on the accelerator instead of paying one
-        host round-trip EACH (measured to dominate served throughput on
-        relayed chips).  The first token materializes at the next chunk
-        consume (:meth:`_materialize_first`)."""
-        self.cache, logits = self._inserts[req.bucket](
-            self.params, self.cache, req.dev_prompt,
-            jnp.int32(len(req.prompt)), jnp.int32(slot))
-        # sample the FIRST new token from the prefill logits with the
-        # same rule the chunk step uses — on device, no sync
-        if req.temperature > 0:
-            key = jax.random.fold_in(jax.random.PRNGKey(req.seed),
-                                     len(req.prompt) - 1)
-            filt = D._filter_logits(logits[None] / req.temperature,
-                                    self._top_k, self._top_p)[0]
-            first = jax.random.categorical(key, filt).astype(jnp.int32)
-        else:
-            first = logits.argmax().astype(jnp.int32)
+        """Admission is ONE compiled dispatch and nothing else on the
+        device path (make_prefill_insert does the splice, first-token
+        sample and all lane-state updates in a single jit): eager ops
+        here would block behind whatever chunk is decoding — measured
+        ~500 ms EACH on relayed chips — and admissions were dominating
+        served throughput.  The first token stays a device future,
+        materialized at the next chunk consume
+        (:meth:`_materialize_first`)."""
+        self.cache, self.tok, self.temp, self.keys, first = \
+            self._inserts[req.bucket](
+                self.params, self.cache, self.tok, self.temp, self.keys,
+                req.dev_prompt, len(req.prompt), slot,
+                float(req.temperature), req.seed)
         try:                            # ship the first token host-ward
             first.copy_to_host_async()  # early: TTFT then needs no
         except AttributeError:          # extra round-trip at consume
             pass
-        self.tok = self.tok.at[slot].set(first)
-        self.temp = self.temp.at[slot].set(req.temperature)
-        self.keys = self.keys.at[slot].set(
-            jax.random.PRNGKey(req.seed))
         self.lane[slot] = req
         self._lane_out[slot] = []
         self._lane_first[slot] = first
@@ -536,9 +551,13 @@ class ContinuousBatcher:
             req._stream.put(None)
 
     def _evict(self, slot: int) -> None:
+        # host bookkeeping ONLY — no device ops (an eager .at[].set here
+        # blocks behind the in-flight chunk on relayed chips).  The
+        # lane's stale temp/keys are harmless: inactive lanes' tokens
+        # are ignored, and the next admission overwrites all lane state
+        # inside its compiled insert.
         req = self.lane[slot]
         self.lane[slot] = None
-        self.temp = self.temp.at[slot].set(0.0)
         self.stats["evicted"] += 1
         if req is not None:
             # error-path evictions can race ahead of the first consume

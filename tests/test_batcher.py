@@ -67,16 +67,19 @@ class TestRingEquivalence:
         # ring: all three lanes resident at ragged positions
         cache = init_ring_cache(cfg, 3, MAX_LEN)
         insert = make_prefill_insert(cfg, 16)
+        tok = jnp.zeros((3,), jnp.int32)
+        temp = jnp.zeros((3,), jnp.float32)
+        keys = jnp.zeros((3, 2), jnp.uint32)
         first = []
         for slot, p in enumerate(prompts):
             padded = jnp.zeros((1, 16), jnp.int32)
             padded = padded.at[0, :p.shape[1]].set(p[0])
-            cache, logits = insert(params, cache, padded,
-                                   jnp.int32(p.shape[1]), jnp.int32(slot))
-            first.append(int(logits.argmax()))
+            cache, tok, temp, keys, ftok = insert(
+                params, cache, tok, temp, keys, padded,
+                p.shape[1], slot, 0.0, 0)
+            first.append(int(ftok))
         assert first == [r[0] for r in refs]     # prefill logits agree
 
-        tok = jnp.asarray(first, jnp.int32)
         from paddle_operator_tpu.infer.batcher import _ring_forward
         ring_logits, _ = _ring_forward(cfg, params, tok, cache)
         for i in range(3):

@@ -108,11 +108,14 @@ class TestGenerateWithKernel:
         def run(cfg):
             cache = init_ring_cache(cfg, 2, 32)
             insert = make_prefill_insert(cfg, 16)
+            tok = jnp.zeros((2,), jnp.int32)
+            temp = jnp.zeros((2,), jnp.float32)
+            keys = jnp.zeros((2, 2), jnp.uint32)
             for slot, n in enumerate((5, 11)):
                 p = jax.random.randint(jax.random.PRNGKey(slot), (1, 16),
                                        0, cfg.vocab_size, dtype=jnp.int32)
-                cache, logits = insert(params, cache, p, jnp.int32(n),
-                                       jnp.int32(slot))
+                cache, tok, temp, keys, _f = insert(
+                    params, cache, tok, temp, keys, p, n, slot, 0.0, 0)
             tok = jnp.asarray([3, 7], jnp.int32)
             out, _ = _ring_forward(cfg, params, tok, cache)
             return np.asarray(out)

@@ -284,7 +284,7 @@ class TestSchemaValidationAtAdmission:
 
     @staticmethod
     def _job(tmpl):
-        return {"kind": "TPUJob", "apiVersion": "batch.tpu.io/v1",
+        return {"kind": "TPUJob", "apiVersion": "batch.tpujob.dev/v1",
                 "metadata": {"name": "sv", "namespace": NS},
                 "spec": {"worker": {"replicas": 2, "template": tmpl}}}
 
