@@ -251,6 +251,31 @@ def render_chart(root: str) -> None:
     print(f"wrote {path}")
 
 
+def kustomize_manifests():
+    """Kustomization entry points (reference parity:
+    config/default/kustomization.yaml sets namespace + namePrefix over
+    the crd/rbac/manager bases, config/operator/kustomization.yaml:1-14
+    lists the rendered resources).  The base kustomization sits next to
+    the rendered manifests so its resource references stay in-root; the
+    overlay shows the namespace/namePrefix customization story."""
+    base = {
+        "apiVersion": "kustomize.config.k8s.io/v1beta1",
+        "kind": "Kustomization",
+        "resources": ["crd.yaml", "operator.yaml"],
+    }
+    overlay = {
+        "apiVersion": "kustomize.config.k8s.io/v1beta1",
+        "kind": "Kustomization",
+        # rename + re-namespace the whole operator install without
+        # touching the rendered manifests:
+        #   kubectl apply -k deploy/overlays/custom-namespace
+        "namespace": "acme-tpu-system",
+        "namePrefix": "acme-",
+        "resources": ["../../v1"],
+    }
+    return base, overlay
+
+
 def main() -> int:
     root = os.path.join(os.path.dirname(__file__), "..")
     write_yaml(os.path.join(root, "deploy", "v1", "crd.yaml"),
@@ -262,6 +287,12 @@ def main() -> int:
                [generate_crd_v1beta1()])
     write_yaml(os.path.join(root, "deploy", "v1beta1", "operator.yaml"),
                operator_manifests())
+    base, overlay = kustomize_manifests()
+    write_yaml(os.path.join(root, "deploy", "v1", "kustomization.yaml"),
+               [base])
+    write_yaml(os.path.join(root, "deploy", "overlays",
+                            "custom-namespace", "kustomization.yaml"),
+               [overlay])
     render_chart(root)
     return 0
 

@@ -209,6 +209,23 @@ def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     return logits[:, 0], {"k": k_new, "v": v_new, "pos": pos + 1}
 
 
+def _sample_tokens(logits, temp, keys, pos, top_k, top_p):
+    """THE per-lane sampling rule — shared by the chunk step and the
+    admission insert so token 1 and tokens 2..N can never be drawn
+    under different rules.  logits [B, V], temp [B], keys [B, 2],
+    pos [B] -> [B] int32: greedy at temp 0, else per-lane
+    fold_in(position) (deterministic given (seed, pos), independent
+    across lanes and steps) feeding temperature + top-k/top-p
+    filtered categorical sampling."""
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    filt = D._filter_logits(
+        logits / jnp.maximum(temp, 1e-6)[:, None], top_k, top_p)
+    sub = jax.vmap(jax.random.fold_in)(keys, pos)
+    drawn = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l))(sub, filt)
+    return jnp.where(temp > 0, drawn.astype(jnp.int32), greedy)
+
+
 def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                     top_k: Optional[int] = None,
                     top_p: Optional[float] = None):
@@ -224,22 +241,12 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     donated: the ring buffer must never be copied per chunk.
     """
 
-    def sample(logits, temp, keys, pos):
-        greedy = logits.argmax(-1).astype(jnp.int32)
-        filt = D._filter_logits(
-            logits / jnp.maximum(temp, 1e-6)[:, None], top_k, top_p)
-        # per-lane fold_in(position): deterministic given (seed, pos),
-        # independent across lanes and steps
-        sub = jax.vmap(jax.random.fold_in)(keys, pos)
-        drawn = jax.vmap(
-            lambda k, l: jax.random.categorical(k, l))(sub, filt)
-        return jnp.where(temp > 0, drawn.astype(jnp.int32), greedy)
-
     def step(params, cache, tok, temp, keys, active):
         def tick(carry, _):
             cache, tok = carry
             logits, new_cache = _ring_forward(cfg, params, tok, cache)
-            nxt = sample(logits, temp, keys, cache["pos"])
+            nxt = _sample_tokens(logits, temp, keys, cache["pos"],
+                                 top_k, top_p)
             # frozen lanes: position does not advance, cache rows keep
             # whatever the (ignored) write put at their current pos —
             # the next admission overwrites from its prompt start anyway
@@ -295,14 +302,13 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int,
         new_v = jax.lax.dynamic_update_slice(
             cache["v"], v[:, None], (0, slot, 0, 0, 0))
         pos = cache["pos"].at[slot].set(prompt_len)
-        # first token, same rule as the chunk step's sample()
+        # first token through the SHARED sampling rule (_sample_tokens),
+        # batch-of-one shaped
         key = jax.random.PRNGKey(seed)
-        sub = jax.random.fold_in(key, prompt_len - 1)
-        filt = D._filter_logits(
-            logits[None] / jnp.maximum(temp_val, 1e-6), top_k, top_p)[0]
-        drawn = jax.random.categorical(sub, filt).astype(jnp.int32)
-        first = jnp.where(temp_val > 0, drawn,
-                          logits.argmax().astype(jnp.int32))
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
         return ({"k": new_k, "v": new_v, "pos": pos},
                 tok.at[slot].set(first),
                 temp.at[slot].set(temp_val),
@@ -465,6 +471,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(prompt)}) + chunk-rounded budget ({budget}) "
                 f"exceeds max_len ({self.max_len})")
+        # the seed now rides into a jitted program as a traced argument,
+        # which parses as int32 — a 64-bit seed (clients send arbitrary
+        # ints; serve.py even derives seed+i per row) would raise
+        # OverflowError at dispatch.  Fold it into int32 range here.
+        seed = int(seed) & 0x7FFFFFFF
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        eos_token, wants_stream=stream)
         # pad + ship the prompt to the device HERE, on the caller's

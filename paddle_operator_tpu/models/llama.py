@@ -75,10 +75,15 @@ class LlamaConfig:
     def resolved_decode_attn(self) -> str:
         """Resolve "auto" at trace time: the pallas filled-prefix kernel
         on TPU, the XLA einsum everywhere else (interpret-mode pallas is
-        orders slower on CPU; the einsum is the CPU-correct path)."""
+        orders slower on CPU; the einsum is the CPU-correct path).
+        Configs whose head_dim is not lane-aligned (a multiple of 128 —
+        debug/tiny shapes) fall back to the einsum: Mosaic cannot tile
+        the kernel's [*, head_dim] slices below one 128-lane register."""
         if self.decode_attn == "auto":
             import jax
 
+            if self.head_dim % 128:
+                return "xla"
             return "pallas" if jax.default_backend() == "tpu" else "xla"
         return self.decode_attn
 

@@ -46,6 +46,23 @@ This kernel makes decode cost proportional to the FILLED context:
   in storage dtype (bf16 native MXU rate); masking folds the causal/
   fill bound AND the head-match predicate into one -inf write.
 
+**Why not an int8 KV cache** (the r4 review's candidate next lever):
+with this kernel at the DMA roofline, a 256-row bf16 block costs ~2.4us
+of HBM time against ~1.7us of cell compute (two MXU passes + masked
+softmax) — the pipeline hides compute under the DMA.  int8 codes halve
+the DMA to ~1.2us but add a dequantize pass (int8->bf16 convert + scale
+multiply) over every cache element: ~0.55us per tensor per block on the
+8x128 VPU, ~1.1us for K+V, pushing cell compute to ~2.8us > the 1.2us
+DMA — the kernel flips from bandwidth- to compute-bound and net wall
+time GROWS ~17%.  Quantized caches pay on hardware where HBM bytes
+cost more than VPU element-ops (higher BW:VPU ratios, or an MXU int8
+path fed by int8 queries); on v5e the bf16 cache IS the fast
+configuration, which is why ``hbm_util`` at serving shapes (0.54-0.83
+measured) is attacked by skipping unfilled blocks rather than by
+shrinking filled ones.  Weight-only int8 (infer/quant.py) is unaffected
+— weights feed large matmuls where XLA folds the dequant into the
+MXU-bound weight stream.
+
 Equivalence is pinned against the XLA einsum path by
 tests/test_decode_attention.py (interpret mode on CPU is exact).
 Compiled on TPU, kernel and einsum logits agree only to MXU rounding
@@ -168,6 +185,14 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     _, hkv, s, _ = k_cache.shape[1:] if stacked else k_cache.shape
     if hq % hkv:
         raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if d % 128 and not interpret:
+        # Mosaic tiles the last dim in 128-lane registers; a smaller
+        # head_dim fails deep in the compiler with an alignment error.
+        # LlamaConfig.resolved_decode_attn routes such configs to the
+        # einsum — reaching here means the kernel was forced explicitly.
+        raise ValueError(
+            f"decode_attention requires head_dim % 128 == 0 on TPU "
+            f"(got {d}); use decode_attn='xla' for this config")
     n_rep = hq // hkv
     while s % block_k:
         block_k //= 2

@@ -213,3 +213,20 @@ class TestHostPortManager:
         import os as _os
         assert _os.path.exists(_os.path.join(REPO, "deploy", "v1beta1",
                                              "crd.yaml"))
+
+    def test_kustomization_files_in_sync(self):
+        import sys as _sys
+        _sys.path.insert(0, os.path.join(REPO, "hack"))
+        from gen_deploy import kustomize_manifests
+
+        base, overlay = kustomize_manifests()
+        with open(os.path.join(REPO, "deploy", "v1",
+                               "kustomization.yaml")) as f:
+            assert yaml.safe_load(f) == base, "run `make gen-deploy`"
+        with open(os.path.join(REPO, "deploy", "overlays",
+                               "custom-namespace",
+                               "kustomization.yaml")) as f:
+            assert yaml.safe_load(f) == overlay, "run `make gen-deploy`"
+        # the base's resource references must resolve in-root
+        for res in base["resources"]:
+            assert os.path.exists(os.path.join(REPO, "deploy", "v1", res))
