@@ -115,7 +115,11 @@ def manager_configmap(namespace: str = NAMESPACE):
 
 
 def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
-                       leader_elect: bool = True):
+                       leader_elect: bool = True, webhook: bool = True):
+    # ``webhook``: include the manager's webhook serving surface (arg,
+    # port, cert mount).  Off for the v1beta1 legacy rendering (those
+    # clusters cannot apply the v1 admissionregistration configs) and
+    # helm-templated behind .Values.webhook.
     """Namespace + RBAC + controller Deployment (reference:
     deploy/v1/operator.yaml — namespace paddle-system, RBAC, manager
     Deployment with --leader-elect), plus the ControllerManagerConfig
@@ -161,23 +165,47 @@ def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
                      "securityContext": {"runAsNonRoot": True,
                                          "runAsUser": 65532},
                      "terminationGracePeriodSeconds": 10,
-                     "volumes": [{
-                         "name": "manager-config",
-                         "configMap": {"name": "tpujob-manager-config"}}],
+                     "volumes": [
+                         {"name": "manager-config",
+                          "configMap": {"name": "tpujob-manager-config"}}]
+                     # cert-manager writes the serving pair here
+                     # (webhook_manifests Certificate); optional so the
+                     # pod schedules before the cert is issued — the
+                     # manager waits for it before serving
+                     + ([{"name": "webhook-certs",
+                          "secret": {
+                              "secretName": "tpujob-webhook-server-cert",
+                              "optional": True}}] if webhook else []),
                      "containers": [{
                          "name": "manager",
                          "image": image,
                          "command": ["python", "-m",
                                      "paddle_operator_tpu.controller.manager"],
+                         # namespace comes from the downward API, not a
+                         # literal arg: kustomize namespace transforms
+                         # rewrite pod namespaces but never container
+                         # args, so a baked --namespace would leave a
+                         # re-namespaced install watching the old one
+                         "env": [{"name": "POD_NAMESPACE",
+                                  "valueFrom": {"fieldRef": {
+                                      "fieldPath":
+                                          "metadata.namespace"}}}],
                          "args": (["--leader-elect"] if leader_elect else [])
-                         + ["--namespace=" + namespace,
-                            "--config=/etc/tpujob/"
+                         + (["--webhook-bind-address=:9443"]
+                            if webhook else [])
+                         + ["--config=/etc/tpujob/"
                             "controller_manager_config.yaml"],
-                         "volumeMounts": [{"name": "manager-config",
-                                           "mountPath": "/etc/tpujob"}],
+                         "volumeMounts": [
+                             {"name": "manager-config",
+                              "mountPath": "/etc/tpujob"}]
+                         + ([{"name": "webhook-certs",
+                              "mountPath": "/tmp/k8s-webhook-server/"
+                                           "serving-certs",
+                              "readOnly": True}] if webhook else []),
                          "ports": [
                              {"containerPort": 8081, "name": "probes"},
-                         ],
+                         ] + ([{"containerPort": 9443,
+                                "name": "webhook"}] if webhook else []),
                          "livenessProbe": {
                              "httpGet": {"path": "/healthz", "port": 8081},
                              "initialDelaySeconds": 15, "periodSeconds": 20},
@@ -210,6 +238,80 @@ def operator_manifests(namespace: str = NAMESPACE, image: str = IMAGE,
     ] + observability_manifests(namespace)
 
 
+def webhook_manifests(namespace: str = NAMESPACE):
+    """Admission webhook surface (reference parity: main.go:76 listens
+    on 9443; config/webhook/ would carry the configurations).  The
+    manager serves /validate-tpujob and /mutate-tpujob
+    (controller/webhook.py) behind this Service; cert-manager issues
+    the serving cert (self-signed Issuer -> Certificate -> the Secret
+    the Deployment mounts) and injects the caBundle via the annotation
+    — the standard kubebuilder arrangement the reference relies on too.
+
+    Rendered to a SEPARATE deploy/v1/webhook.yaml: it requires the
+    cert-manager CRDs, and folding it into operator.yaml would make the
+    base install fail on clusters without cert-manager.  failurePolicy
+    Ignore: an unreachable webhook must not brick job admission — the
+    controller's in-process validation gate remains as defense in
+    depth.  Re-namespacing this file means editing its inject-ca-from /
+    dnsNames strings (kustomize transforms cannot rewrite them)."""
+    svc = "tpujob-webhook-service"
+
+    def client_config(path):
+        return {"service": {"name": svc, "namespace": namespace,
+                            "port": 9443, "path": path}}
+
+    rule = [{"apiGroups": [GROUP], "apiVersions": ["v1"],
+             "operations": ["CREATE", "UPDATE"],
+             "resources": [PLURAL]}]
+    inject = {"cert-manager.io/inject-ca-from":
+              f"{namespace}/tpujob-serving-cert"}
+    return [
+        {"apiVersion": "v1", "kind": "Service",
+         "metadata": {"name": svc, "namespace": namespace},
+         "spec": {"ports": [{"port": 9443, "targetPort": 9443}],
+                  "selector": {"control-plane": "tpujob-controller"}}},
+        # self-signed serving cert written into the Secret the manager
+        # Deployment mounts (kubebuilder's standard cert-manager wiring)
+        {"apiVersion": "cert-manager.io/v1", "kind": "Issuer",
+         "metadata": {"name": "tpujob-selfsigned-issuer",
+                      "namespace": namespace},
+         "spec": {"selfSigned": {}}},
+        {"apiVersion": "cert-manager.io/v1", "kind": "Certificate",
+         "metadata": {"name": "tpujob-serving-cert",
+                      "namespace": namespace},
+         "spec": {
+             "dnsNames": [f"{svc}.{namespace}.svc",
+                          f"{svc}.{namespace}.svc.cluster.local"],
+             "issuerRef": {"kind": "Issuer",
+                           "name": "tpujob-selfsigned-issuer"},
+             "secretName": "tpujob-webhook-server-cert"}},
+        {"apiVersion": "admissionregistration.k8s.io/v1",
+         "kind": "ValidatingWebhookConfiguration",
+         "metadata": {"name": "tpujob-validating-webhook",
+                      "annotations": inject},
+         "webhooks": [{
+             "name": f"validate.{PLURAL}.{GROUP}",
+             "admissionReviewVersions": ["v1"],
+             "sideEffects": "None",
+             "failurePolicy": "Ignore",
+             "clientConfig": client_config("/validate-tpujob"),
+             "rules": rule,
+         }]},
+        {"apiVersion": "admissionregistration.k8s.io/v1",
+         "kind": "MutatingWebhookConfiguration",
+         "metadata": {"name": "tpujob-mutating-webhook",
+                      "annotations": inject},
+         "webhooks": [{
+             "name": f"default.{PLURAL}.{GROUP}",
+             "admissionReviewVersions": ["v1"],
+             "sideEffects": "None",
+             "failurePolicy": "Ignore",
+             "clientConfig": client_config("/mutate-tpujob"),
+             "rules": rule,
+         }]},
+    ]
+
+
 def write_yaml(path: str, docs) -> None:
     os.makedirs(os.path.dirname(path), exist_ok=True)
     with open(path, "w") as f:
@@ -231,6 +333,8 @@ def render_chart(root: str) -> None:
         "controllernamespace": NAMESPACE,
         "jobnamespace": "default",
         "leaderElect": True,
+        # webhook surface needs the cert-manager CRDs: opt-in
+        "webhook": False,
     }])
     write_yaml(os.path.join(chart_dir, "templates", "crd.yaml"),
                [generate_crd()])
@@ -245,9 +349,35 @@ def render_chart(root: str) -> None:
         "        - --leader-elect\n"
         "        {{- end }}\n")
     text = text.replace("leaderElect: true", "leaderElect: {{ .Values.leaderElect }}")
+    # gate the manager's webhook serving surface on .Values.webhook,
+    # matching the gated templates/webhook.yaml — a webhook-less
+    # install must not expose a dead port or poll for a cert forever
+    for block in (
+        "      - name: webhook-certs\n"
+        "        secret:\n"
+        "          secretName: tpujob-webhook-server-cert\n"
+        "          optional: true\n",
+        "        - --webhook-bind-address=:9443\n",
+        "        - name: webhook-certs\n"
+        "          mountPath: /tmp/k8s-webhook-server/serving-certs\n"
+        "          readOnly: true\n",
+        "        - containerPort: 9443\n"
+        "          name: webhook\n",
+    ):
+        assert block in text, block
+        text = text.replace(
+            block, "{{- if .Values.webhook }}\n" + block + "{{- end }}\n")
     path = os.path.join(chart_dir, "templates", "controller.yaml")
     with open(path, "w") as f:
         f.write(text)
+    print(f"wrote {path}")
+    # webhook surface: whole template gated on .Values.webhook (needs
+    # the cert-manager CRDs installed)
+    wh = yaml.safe_dump_all(webhook_manifests("__NS__"), sort_keys=False)
+    wh = wh.replace("__NS__", "{{ .Values.controllernamespace }}")
+    path = os.path.join(chart_dir, "templates", "webhook.yaml")
+    with open(path, "w") as f:
+        f.write("{{- if .Values.webhook }}\n" + wh + "{{- end }}\n")
     print(f"wrote {path}")
 
 
@@ -269,6 +399,12 @@ def kustomize_manifests():
         # rename + re-namespace the whole operator install without
         # touching the rendered manifests:
         #   kubectl apply -k deploy/overlays/custom-namespace
+        # The manager discovers its namespace via the downward API
+        # (POD_NAMESPACE), so no container arg needs patching.  The
+        # webhook surface (deploy/v1/webhook.yaml) is NOT part of this
+        # base — its cert-manager strings (inject-ca-from, dnsNames,
+        # issuerRef) are untransformable by kustomize and must be
+        # edited by hand when re-namespacing (see that file's header).
         "namespace": "acme-tpu-system",
         "namePrefix": "acme-",
         "resources": ["../../v1"],
@@ -282,11 +418,14 @@ def main() -> int:
                [generate_crd()])
     write_yaml(os.path.join(root, "deploy", "v1", "operator.yaml"),
                operator_manifests())
+    # opt-in (needs the cert-manager CRDs): kubectl apply -f .../webhook.yaml
+    write_yaml(os.path.join(root, "deploy", "v1", "webhook.yaml"),
+               webhook_manifests())
     # legacy rendering for k8s <= 1.15 (reference parity: deploy/v1beta1)
     write_yaml(os.path.join(root, "deploy", "v1beta1", "crd.yaml"),
                [generate_crd_v1beta1()])
     write_yaml(os.path.join(root, "deploy", "v1beta1", "operator.yaml"),
-               operator_manifests())
+               operator_manifests(webhook=False))
     base, overlay = kustomize_manifests()
     write_yaml(os.path.join(root, "deploy", "v1", "kustomization.yaml"),
                [base])

@@ -32,6 +32,8 @@ def parse(path):
         if "metric" in d:
             tagged["primary"] = d
             continue
+        if len(d) != 1:
+            continue               # not a {tag: obj} bench line: skip
         (tag, val), = d.items()
         if tag in ("train_sweep", "decode_sweep"):
             tagged[tag].append(val)

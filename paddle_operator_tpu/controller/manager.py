@@ -30,6 +30,7 @@ from __future__ import annotations
 import argparse
 import http.server
 import json
+import os
 import queue
 import threading
 import time
@@ -393,6 +394,17 @@ def main(argv=None) -> int:
                    help="host-port allocation range 'start,end'")
     p.add_argument("--leader-elect", action="store_true")
     p.add_argument("--sync-period", type=float, default=2.0)
+    p.add_argument("--webhook-bind-address", default="",
+                   help="serve admission webhooks (validate/default) on "
+                        "host:port, e.g. ':9443' (reference main.go:76); "
+                        "empty disables")
+    p.add_argument("--webhook-cert-dir",
+                   default="/tmp/k8s-webhook-server/serving-certs",
+                   help="dir with tls.crt/tls.key (cert-manager Secret "
+                        "mount); the server waits for the cert to "
+                        "appear before listening.  Pass an EMPTY value "
+                        "to serve plain HTTP immediately (local dev — "
+                        "the apiserver itself only dials HTTPS)")
     p.add_argument("--config", default="",
                    help="YAML ControllerManagerConfig file; CLI flags "
                         "left at their defaults take the file's values")
@@ -408,7 +420,13 @@ def main(argv=None) -> int:
 
     metrics_addr = pick("metrics_bind_address", "metricsBindAddress")
     probe_addr = pick("health_probe_bind_address", "healthProbeBindAddress")
-    namespace = pick("namespace", "namespace")
+    # --namespace > config file > the pod's own namespace (downward-API
+    # POD_NAMESPACE env in the rendered Deployment) — baking a literal
+    # namespace into container args would survive a kustomize
+    # namespace transform and leave a re-namespaced install watching
+    # the wrong place
+    namespace = pick("namespace", "namespace") \
+        or os.environ.get("POD_NAMESPACE", "")
     port_range = str(pick("port_range", "portRange"))
     leader_elect = bool(pick("leader_elect", "leaderElect"))
     sync_period = float(pick("sync_period", "syncPeriod"))
@@ -432,6 +450,50 @@ def main(argv=None) -> int:
 
     _serve(addr_of(probe_addr, 8081), metrics, mgr.ready)
     _serve(addr_of(metrics_addr, 8080), metrics, mgr.ready)
+    webhook_addr = pick("webhook_bind_address", "webhookBindAddress")
+    if webhook_addr:
+        from paddle_operator_tpu.controller.webhook import \
+            make_webhook_server
+
+        host, port = addr_of(webhook_addr, 9443)
+        cert_dir = pick("webhook_cert_dir", "webhookCertDir")
+
+        def run_webhook():
+            # On a fresh install the pod starts BEFORE cert-manager
+            # issues the serving cert into the (optional) secret mount
+            # — checking once and falling back to plain HTTP would
+            # leave the webhooks permanently inert (the apiserver only
+            # dials HTTPS).  Wait for the cert (logged, so a missing
+            # cert-manager is diagnosable); serve plain HTTP only when
+            # the cert dir is explicitly emptied (local dev).  Serving
+            # failures (port clash, mismatched key pair mid-rotation)
+            # retry instead of silently killing the thread.
+            if cert_dir:
+                crt = os.path.join(cert_dir, "tls.crt")
+                waited = 0
+                while not os.path.exists(crt):
+                    if waited % 300 == 0:
+                        print(f"webhook: waiting for serving cert at "
+                              f"{crt} (cert-manager installed?)",
+                              flush=True)
+                    time.sleep(5)
+                    waited += 5
+            while True:
+                try:
+                    srv = make_webhook_server(host, port,
+                                              cert_dir=cert_dir or None)
+                    print(f"webhook: serving on {host}:{port} "
+                          f"(tls={'on' if cert_dir else 'off'})",
+                          flush=True)
+                    srv.serve_forever()
+                    return
+                except OSError as e:
+                    print(f"webhook: serve failed ({e}); retrying in "
+                          f"10s", flush=True)
+                    time.sleep(10)
+
+        threading.Thread(target=run_webhook, daemon=True,
+                         name="webhook").start()
     mgr.run()
     return 0
 
