@@ -140,3 +140,63 @@ class TestRenderedManifests:
         assert secret_vol["secret"]["secretName"] \
             == cert["spec"]["secretName"]
         assert secret_vol["secret"]["optional"] is True
+
+
+class TestTLS:
+    def test_serving_cert_rotation_without_restart(self, tmp_path):
+        """cert-manager rotates the serving pair in place; the webhook
+        server must present the NEW cert on subsequent connections
+        without a pod restart (a once-loaded context would serve an
+        expired cert forever, silently disabling admission under
+        failurePolicy Ignore)."""
+        import hashlib
+        import shutil
+        import ssl
+        import subprocess
+        import time
+
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl not available")
+        d = str(tmp_path)
+
+        def issue(cn):
+            subprocess.run(
+                ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                 "-keyout", f"{d}/k.tmp", "-out", f"{d}/c.tmp",
+                 "-days", "1", "-nodes", "-subj", f"/CN={cn}"],
+                check=True, capture_output=True)
+            import os
+            os.replace(f"{d}/k.tmp", f"{d}/tls.key")
+            os.replace(f"{d}/c.tmp", f"{d}/tls.crt")
+
+        issue("first")
+        srv = make_webhook_server("127.0.0.1", 0, cert_dir=d)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+        def peer_cert_digest():
+            import http.client
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            conn = http.client.HTTPSConnection("127.0.0.1", port,
+                                               context=ctx, timeout=10)
+            try:
+                conn.request("POST", "/validate-tpujob", json.dumps(
+                    {"request": {"uid": "u", "object": {}}}))
+                cert = conn.sock.getpeercert(binary_form=True)
+                out = json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+            assert out["response"]["uid"] == "u", out
+            return hashlib.sha256(cert).hexdigest()
+
+        try:
+            h1 = peer_cert_digest()
+            time.sleep(1.1)            # distinct tls.crt mtime
+            issue("rotated")
+            h2 = peer_cert_digest()
+            assert h1 != h2, "pre-rotation cert still served"
+        finally:
+            srv.shutdown()
