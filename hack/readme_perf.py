@@ -55,7 +55,7 @@ def _dsweep_index(entries):
     return out
 
 
-def render(t) -> str:
+def render(t, source=None) -> str:
     p = t["primary"]
     det = p["detail"]
     lines = []
@@ -132,10 +132,12 @@ def render(t) -> str:
             f"HTTP watch machinery; submit -> first train step "
             f"{det.get('submit_to_first_step_s', float('nan')):.1f} s "
             f"(dominated by XLA compile, {det['first_step_s']:.1f} s)")
+    cite = f"`{source}`" if source else "`BENCH_r*.json`"
     lines.append(
         "- run-to-run jitter on the relayed chip is ~±15% on decode "
-        "points; every number above comes from the same bench run "
-        "(`BENCH_r*.json` is the driver's artifact of record)")
+        "points; every number above was regenerated mechanically from "
+        f"the single bench run {cite} (hack/readme_perf.py — the "
+        "artifact of record, never hand-edited)")
     return "\n".join(lines)
 
 
@@ -143,7 +145,7 @@ def main(argv):
     if len(argv) != 2:
         print(__doc__)
         return 2
-    block = render(parse(argv[1]))
+    block = render(parse(argv[1]), source=os.path.basename(argv[1]))
     path = os.path.join(REPO, "README.md")
     text = open(path).read()
     pre, _, rest = text.partition(BEGIN)

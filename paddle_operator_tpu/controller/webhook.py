@@ -164,7 +164,22 @@ class _TLSServer(ThreadingHTTPServer):
     cert until a pod restart — with failurePolicy Ignore that silently
     disables admission cluster-wide.  Each accepted connection is
     wrapped with a context rebuilt on tls.crt mtime change (the same
-    job controller-runtime's cert watcher does)."""
+    job controller-runtime's cert watcher does).
+
+    The TLS handshake is NOT run on the accept loop (ADVICE r5 #1):
+    ``get_request`` only wraps the socket
+    (``do_handshake_on_connect=False`` touches no bytes on the wire)
+    and sets a short timeout, and the handshake happens in
+    :meth:`finish_request` on the per-connection ThreadingMixIn thread.
+    Previously a single stalled pre-handshake client (or a bare TCP
+    probe that never speaks TLS) blocked ``accept()`` indefinitely —
+    with failurePolicy Ignore that silently disabled admission
+    cluster-wide until the peer went away."""
+
+    # bounds the per-connection handshake AND subsequent request reads;
+    # a stalled client costs one worker thread for this long, never the
+    # accept loop
+    handshake_timeout = 10.0
 
     def __init__(self, addr, handler, cert_dir: str) -> None:
         super().__init__(addr, handler)
@@ -184,7 +199,19 @@ class _TLSServer(ThreadingHTTPServer):
 
     def get_request(self):
         sock, addr = super().get_request()
-        return self._context().wrap_socket(sock, server_side=True), addr
+        sock.settimeout(self.handshake_timeout)
+        return self._context().wrap_socket(
+            sock, server_side=True, do_handshake_on_connect=False), addr
+
+    def finish_request(self, request, client_address):
+        try:
+            request.do_handshake()
+        except (ssl.SSLError, OSError):
+            # bad TLS probe / stalled or vanished client: drop the
+            # connection quietly (process_request_thread's finally
+            # closes the socket); other connections were never blocked
+            return
+        super().finish_request(request, client_address)
 
 
 def make_webhook_server(host: str = "0.0.0.0", port: int = 9443,

@@ -54,18 +54,19 @@ from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
 
 
 def init_ring_cache(cfg: LlamaConfig, slots: int,
-                    max_len: int) -> Dict[str, jax.Array]:
+                    max_len: int, mesh=None) -> Dict[str, jax.Array]:
     """KV ring: like decode.init_cache (same head-major layout,
-    block-aligned allocation) but with a per-lane fill position vector
-    instead of one scalar."""
+    block-aligned allocation, same kv-head tp sharding under a serving
+    mesh) but with a per-lane fill position vector instead of one
+    scalar."""
     if max_len > cfg.max_seq_len:
         raise ValueError(f"max_len {max_len} exceeds the RoPE table "
                          f"(cfg.max_seq_len={cfg.max_seq_len})")
     alloc = D.cache_alloc_len(max_len)
     shape = (cfg.n_layers, slots, cfg.n_kv_heads, alloc, cfg.head_dim)
     return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+        "k": D.alloc_kv_buffer(cfg, shape, mesh),
+        "v": D.alloc_kv_buffer(cfg, shape, mesh),
         "pos": jnp.zeros((slots,), jnp.int32),
     }
 
@@ -161,20 +162,48 @@ def _write_lane_stacked(stack: jax.Array, kv: jax.Array, li: jax.Array,
 
 
 def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
-                  tok: jax.Array, cache: Dict[str, jax.Array]
-                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                  tok: jax.Array, cache: Dict[str, jax.Array],
+                  mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """tok [B] at per-lane cache['pos'] -> (logits [B, V], advanced
     cache).  Counterpart of decode._forward for vector positions; like
     it, the pallas path carries the caches STACKED through the layer
     scan so the kernel reads them copy-free (decode.py _forward has the
-    why)."""
+    why), and under a serving mesh the kernel + output projection run
+    TP-sharded in one manual region per layer (the ragged per-lane
+    ``pos`` vector is exactly the ``lengths`` operand the kernel's
+    index map already takes — replicated across shards)."""
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tok[:, None]]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
 
     attn_impl = cfg.resolved_decode_attn()
-    if attn_impl != "xla":
+    use_sharded = D._use_sharded_kernel(cfg, mesh, attn_impl)
+    if D.mesh_tp(mesh) > 1 and not use_sharded:
+        attn_impl = "xla"   # whole GQA groups don't split: GSPMD einsum
+    if use_sharded:
+        from paddle_operator_tpu.ops.decode_attention import (
+            sharded_decode_attention,
+        )
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
+            vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
+            proj = sharded_decode_attention(
+                mesh, q[:, 0], kc, vc, pos + 1,
+                lp["attn"]["wo"]["kernel"], layer=li,
+                interpret=(attn_impl == "pallas-interpret"),
+                compute_dtype=cfg.dtype)
+            x = x + proj[:, None].astype(cfg.dtype)
+            return (D._ffn_residual(cfg, lp, x), kc, vc), ()
+
+        (x, k_new, v_new), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    elif attn_impl != "xla":
         from paddle_operator_tpu.ops.decode_attention import decode_attention
 
         b = x.shape[0]
@@ -228,7 +257,7 @@ def _sample_tokens(logits, temp, keys, pos, top_k, top_p):
 
 def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                     top_k: Optional[int] = None,
-                    top_p: Optional[float] = None):
+                    top_p: Optional[float] = None, mesh=None):
     """The ONE resident compiled decode program.
 
     ``step(params, cache, tok [B], temp [B], keys [B,2], active [B])
@@ -238,13 +267,17 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     (their FLOPs are the price of static shapes — standard slot-server
     trade) but neither advance their position nor write meaningful
     state; their emitted tokens are ignored host-side.  The cache is
-    donated: the ring buffer must never be copied per chunk.
+    donated: the ring buffer must never be copied per chunk.  Under a
+    serving mesh the whole chunk remains ONE sharded dispatch — the
+    shard_map kernel regions and GSPMD einsums compile into the same
+    resident program, no eager per-device ops anywhere.
     """
 
     def step(params, cache, tok, temp, keys, active):
         def tick(carry, _):
             cache, tok = carry
-            logits, new_cache = _ring_forward(cfg, params, tok, cache)
+            logits, new_cache = _ring_forward(cfg, params, tok, cache,
+                                              mesh=mesh)
             nxt = _sample_tokens(logits, temp, keys, cache["pos"],
                                  top_k, top_p)
             # frozen lanes: position does not advance, cache rows keep
@@ -264,7 +297,7 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
 
 def make_prefill_insert(cfg: LlamaConfig, bucket: int,
                         top_k: Optional[int] = None,
-                        top_p: Optional[float] = None):
+                        top_p: Optional[float] = None, mesh=None):
     """Per-prompt-bucket compiled admission: prefill a [1, bucket]
     (right-padded) prompt, splice its KV into ring lane ``slot``, sample
     the first token, and update EVERY piece of lane state — tok, temp,
@@ -291,7 +324,7 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int,
     def insert(params, cache, tok, temp, keys, prompt, prompt_len, slot,
                temp_val, seed):
         lane = D.init_cache(cfg, 1, bucket)
-        logits, lane = D._forward(cfg, params, prompt, lane)
+        logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
         logits = logits[0, prompt_len - 1]                  # last real row
         k = jnp.zeros_like(cache["k"][:, 0])
         k = jax.lax.dynamic_update_slice(k, lane["k"][:, 0], (0, 0, 0, 0))
@@ -321,6 +354,19 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int,
 # ---------------------------------------------------------------------------
 # Host side: the scheduler
 # ---------------------------------------------------------------------------
+
+
+def _fold_seed(seed: int) -> int:
+    """Fold an out-of-int32-range seed to [0, 2**31) via the splitmix64
+    finalizer (a bijection on 64-bit ints before the final fold) —
+    distinct wide seeds stay distinct with overwhelming probability,
+    unlike the ``& 0x7FFFFFFF`` mask that mapped s and s + 2**31 to the
+    same sampling stream."""
+    x = seed & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 31
+    return x & 0x7FFFFFFF
 
 
 class _Request:
@@ -403,7 +449,16 @@ class ContinuousBatcher:
                  prefill_buckets: Tuple[int, ...] = (),
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 pipeline_depth: int = 2) -> None:
+                 pipeline_depth: int = 2, mesh=None) -> None:
+        # ``mesh`` (parallel/mesh.py make_serving_mesh): serve
+        # tensor-parallel — params are laid out over tp once here, the
+        # ring cache shards over the kv-head axis, and the resident
+        # chunk/insert programs compile sharded (shard_map pallas
+        # kernel + GSPMD einsums).  Token streams are identical to the
+        # single-device ring (tests/test_batcher.py pins it).
+        self.mesh = mesh
+        if mesh is not None and D.mesh_tp(mesh) > 1:
+            params = D.shard_params_for_serving(params, cfg, mesh)
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -420,11 +475,13 @@ class ContinuousBatcher:
         self.buckets = tuple(sorted(prefill_buckets)) or _default_buckets(
             self.max_len)
         self._top_k, self._top_p = top_k, top_p
-        self._step = make_chunk_step(cfg, chunk_tokens, top_k, top_p)
-        self._inserts = {b: make_prefill_insert(cfg, b, top_k, top_p)
+        self._step = make_chunk_step(cfg, chunk_tokens, top_k, top_p,
+                                     mesh=mesh)
+        self._inserts = {b: make_prefill_insert(cfg, b, top_k, top_p,
+                                                mesh=mesh)
                          for b in self.buckets}
 
-        self.cache = init_ring_cache(cfg, slots, self.max_len)
+        self.cache = init_ring_cache(cfg, slots, self.max_len, mesh=mesh)
         self.tok = jnp.zeros((slots,), jnp.int32)
         self.temp = jnp.zeros((slots,), jnp.float32)
         self.keys = jnp.zeros((slots, 2), jnp.uint32)
@@ -450,6 +507,17 @@ class ContinuousBatcher:
                temperature: float = 0.0, seed: int = 0,
                eos_token: Optional[int] = None,
                stream: bool = False) -> _Request:
+        """Queue one generation request; returns a handle whose
+        ``result()``/``stream()`` deliver the tokens.
+
+        ``seed``: sampling seed with an effective range of [0, 2**31) —
+        it rides into the compiled insert as an int32 traced argument.
+        In-range seeds are used as-is (streams are stable across
+        versions for the common case); anything outside (negative or
+        >= 2**31 — clients send arbitrary 64-bit ints, serve.py even
+        derives seed+i per row) is folded through a splitmix64 hash
+        rather than truncated, so distinct wide seeds keep distinct
+        streams (masking would collide s with s + 2**31)."""
         prompt = list(map(int, prompt))
         if not prompt:
             raise ValueError("empty prompt")
@@ -471,11 +539,11 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt ({len(prompt)}) + chunk-rounded budget ({budget}) "
                 f"exceeds max_len ({self.max_len})")
-        # the seed now rides into a jitted program as a traced argument,
-        # which parses as int32 — a 64-bit seed (clients send arbitrary
-        # ints; serve.py even derives seed+i per row) would raise
-        # OverflowError at dispatch.  Fold it into int32 range here.
-        seed = int(seed) & 0x7FFFFFFF
+        # int32-range seeds pass through untouched; wide/negative seeds
+        # hash-fold (see docstring)
+        seed = int(seed)
+        if not 0 <= seed < 0x80000000:
+            seed = _fold_seed(seed)
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        eos_token, wants_stream=stream)
         # pad + ship the prompt to the device HERE, on the caller's

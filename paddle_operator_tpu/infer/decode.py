@@ -33,6 +33,62 @@ import jax.numpy as jnp
 from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
 
 
+# ---------------------------------------------------------------------------
+# Mesh-sharded serving (tensor parallel over heads/ffn/vocab)
+# ---------------------------------------------------------------------------
+
+
+def mesh_tp(mesh) -> int:
+    """Size of the mesh's ``tp`` axis (1 for no mesh) — the one axis the
+    serving path shards over (parallel/mesh.py make_serving_mesh)."""
+    if mesh is None:
+        return 1
+    return dict(zip(mesh.axis_names, mesh.devices.shape)).get("tp", 1)
+
+
+def shard_params_for_serving(params: Dict[str, Any], cfg: LlamaConfig,
+                             mesh) -> Dict[str, Any]:
+    """Lay the serving param tree onto ``mesh``: the training partition
+    table (models/llama.py partition_patterns — heads/mlp/vocab → tp)
+    applied with indivisible axes replicated, which covers weight-only
+    int8 scale leaves whose contraction dim collapsed to 1.  Works on
+    raw bf16/f32 trees and quantize_params output alike."""
+    from paddle_operator_tpu.models.llama import partition_patterns
+    from paddle_operator_tpu.parallel.sharding import tree_shardings
+
+    return jax.device_put(
+        params, tree_shardings(params, mesh, partition_patterns(cfg),
+                               replicate_indivisible=True))
+
+
+def _use_sharded_kernel(cfg: LlamaConfig, mesh, attn_impl: str) -> bool:
+    """THE kernel-eligibility rule for tp>1 meshes, shared by
+    decode._forward and batcher._ring_forward: the pallas kernel enters
+    a sharded mesh only through shard_map (sharded_decode_attention)
+    and only when whole GQA groups split; everything else serves
+    through the GSPMD einsum path."""
+    return (mesh is not None and mesh_tp(mesh) > 1
+            and attn_impl != "xla"
+            and cfg.decode_tp_compatible(mesh_tp(mesh)))
+
+
+def alloc_kv_buffer(cfg: LlamaConfig, shape, mesh) -> jax.Array:
+    """One KV cache buffer (decode scalar cache or ring cache — they
+    differ only in the batch/lane dim), sharded over the kv-head axis
+    when the serving mesh can split it: every cache shard lives with
+    the wk/wv shard that fills it.  Indivisible kv heads leave the
+    buffer replicated — the GSPMD einsum fallback handles it.  Callers
+    allocate k and v separately: the jitted steps donate them as
+    distinct buffers."""
+    buf = jnp.zeros(shape, cfg.dtype)
+    if (mesh is not None and mesh_tp(mesh) > 1
+            and cfg.n_kv_heads % mesh_tp(mesh) == 0):
+        from paddle_operator_tpu.parallel.sharding import kv_cache_sharding
+
+        buf = jax.device_put(buf, kv_cache_sharding(mesh))
+    return buf
+
+
 def _rms(x: jax.Array, scale: jax.Array, eps: float, dtype) -> jax.Array:
     """models/llama.py RMSNorm math, f32 internals."""
     xf = x.astype(jnp.float32)
@@ -85,7 +141,8 @@ def cache_alloc_len(max_len: int) -> int:
 
 
 def init_cache(cfg: LlamaConfig, batch: int,
-               max_len: Optional[int] = None) -> Dict[str, jax.Array]:
+               max_len: Optional[int] = None,
+               mesh=None) -> Dict[str, jax.Array]:
     """Fixed-size KV cache: k/v [L, B, H_kv, alloc, D] in compute
     dtype, plus the fill position (scalar int32).  Head-major layout:
     per-head rows are contiguous, which is what both the XLA attention
@@ -105,8 +162,8 @@ def init_cache(cfg: LlamaConfig, batch: int,
     alloc = cache_alloc_len(max_len)
     shape = (cfg.n_layers, batch, cfg.n_kv_heads, alloc, cfg.head_dim)
     return {
-        "k": jnp.zeros(shape, cfg.dtype),
-        "v": jnp.zeros(shape, cfg.dtype),
+        "k": alloc_kv_buffer(cfg, shape, mesh),
+        "v": alloc_kv_buffer(cfg, shape, mesh),
         "pos": jnp.zeros((), jnp.int32),
     }
 
@@ -125,11 +182,13 @@ def _qkv(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     return _rope(q, cos, sin, pos), _rope(k, cos, sin, pos), v
 
 
-def _finish_layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
-                  out: jax.Array) -> jax.Array:
-    """Post-attention half: output projection + residual, then the
-    (dense SwiGLU or MoE) FFN + residual."""
-    x = x + _mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
+def _ffn_residual(cfg: LlamaConfig, lp: Dict[str, Any],
+                  x: jax.Array) -> jax.Array:
+    """The FFN half of a decoder layer: norm -> (SwiGLU or MoE) -> +x.
+    Split out of :func:`_finish_layer` because the TP-sharded kernel
+    path applies the output projection INSIDE its shard_map region
+    (attention out is head-sharded there; the wo contraction + psum is
+    the Megatron row-parallel reduction) and re-enters GSPMD here."""
     n = _rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     if cfg.n_experts > 0:
         ffn = _moe_ffn(cfg, lp["moe"], n)
@@ -139,6 +198,14 @@ def _finish_layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
         ffn = _mm(jax.nn.silu(gate) * up, lp["mlp"]["w2"]["kernel"],
                   cfg.dtype)
     return x + ffn
+
+
+def _finish_layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+                  out: jax.Array) -> jax.Array:
+    """Post-attention half: output projection + residual, then the
+    (dense SwiGLU or MoE) FFN + residual."""
+    x = x + _mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
+    return _ffn_residual(cfg, lp, x)
 
 
 def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
@@ -219,8 +286,8 @@ def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
 
 
 def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
-             cache: Dict[str, jax.Array], *, last_only: bool = False
-             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+             cache: Dict[str, jax.Array], *, last_only: bool = False,
+             mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """[B, T] new tokens at cache['pos'] -> ([B, T, vocab] logits,
     advanced cache).  Layers run under lax.scan over the stacked params
     (the same ``layers`` layout nn.scan trains).
@@ -228,14 +295,54 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     ``last_only``: apply the norm + lm head to the final position only
     (logits [B, 1, vocab]) — prefill needs just the next-token logits,
     and head logits over a whole long prompt are the biggest tensor in
-    the decode path ([B, S, V] f32 — gigabytes at real vocab sizes)."""
+    the decode path ([B, S, V] f32 — gigabytes at real vocab sizes).
+
+    ``mesh``: a serving mesh with a tp axis (make_serving_mesh) makes
+    the whole forward tensor-parallel: the einsum/matmul structure rides
+    GSPMD off the param/cache shardings, and the pallas kernel enters
+    through its own shard_map with a per-layer wo psum
+    (sharded_decode_attention).  Configs the kernel cannot split
+    (decode_tp_compatible) fall back to the GSPMD einsum path whole."""
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tokens]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
 
     attn_impl = cfg.resolved_decode_attn()
-    if tokens.shape[1] == 1 and attn_impl != "xla":
+    tp = mesh_tp(mesh)
+    use_sharded = _use_sharded_kernel(cfg, mesh, attn_impl)
+    if tp > 1 and not use_sharded:
+        attn_impl = "xla"   # kernel can't split whole GQA groups: GSPMD
+    if tokens.shape[1] == 1 and use_sharded:
+        # TP-sharded kernel: same stacked-cache scan as below, but the
+        # attention + output projection run inside one manual region per
+        # layer (ops/decode_attention.py sharded_decode_attention)
+        from paddle_operator_tpu.ops.decode_attention import (
+            sharded_decode_attention,
+        )
+
+        b = x.shape[0]
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv(cfg, lp, x, cos, sin, pos)
+            kc = jax.lax.dynamic_update_slice(
+                kc, k.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
+            proj = sharded_decode_attention(
+                mesh, q[:, 0], kc, vc, jnp.broadcast_to(pos + 1, (b,)),
+                lp["attn"]["wo"]["kernel"], layer=li,
+                interpret=(attn_impl == "pallas-interpret"),
+                compute_dtype=cfg.dtype)
+            x = x + proj[:, None].astype(cfg.dtype)
+            return (_ffn_residual(cfg, lp, x), kc, vc), ()
+
+        (x, k_new, v_new), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    elif tokens.shape[1] == 1 and attn_impl != "xla":
         # pallas decode path: the caches stay STACKED [L, B, H, S, D]
         # and flow as scan CARRY, with the layer index steering the
         # kernel's block index map.  Scanning them as xs (the einsum
@@ -284,7 +391,7 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 
 
 def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
-            max_len: Optional[int] = None
+            max_len: Optional[int] = None, mesh=None
             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Process the whole prompt [B, S] in one pass.  Returns
     ([B, vocab] last-position logits, filled cache)."""
@@ -292,20 +399,21 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
     if tokens.shape[1] > cache_len:
         raise ValueError(f"prompt length {tokens.shape[1]} exceeds the "
                          f"cache ({cache_len} positions)")
-    cache = init_cache(cfg, tokens.shape[0], max_len)
-    logits, cache = _forward(cfg, params, tokens, cache, last_only=True)
+    cache = init_cache(cfg, tokens.shape[0], max_len, mesh=mesh)
+    logits, cache = _forward(cfg, params, tokens, cache, last_only=True,
+                             mesh=mesh)
     return logits[:, 0], cache
 
 
 def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
-                token: jax.Array, cache: Dict[str, jax.Array]
-                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                token: jax.Array, cache: Dict[str, jax.Array],
+                mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One token [B] -> next-position logits [B, vocab] + advanced cache."""
-    logits, cache = _forward(cfg, params, token[:, None], cache)
+    logits, cache = _forward(cfg, params, token[:, None], cache, mesh=mesh)
     return logits[:, 0], cache
 
 
-def make_decode_fn(cfg: LlamaConfig):
+def make_decode_fn(cfg: LlamaConfig, mesh=None):
     """Jitted single-token step with the cache DONATED: driving
     decode_step yourself (serving loops, speculative drafts) without
     donation would copy the whole KV cache every step — for a 7B-shaped
@@ -317,7 +425,8 @@ def make_decode_fn(cfg: LlamaConfig):
     the passed cache buffer is consumed."""
 
     def step(params, token, cache):
-        logits, cache = _forward(cfg, params, token[:, None], cache)
+        logits, cache = _forward(cfg, params, token[:, None], cache,
+                                 mesh=mesh)
         return logits[:, 0], cache
 
     return jax.jit(step, donate_argnums=(2,))
@@ -349,14 +458,18 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
              key: Optional[jax.Array] = None,
              max_len: Optional[int] = None,
-             eos_token: Optional[int] = None) -> jax.Array:
+             eos_token: Optional[int] = None, mesh=None) -> jax.Array:
     """Greedy (temperature=0) or temperature sampling, with optional
     top-k / nucleus (top-p) filtering.  prompt [B, S] ->
     [B, S + max_new_tokens].  jit-friendly: the step loop is a lax.scan
     with static trip count (shapes never depend on when sequences stop).
     With ``eos_token``, a sequence that emits it keeps emitting eos for
     its remaining positions (the scan still runs max_new_tokens ticks —
-    static shapes beat early exit on TPU)."""
+    static shapes beat early exit on TPU).
+
+    ``mesh`` (make_serving_mesh) serves tensor-parallel: params must be
+    laid out with :func:`shard_params_for_serving`; output tokens are
+    identical to the single-device path (same math, head-sharded)."""
     if temperature > 0 and key is None:
         key = jax.random.PRNGKey(0)
     need = prompt.shape[1] + max_new_tokens
@@ -366,7 +479,7 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
                          f"({max_new_tokens}) = {need} exceeds the cache "
                          f"({cache_len} positions)")
 
-    logits, cache = prefill(params, cfg, prompt, max_len)
+    logits, cache = prefill(params, cfg, prompt, max_len, mesh=mesh)
     done0 = jnp.zeros((prompt.shape[0],), bool)
 
     def sample(logits, k):
@@ -381,7 +494,7 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
         if eos_token is not None:
             tok = jnp.where(done, jnp.asarray(eos_token, tok.dtype), tok)
             done = done | (tok == eos_token)
-        logits, cache = decode_step(params, cfg, tok, cache)
+        logits, cache = decode_step(params, cfg, tok, cache, mesh=mesh)
         return (logits, cache, done), tok
 
     keys = (jax.random.split(key, max_new_tokens) if temperature > 0

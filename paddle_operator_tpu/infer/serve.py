@@ -53,7 +53,12 @@ class Generator:
     MAX_CACHED = 32
 
     def __init__(self, params: Any, cfg: LlamaConfig,
-                 max_cached: int = MAX_CACHED) -> None:
+                 max_cached: int = MAX_CACHED, mesh=None) -> None:
+        # mesh (make_serving_mesh): TP-sharded batch serving — params
+        # laid out once, every jitted generate compiles sharded
+        self.mesh = mesh
+        if mesh is not None and D.mesh_tp(mesh) > 1:
+            params = D.shard_params_for_serving(params, cfg, mesh)
         self.params = params
         self.cfg = cfg
         self._fns: "OrderedDict[tuple, Any]" = OrderedDict()
@@ -73,7 +78,7 @@ class Generator:
                 fn = jax.jit(lambda p, t, k: D.generate(
                     p, self.cfg, t, max_new_tokens=max_new_tokens,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    eos_token=eos_token, key=k))
+                    eos_token=eos_token, key=k, mesh=self.mesh))
                 self._fns[key] = fn
                 while len(self._fns) > self._max_cached:
                     self._fns.popitem(last=False)
@@ -244,15 +249,18 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_server(host: str, port: int, params: Any, cfg: LlamaConfig,
-                *, continuous: bool = False,
+                *, continuous: bool = False, mesh=None,
                 **ring_kw) -> ThreadingHTTPServer:
     """``continuous=True`` serves through the decode ring
     (infer/batcher.py; ``ring_kw``: slots, max_len, chunk_tokens,
-    prefill_buckets, top_k, top_p).  The returned server carries
-    ``.generator`` — call its ``close()`` when tearing a continuous
-    server down to stop the ring thread."""
-    gen = (ContinuousGenerator(params, cfg, **ring_kw) if continuous
-           else Generator(params, cfg))
+    prefill_buckets, top_k, top_p).  ``mesh`` (make_serving_mesh)
+    makes either mode tensor-parallel — the ring's resident programs
+    and the batch generator's jits compile sharded, token streams
+    unchanged.  The returned server carries ``.generator`` — call its
+    ``close()`` when tearing a continuous server down to stop the ring
+    thread."""
+    gen = (ContinuousGenerator(params, cfg, mesh=mesh, **ring_kw)
+           if continuous else Generator(params, cfg, mesh=mesh))
     handler = type("Handler", (_Handler,), {"generator": gen})
     srv = ThreadingHTTPServer((host, port), handler)
     srv.generator = gen
@@ -304,13 +312,23 @@ def main() -> int:
                    "chunk_tokens": int(os.environ.get("SERVE_CHUNK", "8"))}
         if os.environ.get("SERVE_MAX_LEN"):
             ring_kw["max_len"] = int(os.environ["SERVE_MAX_LEN"])
+    # SERVE_TP=n: tensor-parallel serving over the pod's first n chips
+    # (weights a single chip cannot hold — the 7B-on-v5e case).  The
+    # mesh carries only the tp axis; DP is separate server replicas.
+    mesh = None
+    tp = int(os.environ.get("SERVE_TP", "1"))
+    if tp > 1:
+        from paddle_operator_tpu.parallel.mesh import make_serving_mesh
+
+        mesh = make_serving_mesh(tp)
     print(f"serving {os.environ.get('MODEL_PRESET', '7b')} "
           f"(resumed={resumed}, "
           f"quantize={os.environ.get('QUANTIZE', 'off')}, "
+          f"tp={tp}, "
           f"mode={'continuous' if continuous else 'batch'}) on :{env.port}",
           flush=True)
     srv = make_server("0.0.0.0", env.port, params, cfg,
-                      continuous=continuous, **ring_kw)
+                      continuous=continuous, mesh=mesh, **ring_kw)
     srv.serve_forever()
     return 0
 

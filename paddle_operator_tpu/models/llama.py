@@ -87,6 +87,16 @@ class LlamaConfig:
             return "pallas" if jax.default_backend() == "tpu" else "xla"
         return self.decode_attn
 
+    def decode_tp_compatible(self, tp: int) -> bool:
+        """Whether the pallas decode kernel can run tensor-parallel over
+        ``tp`` shards: the cache's kv-head axis must split evenly so
+        each shard contracts WHOLE GQA groups (Hq = n_rep * Hkv then
+        splits with it).  Configs that fail this (or whose head_dim the
+        kernel rejects) serve sharded through the GSPMD einsum path
+        instead — same math, no filled-prefix block skipping."""
+        return tp <= 1 or (self.n_kv_heads % tp == 0
+                           and self.n_heads % tp == 0)
+
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads

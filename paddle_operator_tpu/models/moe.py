@@ -65,10 +65,11 @@ class MoELayer(nn.Module):
         e, kk = cfg.n_experts, cfg.top_k
         if not 1 <= kk <= e:
             raise ValueError(f"top_k={kk} out of range for {e} experts")
-        # capacity counts TOKENS (not assignments): with top-2 each
-        # expert sees ~2x the assignment pressure at the same capacity
-        # factor, matching the GShard convention where capacity_factor
-        # is quoted per choice
+        # capacity counts ASSIGNMENTS (token-choices): k*T slots total,
+        # so with top-2 each expert's buffer doubles at the same
+        # capacity factor — the GShard per-choice convention, where
+        # capacity_factor is quoted per choice and an expert's buffer
+        # holds capacity_factor * (k*T/E) assignments
         cap = max(1, int(cfg.capacity_factor * kk * t / e))
 
         router = nn.Dense(e, use_bias=False, name="router",

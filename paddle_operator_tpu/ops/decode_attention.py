@@ -249,6 +249,77 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return out
 
 
+def sharded_decode_attention(mesh, q: jax.Array, k_cache: jax.Array,
+                             v_cache: jax.Array, lengths: jax.Array,
+                             wo, *, layer: Optional[jax.Array] = None,
+                             axis_name: str = "tp",
+                             interpret: bool = False,
+                             compute_dtype=None) -> jax.Array:
+    """Tensor-parallel decode attention + output projection in ONE
+    manual region (the Megatron decomposition, serving-side).
+
+    q [B, Hq, D] sharded over heads, caches sharded over the KV-head
+    axis ([B, Hkv, S, D], or stacked [L, B, Hkv, S, D] with ``layer``),
+    wo [Hq*D, E] row-sharded (raw kernel or the weight-only-int8
+    {"q","s"} dict — the per-output-channel scale is constant along the
+    contraction, so it commutes with the reduction).  Returns [B, E]
+    replicated: each shard runs the block-contraction kernel on its own
+    whole GQA groups (no cross-shard softmax terms exist — heads are
+    independent), contracts its local head slab against its rows of wo,
+    and a single psum over ``axis_name`` completes the projection.
+
+    A pallas call cannot be GSPMD-partitioned (XLA would all-gather the
+    sharded cache around the custom call), which is why the kernel must
+    enter the mesh through shard_map while the surrounding einsums ride
+    GSPMD.  Sharding is by WHOLE GQA groups: Hkv % tp must be 0 (then
+    Hq = n_rep * Hkv splits with it) — LlamaConfig.decode_tp_compatible
+    gates callers into the GSPMD einsum fallback otherwise."""
+    from paddle_operator_tpu.parallel.mesh import (
+        compat_shard_map,
+        resolve_shard_map_mesh,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    use_mesh, sizes = resolve_shard_map_mesh(mesh)
+    tp = sizes.get(axis_name, 1)
+    b, hq, d = q.shape
+    hkv = k_cache.shape[2] if layer is not None else k_cache.shape[1]
+    if hq % tp or hkv % tp:
+        raise ValueError(
+            f"Hq={hq}/Hkv={hkv} not divisible by {axis_name}={tp} — "
+            "route this config to the einsum path")
+    dtype = compute_dtype if compute_dtype is not None else q.dtype
+
+    head_spec = P(None, axis_name, None)
+    cache_spec = (P(None, None, axis_name, None, None)
+                  if layer is not None else P(None, axis_name, None, None))
+    wo_spec = ({"q": P(axis_name, None), "s": P(None, None)}
+               if isinstance(wo, dict) else P(axis_name, None))
+    stacked = layer is not None
+
+    def body(q, kc, vc, lens, wo, *lay):
+        out = decode_attention(q, kc, vc, lens,
+                               layer=lay[0] if stacked else None,
+                               interpret=interpret)      # [B, Hq/tp, D]
+        o = out.reshape(b, -1)
+        if isinstance(wo, dict):
+            o = (o @ wo["q"].astype(dtype)) * wo["s"][..., 0, :].astype(dtype)
+        else:
+            o = o @ wo.astype(dtype)
+        return jax.lax.psum(o, axis_name)                # [B, E]
+
+    fn = compat_shard_map(
+        body, mesh=use_mesh,
+        in_specs=(head_spec, cache_spec, cache_spec, P(), wo_spec)
+        + ((P(),) if stacked else ()),
+        out_specs=P(None, None),
+        axis_names=frozenset({axis_name}), check_vma=False)
+    args = (q, k_cache, v_cache, lengths.astype(jnp.int32), wo)
+    if stacked:
+        args += (layer,)
+    return fn(*args)
+
+
 def decode_attention_reference(q: jax.Array, k_cache: jax.Array,
                                v_cache: jax.Array,
                                lengths: jax.Array) -> jax.Array:

@@ -102,10 +102,19 @@ def spec_for_path(path_patterns: Sequence[Tuple[str, LogicalSpec]],
 
 def tree_shardings(tree: Any, mesh: Mesh,
                    path_patterns: Sequence[Tuple[str, LogicalSpec]],
-                   rules: Optional[Dict[str, Any]] = None):
+                   rules: Optional[Dict[str, Any]] = None,
+                   replicate_indivisible: bool = False):
     """NamedSharding pytree for `tree`: each leaf's path is matched against
     `path_patterns`; unmatched leaves are replicated.  Works on both real
-    arrays and ShapeDtypeStructs (use with jax.eval_shape to pre-plan)."""
+    arrays and ShapeDtypeStructs (use with jax.eval_shape to pre-plan).
+
+    ``replicate_indivisible`` extends the q8-leaf divisibility guard to
+    EVERY leaf: any axis whose size the mesh factor does not divide is
+    replicated instead.  The serving path needs this — weight-only-int8
+    scale leaves are the kernel with the contraction dim collapsed to 1
+    (infer/quant.py), so the kernel's spec can land a live mesh axis on
+    a size-1 dim.  Training keeps the default (a silently replicated
+    axis there would hide a real layout bug)."""
 
     def leaf_sharding(path, leaf):
         pstr = _path_str(path)
@@ -124,7 +133,8 @@ def tree_shardings(tree: Any, mesh: Mesh,
         ndim = getattr(leaf, "ndim", len(getattr(leaf, "shape", ())))
         parts = list(pspec)[:ndim]
         parts += [None] * (ndim - len(parts))
-        if pstr.endswith(("q8_codes", "q8_scale")):
+        if replicate_indivisible or pstr.endswith(("q8_codes",
+                                                   "q8_scale")):
             # blocking can shrink an axis below the mesh factor (a 1D
             # param's codes are [ceil(n/256), 256] — often one block):
             # replicate any axis the blocked shape can no longer divide
@@ -141,6 +151,23 @@ def tree_shardings(tree: Any, mesh: Mesh,
         return NamedSharding(mesh, P(*parts))
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def kv_cache_sharding(mesh: Mesh, *, stacked: bool = True,
+                      rules: Optional[Dict[str, Any]] = None
+                      ) -> NamedSharding:
+    """Sharding for the serving KV cache — stacked ``[L, B, Hkv, S, D]``
+    (the decode layer-scan carry) or per-layer ``[B, Hkv, S, D]``.
+
+    The kv-head axis rides the same ``kv_heads`` logical axis as the
+    wk/wv projections' output dim, so every cache shard lives on the tp
+    shard whose projections produce its rows: the decode kernel's
+    shard_map (ops/decode_attention.py sharded_decode_attention) then
+    reads and writes purely shard-locally.  Layers/batch/positions stay
+    unsharded — serving lanes are scheduled, not mesh-distributed."""
+    spec: LogicalSpec = (None, None, "kv_heads", None, None) if stacked \
+        else (None, "kv_heads", None, None)
+    return NamedSharding(mesh, logical_to_mesh(spec, rules, mesh))
 
 
 def batch_sharding(mesh: Mesh, extra_dims: int = 1,

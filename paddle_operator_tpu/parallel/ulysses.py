@@ -67,7 +67,7 @@ def make_ulysses_attention_fn(mesh: Mesh, *, causal: bool = True,
     make_ring_attention_fn — only ``cp`` is manual, so batch/head dims keep
     their dp/fsdp/tp shardings and the wrapper nests inside other manual
     regions (the pp pipeline body)."""
-    from jax import shard_map
+    from paddle_operator_tpu.parallel.mesh import compat_shard_map
 
     from paddle_operator_tpu.parallel.mesh import resolve_shard_map_mesh
 
@@ -76,13 +76,13 @@ def make_ulysses_attention_fn(mesh: Mesh, *, causal: bool = True,
 
     common = dict(mesh=use_mesh, out_specs=seq_spec,
                   axis_names=frozenset({axis_name}), check_vma=False)
-    fn = shard_map(
+    fn = compat_shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal),
         in_specs=(seq_spec, seq_spec, seq_spec),
         **common,
     )
-    fn_seg = shard_map(
+    fn_seg = compat_shard_map(
         functools.partial(ulysses_attention, axis_name=axis_name,
                           causal=causal),
         in_specs=(seq_spec, seq_spec, seq_spec, seq_spec),

@@ -65,7 +65,13 @@ class CheckpointManager:
 
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
         """Restore into the sharding/structure of `state_like` (an abstract
-        or concrete TrainState).  Returns the restored state."""
+        or concrete TrainState).  Returns the restored state.
+
+        Pre-r5 int8-moment checkpoints stored the Adam moments in the
+        FLAT ``[n_blocks, BLOCK]`` layout (train/opt8bit.py VERSION
+        NOTE); a shape-mismatch restore against the current shard-aware
+        template retries against the legacy template and re-blocks the
+        moments once, so old checkpoints keep resuming."""
         if not self._mgr:
             raise RuntimeError("checkpointing disabled (no path)")
         import orbax.checkpoint as ocp
@@ -73,8 +79,27 @@ class CheckpointManager:
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.path}")
-        return self._mgr.restore(step,
-                                 args=ocp.args.StandardRestore(state_like))
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.StandardRestore(state_like))
+        except Exception as err:
+            if not (hasattr(state_like, "params")
+                    and hasattr(state_like, "opt_state")):
+                raise
+            from paddle_operator_tpu.train import opt8bit
+
+            legacy, found = opt8bit.legacy_flat_template(state_like)
+            if not found:
+                raise
+            try:
+                raw = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(legacy))
+            except Exception:
+                # not an r4-layout checkpoint either: the ORIGINAL
+                # failure is the real story — surface it, not the
+                # legacy template's mismatch
+                raise err
+            return opt8bit.reblock_restored(raw, state_like)
 
     def wait(self) -> None:
         """Block until pending async saves are durable (call before exit)."""

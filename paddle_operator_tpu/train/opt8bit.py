@@ -39,6 +39,18 @@ decoupled weight decay, schedule support) and composes with
 clip_by_global_norm and the host-offload path (the int8 codes offload
 like any other opt-state leaf, at a quarter of the traffic).
 
+**VERSION NOTE — checkpoint layout.**  The r4 release stored every
+moment leaf FLAT: codes ``[n_blocks, BLOCK]`` over the whole flattened
+param (no correspondence to any param axis).  r5's shard-aware layout
+above is shape-incompatible with those checkpoints, so restore handles
+the migration explicitly: ``CheckpointManager.restore``
+(train/checkpoint.py) retries a failed restore against the legacy
+template (:func:`legacy_flat_template`) and re-blocks the moments once
+into the current layout (:func:`reblock_restored`).  Re-blocking moves
+block BOUNDARIES, so the values are requantized once under the new
+per-block scales — a one-time perturbation within the quantizer's own
+error bound, after which training proceeds in the r5 layout.
+
 Reference scope note: the reference operator has no training runtime at
 all (user containers own it); this realizes the "int8 Adam moments"
 depth recipe from the round-3 review, made mesh-ready in round 5.
@@ -224,6 +236,101 @@ def scale_by_adam8bit(b1: float = 0.9, b2: float = 0.999,
         return upds, ScaleByAdam8bitState(count=count, mu=mus, nu=nus)
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+# ---------------------------------------------------------------------------
+# Legacy (r4 flat-layout) checkpoint migration — see the VERSION NOTE in
+# the module docstring.
+# ---------------------------------------------------------------------------
+
+
+def _is_q8(x) -> bool:
+    return isinstance(x, _Q8)
+
+
+def _walk_opt_state(node, fn):
+    """Map ``fn`` over every ScaleByAdam8bitState inside an optax chain
+    state (a nest of (named)tuples/lists), leaving everything else."""
+    if isinstance(node, ScaleByAdam8bitState):
+        return fn(node)
+    if isinstance(node, tuple):
+        mapped = [_walk_opt_state(c, fn) for c in node]
+        return (type(node)(*mapped) if hasattr(node, "_fields")
+                else tuple(mapped))
+    if isinstance(node, list):
+        return [_walk_opt_state(c, fn) for c in node]
+    return node
+
+
+def _legacy_q8_struct(param) -> _Q8:
+    """The r4 flat layout for one param: codes [ceil(n/BLOCK), BLOCK]
+    over the WHOLE flattened leaf."""
+    import numpy as np
+
+    n = max(1, int(np.prod(param.shape)) if param.shape else 1)
+    nb = -(-n // BLOCK)
+    return _Q8(jax.ShapeDtypeStruct((nb, BLOCK), jnp.int8),
+               jax.ShapeDtypeStruct((nb, 1), jnp.float32))
+
+
+def legacy_flat_template(state):
+    """(template, found): ``state`` (a TrainState-like with ``.params``
+    and ``.opt_state``) with every _Q8 moment leaf replaced by its
+    r4 flat-layout ShapeDtypeStruct — the restore target for pre-r5
+    int8-moment checkpoints.  ``found`` is False when the state carries
+    no q8 moments (nothing to migrate)."""
+    found = [False]
+    params = state.params
+
+    def to_legacy(st):
+        found[0] = True
+
+        def leaf(_q8, p):
+            return _legacy_q8_struct(p)
+
+        return ScaleByAdam8bitState(
+            count=st.count,
+            mu=jax.tree_util.tree_map(leaf, st.mu, params, is_leaf=_is_q8),
+            nu=jax.tree_util.tree_map(leaf, st.nu, params, is_leaf=_is_q8),
+        )
+
+    opt = _walk_opt_state(state.opt_state, to_legacy)
+    return state.replace(opt_state=opt), found[0]
+
+
+def reblock_restored(state, like):
+    """Re-block an r4-flat-layout restore into the current last-axis
+    layout: dequantize each flat moment over the whole leaf, reshape to
+    the param, requantize under the shard-aware blocking (mu signed,
+    nu in its stored sqrt domain unsigned).  One-time requantization —
+    see the module VERSION NOTE."""
+    params = like.params
+
+    def reblock(st):
+        def one(q8, p, unsigned):
+            import numpy as np
+
+            codes = q8.q8_codes.astype(jnp.float32)
+            if unsigned:
+                codes = codes + 127.0
+            flat = (codes * q8.q8_scale).reshape(-1)
+            shape = tuple(p.shape)
+            want = max(1, int(np.prod(shape)) if shape else 1)
+            vals = flat[:want].reshape(shape)
+            return quantize_q8u(vals) if unsigned else quantize_q8(vals)
+
+        return ScaleByAdam8bitState(
+            count=st.count,
+            mu=jax.tree_util.tree_map(
+                lambda q, p: one(q, p, False), st.mu, params,
+                is_leaf=_is_q8),
+            nu=jax.tree_util.tree_map(
+                lambda q, p: one(q, p, True), st.nu, params,
+                is_leaf=_is_q8),
+        )
+
+    return state.replace(opt_state=_walk_opt_state(state.opt_state,
+                                                   reblock))
 
 
 def adamw8bit(learning_rate, b1: float = 0.9, b2: float = 0.999,

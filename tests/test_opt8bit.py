@@ -188,3 +188,88 @@ class TestTraining:
 
         with _pt.raises(ValueError, match="unknown moments"):
             T.make_optimizer(1e-3, moments="Int8")
+
+
+class TestLegacyCheckpointMigration:
+    def test_r4_flat_moment_checkpoint_restores_and_reblocks(
+            self, tmp_path):
+        """A checkpoint written in the r4 FLAT [n_blocks, BLOCK] moment
+        layout must restore against the current shard-aware template:
+        CheckpointManager retries with the legacy template and re-blocks
+        once (train/opt8bit.py VERSION NOTE), values preserved within
+        the quantizer's own error bound."""
+        from paddle_operator_tpu.train import opt8bit as Q8
+        from paddle_operator_tpu.train.checkpoint import CheckpointManager
+
+        rng = np.random.default_rng(11)
+        params = {
+            "w": jnp.asarray(rng.standard_normal((5, 300)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((7,)), jnp.float32),
+        }
+        opt = Q8.adamw8bit(1e-2)
+        opt_state = opt.init(params)
+        for i in range(3):      # nonzero moments
+            g = jax.tree_util.tree_map(
+                lambda p: jnp.asarray(
+                    rng.standard_normal(p.shape), jnp.float32), params)
+            _, opt_state = opt.update(g, opt_state, params)
+        state = T.TrainState(step=jnp.asarray(3, jnp.int32),
+                             params=params, opt_state=opt_state)
+
+        # forge the r4 image of this state: every moment dequantized,
+        # flattened whole, re-quantized flat (1-D input -> [nb, BLOCK])
+        def to_flat(st):
+            def one(q8, p, unsigned):
+                if unsigned:
+                    vals = Q8.dequantize_q8u(q8, p.shape)
+                    return Q8.quantize_q8u(vals.reshape(-1))
+                vals = Q8.dequantize_q8(q8, p.shape)
+                return Q8.quantize_q8(vals.reshape(-1))
+
+            is_q8 = lambda x: isinstance(x, Q8._Q8)  # noqa: E731
+            return Q8.ScaleByAdam8bitState(
+                count=st.count,
+                mu=jax.tree_util.tree_map(
+                    lambda q, p: one(q, p, False), st.mu, params,
+                    is_leaf=is_q8),
+                nu=jax.tree_util.tree_map(
+                    lambda q, p: one(q, p, True), st.nu, params,
+                    is_leaf=is_q8))
+
+        legacy = state.replace(
+            opt_state=Q8._walk_opt_state(state.opt_state, to_flat))
+        legacy_codes = [x for x in jax.tree_util.tree_leaves(
+            legacy.opt_state) if getattr(x, "dtype", None) == jnp.int8]
+        assert all(c.ndim == 2 for c in legacy_codes)   # really r4-flat
+
+        mgr = CheckpointManager(path=str(tmp_path))
+        mgr.save(1, legacy, force=True)
+        mgr.wait()
+
+        restored = mgr.restore(state)                  # NEW template
+        # shapes landed in the current layout
+        for a, b in zip(jax.tree_util.tree_leaves(state.opt_state),
+                        jax.tree_util.tree_leaves(restored.opt_state)):
+            assert a.shape == b.shape, (a.shape, b.shape)
+
+        # values survive within stacked quantization error
+        def deq_all(st, unsigned):
+            tree = st.nu if unsigned else st.mu
+            fn = Q8.dequantize_q8u if unsigned else Q8.dequantize_q8
+            return jax.tree_util.tree_map(
+                lambda q, p: fn(q, p.shape), tree, params,
+                is_leaf=lambda x: isinstance(x, Q8._Q8))
+
+        def adam_states(s):
+            out = []
+            Q8._walk_opt_state(s, lambda st: out.append(st) or st)
+            return out
+
+        for unsigned in (False, True):
+            want = deq_all(adam_states(state.opt_state)[0], unsigned)
+            got = deq_all(adam_states(restored.opt_state)[0], unsigned)
+            for k in params:
+                w, g = np.asarray(want[k]), np.asarray(got[k])
+                tol = max(np.abs(w).max(), 1e-6) * 3 / 127 + 1e-7
+                np.testing.assert_allclose(g, w, atol=tol)
+        mgr.close()

@@ -142,6 +142,62 @@ class TestRenderedManifests:
         assert secret_vol["secret"]["optional"] is True
 
 
+def _issue_cert(d, cn):
+    import os
+    import subprocess
+
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+         "-keyout", f"{d}/k.tmp", "-out", f"{d}/c.tmp",
+         "-days", "1", "-nodes", "-subj", f"/CN={cn}"],
+        check=True, capture_output=True)
+    os.replace(f"{d}/k.tmp", f"{d}/tls.key")
+    os.replace(f"{d}/c.tmp", f"{d}/tls.crt")
+
+
+class TestStalledClient:
+    def test_stalled_prehandshake_connection_does_not_block_admission(
+            self, tmp_path):
+        """A connection that never speaks TLS (a bare TCP probe, a
+        stalled client) must not block concurrent AdmissionReviews: the
+        handshake runs on the per-connection thread, never the accept
+        loop (ADVICE r5 #1 — previously one such peer silently disabled
+        admission until it went away)."""
+        import json
+        import shutil
+        import socket
+        import ssl
+
+        if shutil.which("openssl") is None:
+            pytest.skip("openssl not available")
+        d = str(tmp_path)
+        _issue_cert(d, "stall")
+        srv = make_webhook_server("127.0.0.1", 0, cert_dir=d)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        stalled = socket.create_connection(("127.0.0.1", port))
+        try:
+            # while the stalled socket sits pre-handshake, a real
+            # AdmissionReview must round-trip well inside its timeout
+            import http.client
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            conn = http.client.HTTPSConnection("127.0.0.1", port,
+                                               context=ctx, timeout=8)
+            try:
+                conn.request("POST", "/validate-tpujob", json.dumps(
+                    {"request": {"uid": "live", "object": {}}}))
+                out = json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+            assert out["response"]["uid"] == "live"
+        finally:
+            stalled.close()
+            srv.shutdown()
+
+
 class TestTLS:
     def test_serving_cert_rotation_without_restart(self, tmp_path):
         """cert-manager rotates the serving pair in place; the webhook
