@@ -3,6 +3,8 @@
 
 PY ?= python3
 IMG ?= tpujob/controller:latest
+# tier1 uses pipefail/PIPESTATUS (bashisms)
+SHELL := /bin/bash
 
 all: native test
 
@@ -12,6 +14,11 @@ native:
 
 test: native
 	$(PY) -m pytest tests/ -x -q
+
+# The ROADMAP.md tier-1 verify command, verbatim — the bar every PR must
+# keep no worse than the seed.
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Run the controller locally against the current kube context
 run:
@@ -43,4 +50,4 @@ clean:
 	$(MAKE) -C native clean
 	rm -rf .pytest_cache
 
-.PHONY: all native test run gen-deploy install deploy helm bench docker-build clean
+.PHONY: all native test tier1 run gen-deploy install deploy helm bench docker-build clean

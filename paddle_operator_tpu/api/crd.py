@@ -248,6 +248,7 @@ def _resource_status_schema() -> Dict[str, Any]:
         "properties": {
             "pending": _int(), "starting": _int(), "running": _int(),
             "failed": _int(), "succeeded": _int(), "unknown": _int(),
+            "preempted": _int(),
             "ready": {"type": "string"},
             "refs": {
                 "type": "array",
@@ -274,6 +275,24 @@ def _status_schema() -> Dict[str, Any]:
             "completionTime": {"type": "string", "format": "date-time"},
             "observedGeneration": _int(),
             "restartCount": _int(),
+            # fault-tolerance runtime (ft/, docs/fault-tolerance.md):
+            # budget-free preemption restarts, the sticky restart reason,
+            # the workload-published goodput block, and conditions —
+            # without these a structural-schema apiserver would PRUNE the
+            # fields on status update.
+            "preemptedCount": _int(),
+            "restartingReason": {"type": "string"},
+            "goodput": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
+            "conditions": {
+                "type": "array",
+                "items": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True,
+                },
+            },
         },
     }
 

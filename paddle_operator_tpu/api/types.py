@@ -55,6 +55,20 @@ COORDINATOR_PORT = 8476
 PORT_NUM = 8
 HOST_PORT_RANGE = (35000, 65000)
 
+# Workload exit-code contract (docs/fault-tolerance.md).  A worker that
+# catches a preemption notice (ft/preemption.py), finishes its in-flight
+# step and lands a durable checkpoint exits with EXIT_PREEMPTED — the
+# reconciler then restarts the gang WITHOUT consuming spec.maxRestarts
+# (capacity loss is not a program fault).  Any other non-zero exit burns
+# the budget.  Must match ft.preemption.EXIT_PREEMPTED.
+EXIT_PREEMPTED = 83
+
+# Annotation the reconciler stamps on pods it is about to tear down for a
+# rescale: a drain REQUEST (the workload's notice-file/SIGTERM watcher
+# gets the actual signal from kubelet on delete; the annotation gives the
+# node agent the advance notice to mirror into the notice file).
+DRAIN_ANNOTATION = "tpujob-drain"
+
 
 class JobMode:
     """Reference: PaddleJobMode (api/v1/paddlejob_types.go:47-56)."""
@@ -323,6 +337,9 @@ class ResourceStatus:
     failed: int = 0
     succeeded: int = 0
     unknown: int = 0
+    # Subset of `failed` whose containers exited EXIT_PREEMPTED (a
+    # completed preemption drain) — these do not burn the restart budget.
+    preempted: int = 0
     ready: str = ""
     # Object references to child pods: [{"kind": "Pod", "name": ..., ...}].
     refs: List[Dict[str, Any]] = field(default_factory=list)
@@ -333,6 +350,7 @@ class ResourceStatus:
             ("pending", "pending"), ("starting", "starting"),
             ("running", "running"), ("failed", "failed"),
             ("succeeded", "succeeded"), ("unknown", "unknown"),
+            ("preempted", "preempted"),
         ):
             if getattr(self, attr):
                 d[k] = getattr(self, attr)
@@ -352,6 +370,7 @@ class ResourceStatus:
             failed=d.get("failed", 0),
             succeeded=d.get("succeeded", 0),
             unknown=d.get("unknown", 0),
+            preempted=d.get("preempted", 0),
             ready=d.get("ready", ""),
             refs=d.get("refs", []) or [],
         )
@@ -372,8 +391,37 @@ class TPUJobStatus:
     start_time: Optional[str] = None          # RFC3339
     completion_time: Optional[str] = None
     observed_generation: int = 0
-    # Fault tolerance (new): completed whole-job restarts.
+    # Fault tolerance (new): completed whole-job restarts that consumed
+    # the spec.maxRestarts budget (program failures).
     restart_count: int = 0
+    # Restarts that did NOT consume the budget: preemption drains
+    # (EXIT_PREEMPTED workers — capacity loss, not program fault).
+    preempted_count: int = 0
+    # Why the in-flight RESTARTING cycle started ("Preempted" |
+    # "PodFailure"); sticky alongside the phase, cleared when the restart
+    # completes.  Decides which counter the restart lands in.
+    restarting_reason: str = ""
+    # Workload-published goodput block (ft/goodput.py
+    # GoodputTracker.to_status): ratio, productive/wallclock seconds,
+    # badput breakdown.  The manager exports it as tpujob_goodput_*
+    # gauges on /metrics.
+    goodput: Dict[str, Any] = field(default_factory=dict)
+    # k8s-style status conditions; the reconciler maintains a "Goodput"
+    # condition from the published block.
+    conditions: List[Dict[str, Any]] = field(default_factory=list)
+
+    def set_condition(self, cond: Dict[str, Any]) -> None:
+        """Upsert by condition type, keeping lastTransitionTime stable
+        when only the message changed but status did not."""
+        for i, c in enumerate(self.conditions):
+            if c.get("type") == cond.get("type"):
+                if c.get("status") == cond.get("status") and \
+                        c.get("lastTransitionTime"):
+                    cond = dict(cond)
+                    cond["lastTransitionTime"] = c["lastTransitionTime"]
+                self.conditions[i] = cond
+                return
+        self.conditions.append(cond)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -400,6 +448,14 @@ class TPUJobStatus:
             d["observedGeneration"] = self.observed_generation
         if self.restart_count:
             d["restartCount"] = self.restart_count
+        if self.preempted_count:
+            d["preemptedCount"] = self.preempted_count
+        if self.restarting_reason:
+            d["restartingReason"] = self.restarting_reason
+        if self.goodput:
+            d["goodput"] = self.goodput
+        if self.conditions:
+            d["conditions"] = self.conditions
         return d
 
     @classmethod
@@ -416,6 +472,10 @@ class TPUJobStatus:
             completion_time=d.get("completionTime"),
             observed_generation=d.get("observedGeneration", 0),
             restart_count=d.get("restartCount", 0),
+            preempted_count=d.get("preemptedCount", 0),
+            restarting_reason=d.get("restartingReason", ""),
+            goodput=d.get("goodput", {}) or {},
+            conditions=d.get("conditions", []) or [],
         )
 
 

@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from paddle_operator_tpu.api.types import (
     COORDINATOR_PORT,
+    EXIT_PREEMPTED,
     HOSTPORT_ANNOTATION,
     PORT_NUM,
     RESOURCE_ANNOTATION,
@@ -89,6 +90,25 @@ def is_pod_real_running(pod: Dict[str, Any]) -> bool:
     return True
 
 
+def is_pod_preempted(pod: Dict[str, Any]) -> bool:
+    """Whether a Failed pod is a *completed preemption drain*: every
+    terminated container exited 0 or EXIT_PREEMPTED with at least one
+    EXIT_PREEMPTED (ft/preemption.py's exit-code contract).  A pod whose
+    status carries no container exit information is NOT preempted — an
+    unexplained failure must keep burning the restart budget."""
+    status = pod.get("status", {})
+    if status.get("phase") != "Failed":
+        return False
+    codes = []
+    for c in status.get("containerStatuses", []):
+        term = (c.get("state") or {}).get("terminated")
+        if term is None:
+            return False   # still running / no exit info
+        codes.append(int(term.get("exitCode", -1)))
+    return bool(codes) and all(x in (0, EXIT_PREEMPTED) for x in codes) \
+        and EXIT_PREEMPTED in codes
+
+
 def is_pod_initializing(pod: Dict[str, Any]) -> bool:
     status = pod.get("status", {})
     if status.get("phase") != "Pending":
@@ -133,7 +153,16 @@ def get_job_phase(job: TPUJob) -> str:
     if st.phase == Phase.SCALING:
         # Same stickiness for the gang-rescale cycle (reconciler._rescale).
         return Phase.SCALING
-    if st.ps.failed > 0 or st.worker.failed > 0 or st.heter.failed > 0:
+    failed = st.ps.failed + st.worker.failed + st.heter.failed
+    if failed > 0:
+        preempted = (st.ps.preempted + st.worker.preempted
+                     + st.heter.preempted)
+        if preempted == failed:
+            # Every failure is a completed preemption drain
+            # (EXIT_PREEMPTED): capacity loss, not program fault — restart
+            # without consuming the maxRestarts budget, even when it is
+            # already exhausted.
+            return Phase.RESTARTING
         if st.restart_count < job.spec.max_restarts:
             return Phase.RESTARTING
         return Phase.FAILED

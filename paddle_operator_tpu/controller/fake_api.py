@@ -277,6 +277,27 @@ class FakeFleet:
     def fail(self, pod_name: str) -> None:
         self.set_phase(pod_name, "Failed")
 
+    def preempt(self, pod_name: str) -> None:
+        """Fail a pod the way a completed preemption drain does: phase
+        Failed with every container terminated at EXIT_PREEMPTED
+        (ft/preemption.py's exit-code contract) — what kubelet reports
+        after the trainer catches SIGTERM, lands its checkpoint, and
+        exits 83."""
+        from paddle_operator_tpu.api.types import EXIT_PREEMPTED
+
+        with self.api._lock:
+            key = ("Pod", self.namespace, pod_name)
+            pod = self.api.store[key]
+            st = pod.setdefault("status", {})
+            st["phase"] = "Failed"
+            st["containerStatuses"] = [
+                {"name": c.get("name", "main"), "ready": False,
+                 "state": {"terminated": {"exitCode": EXIT_PREEMPTED}}}
+                for c in pod.get("spec", {}).get("containers", [])
+            ] or [{"name": "main", "ready": False,
+                   "state": {"terminated": {"exitCode": EXIT_PREEMPTED}}}]
+            self.api._notify("Pod", "MODIFIED", pod)
+
     def succeed_all(self) -> None:
         for (_, _, name), _ in self._pods():
             self.set_phase(name, "Succeeded")

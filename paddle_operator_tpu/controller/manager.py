@@ -90,11 +90,13 @@ class Workqueue:
 
 
 class Metrics:
-    """Minimal prometheus-text counters (reference: controller-runtime
-    metrics at :8080)."""
+    """Minimal prometheus-text counters and gauges (reference:
+    controller-runtime metrics at :8080).  Keys may carry prometheus
+    labels inline (``name{job="ns/x"}``) — the renderer treats the whole
+    key as opaque."""
 
     def __init__(self) -> None:
-        self.counters: Dict[str, int] = {
+        self.counters: Dict[str, float] = {
             "tpujob_reconcile_total": 0,
             "tpujob_reconcile_errors_total": 0,
             "tpujob_active_jobs": 0,
@@ -105,9 +107,13 @@ class Metrics:
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
 
-    def set(self, name: str, v: int) -> None:
+    def set(self, name: str, v: float) -> None:
         with self._lock:
             self.counters[name] = v
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self.counters.pop(name, None)
 
     def render(self) -> str:
         with self._lock:
@@ -244,6 +250,8 @@ class Manager:
                        if leader_elect else None)
         self._stop = threading.Event()
         self._ready = False
+        # job key -> gauge names last exported for it (stale-prune state)
+        self._goodput_gauges: Dict[str, Set[str]] = {}
 
     def ready(self) -> bool:
         return self._ready
@@ -258,6 +266,7 @@ class Manager:
         production path)."""
         jobs = self._list_jobs()
         self.metrics.set("tpujob_active_jobs", len(jobs))
+        self._export_goodput(jobs)
         n = 0
         for j in jobs:
             name = j["metadata"]["name"]
@@ -273,6 +282,31 @@ class Manager:
             except Exception:
                 self.metrics.inc("tpujob_reconcile_errors_total")
         return n
+
+    def _export_goodput(self, jobs) -> None:
+        """Mirror each job's workload-published ``status.goodput`` block
+        (ft/goodput.py) into per-job ``tpujob_goodput_*`` /
+        ``tpujob_badput_seconds`` gauges on ``/metrics`` — the scrapeable
+        face of the goodput accounting.  Gauges of deleted jobs (and
+        gauge names a job stopped publishing) are pruned, so /metrics
+        never serves stale readings and the registry stays bounded."""
+        from paddle_operator_tpu.ft.goodput import goodput_gauges
+
+        exported: Dict[str, Set[str]] = {}
+        for j in jobs:
+            gp = (j.get("status") or {}).get("goodput")
+            if not gp:
+                continue
+            ns = j["metadata"].get("namespace", self.namespace)
+            key = f'{ns}/{j["metadata"]["name"]}'
+            gauges = goodput_gauges(gp, key)
+            for name, val in gauges.items():
+                self.metrics.set(name, val)
+            exported[key] = set(gauges)
+        for key, names in self._goodput_gauges.items():
+            for stale in names - exported.get(key, set()):
+                self.metrics.remove(stale)
+        self._goodput_gauges = exported
 
     def _list_jobs(self):
         if hasattr(self.api, "list_kind"):  # FakeAPI (locked snapshot)
@@ -342,6 +376,7 @@ class Manager:
                 try:
                     jobs = self._list_jobs()
                     self.metrics.set("tpujob_active_jobs", len(jobs))
+                    self._export_goodput(jobs)
                     for j in jobs:
                         wq.add(j["metadata"]["name"])
                 except Exception as e:

@@ -31,6 +31,7 @@ from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional
 
 from paddle_operator_tpu.api.types import (
+    DRAIN_ANNOTATION,
     HOSTPORT_ANNOTATION,
     RESOURCE_HETER,
     RESOURCE_PS,
@@ -358,7 +359,13 @@ class TPUJobReconciler:
         """Reference getCurrentStatus (controller.go:238-294)."""
         status = TPUJobStatus(
             restart_count=job.status.restart_count,
+            preempted_count=job.status.preempted_count,
             observed_generation=job.generation,
+            # Workload-published goodput and the condition list ride along
+            # rather than being recomputed — the status sync owns pod
+            # counters, not trainer telemetry.
+            goodput=job.status.goodput,
+            conditions=[dict(c) for c in job.status.conditions],
         )
 
         def sync(rs: ResourceStatus, pod: Dict[str, Any]) -> None:
@@ -375,6 +382,8 @@ class TPUJobReconciler:
                     rs.starting += 1
             elif phase == "Failed":
                 rs.failed += 1
+                if builders.is_pod_preempted(pod):
+                    rs.preempted += 1
             elif phase == "Succeeded":
                 rs.succeeded += 1
             else:
@@ -447,6 +456,26 @@ class TPUJobReconciler:
         now = _now()
         status.start_time = builders.get_start_time(probe, now)
         status.completion_time = builders.get_completion_time(probe, now)
+        # Why is the gang restarting?  Decided once on the transition into
+        # RESTARTING (from the observed pod exit codes), then sticky with
+        # the phase so _restart — which runs after the pods are gone —
+        # still knows which counter the restart belongs to.
+        if status.phase == Phase.RESTARTING:
+            if (job.status.phase == Phase.RESTARTING
+                    and job.status.restarting_reason):
+                status.restarting_reason = job.status.restarting_reason
+            else:
+                failed = (status.ps.failed + status.worker.failed
+                          + status.heter.failed)
+                preempted = (status.ps.preempted + status.worker.preempted
+                             + status.heter.preempted)
+                status.restarting_reason = (
+                    "Preempted" if failed and failed == preempted
+                    else "PodFailure")
+        if status.goodput:
+            from paddle_operator_tpu.ft.goodput import goodput_condition
+
+            status.set_condition(goodput_condition(status.goodput, now))
         return status
 
     def _teardown_gang(self, job: TPUJob,
@@ -499,17 +528,28 @@ class TPUJobReconciler:
         return Result(requeue_after=1.0)
 
     def _restart(self, job: TPUJob, child_pods: List[Dict[str, Any]]) -> Result:
-        """Tear down the whole gang and bump restartCount; next passes
+        """Tear down the whole gang and account the restart; next passes
         recreate every pod with identical ranks so the XLA coordinator
-        re-forms and training resumes from the checkpoint path."""
+        re-forms and training resumes from the checkpoint path.
+
+        A restart whose reason is ``Preempted`` (every failed pod exited
+        EXIT_PREEMPTED — a completed drain) lands in ``preemptedCount``
+        and leaves the ``maxRestarts`` failure budget untouched; anything
+        else consumes it as before."""
         if self._teardown_gang(job, child_pods):
             return Result(requeue_after=1.0)
-        job.status.restart_count += 1
+        preempted = job.status.restarting_reason == "Preempted"
+        if preempted:
+            job.status.preempted_count += 1
+            msg = (f"preemption restart {job.status.preempted_count} "
+                   f"(failure budget untouched: "
+                   f"{job.status.restart_count}/{job.spec.max_restarts})")
+        else:
+            job.status.restart_count += 1
+            msg = f"restart {job.status.restart_count}/{job.spec.max_restarts}"
+        job.status.restarting_reason = ""
         job.status.phase = Phase.PENDING
-        self.api.record_event(
-            job.to_dict(), "Warning", "Restarting",
-            f"restart {job.status.restart_count}/{job.spec.max_restarts}",
-        )
+        self.api.record_event(job.to_dict(), "Warning", "Restarting", msg)
         try:
             self.api.update_status(KIND_JOB, job.to_dict())
         except (Conflict, NotFound):
@@ -540,7 +580,34 @@ class TPUJobReconciler:
         from the checkpoint) but WITHOUT consuming the failure-restart
         budget — scaling is user intent, not a fault.  Per-pod services go
         too (the new gang recreates its own; keeping stale ones would leak
-        them, as the reference does on scale-down)."""
+        them, as the reference does on scale-down).
+
+        The teardown is drain-first: running pods get the
+        ``tpujob-drain`` annotation one pass ahead of deletion — the
+        advance notice a node agent mirrors into the workload's
+        preemption-notice file (ft/preemption.py), and the signal for the
+        trainer to land a final checkpoint.  Deletion itself still
+        delivers SIGTERM, so a workload without the annotation relay
+        drains one pass later via its signal handler."""
+        undrained = [
+            p for p in child_pods
+            if not p["metadata"].get("deletionTimestamp")
+            and DRAIN_ANNOTATION not in (p["metadata"].get("annotations")
+                                         or {})
+        ]
+        if undrained:
+            for pod in undrained:
+                pod["metadata"].setdefault(
+                    "annotations", {})[DRAIN_ANNOTATION] = "rescale"
+                try:
+                    self.api.update(KIND_POD, pod)
+                except (Conflict, NotFound):
+                    pass
+            self.api.record_event(
+                job.to_dict(), "Normal", "DrainRequested",
+                f"{len(undrained)} pod(s) asked to checkpoint and drain "
+                f"before rescale")
+            return Result(requeue_after=1.0)
         if self._teardown_gang(job, child_pods):
             return Result(requeue_after=1.0)
         job.status.phase = Phase.PENDING

@@ -1,0 +1,44 @@
+"""Fault-tolerance runtime: the workload side of the recovery contract.
+
+The controller half of fault tolerance has existed since the seed (gang
+restart on pod failure, elastic rescale, checkpoint-path injection —
+controller/reconciler.py); this package closes the loop from inside the
+trainer:
+
+- :mod:`ft.preemption` — SIGTERM / maintenance-notice drain: finish the
+  in-flight step, force a durable checkpoint, exit ``EXIT_PREEMPTED`` so
+  the controller restarts the gang without burning the failure budget.
+- :mod:`ft.elastic` — topology-elastic resume: restore a checkpoint saved
+  under mesh A onto mesh B (dp resize within the CRD's elastic bounds),
+  with deterministic data fast-forward and LR-schedule continuity.
+- :mod:`ft.goodput` — productive-time vs wallclock accounting with a
+  badput breakdown (init / restore / lost work), exported through the
+  manager's ``/metrics`` endpoint and a job-status condition.
+
+Exports resolve lazily (module ``__getattr__``): ``ft.goodput`` and
+``ft.preemption`` are stdlib-only, and the CONTROL PLANE imports
+``ft.goodput`` on every metrics pass — an eager ``ft.elastic`` import
+here would drag jax/orbax into the previously ML-stack-free controller
+image (and its multi-second import into the reconcile loop).
+"""
+
+_EXPORTS = {
+    "elastic_resume": "paddle_operator_tpu.ft.elastic",
+    "resume_step_for": "paddle_operator_tpu.ft.elastic",
+    "scale_schedule": "paddle_operator_tpu.ft.elastic",
+    "GoodputTracker": "paddle_operator_tpu.ft.goodput",
+    "EXIT_PREEMPTED": "paddle_operator_tpu.ft.preemption",
+    "PreemptionWatcher": "paddle_operator_tpu.ft.preemption",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,108 @@
+"""Topology-elastic resume: a checkpoint saved under mesh A restores onto
+mesh B.
+
+What makes a reshape legal (docs/fault-tolerance.md): a checkpoint stores
+*global* logical arrays — sharding is metadata, not layout — so any resume
+whose TrainState tree (model config + optimizer) is identical can pick a
+new mesh; orbax reshards each array to the template's ``NamedSharding`` at
+restore time.  The production case is a **dp resize** inside the CRD's
+elastic bounds (``worker.requests``/``limits``): dp shards only the batch
+dim, so params/opt-state are untouched and the restore is a pure
+re-placement.  fsdp/tp resizes work the same way provided every sharded
+axis stays divisible by its new mesh factor (tree_shardings raises
+otherwise).
+
+Two things do NOT come for free and are handled here:
+
+- **data continuity** — the batch at global step *k* must be the same
+  batches regardless of world shape, or resume silently repeats/skips
+  data.  :func:`resume_step_for` maps preserved progress (global step ×
+  global batch = tokens) to the iterator fast-forward offset; the
+  deterministic sources in train/data.py accept ``start_step``.
+- **LR-schedule continuity** — when the global batch changes with the
+  world size, a per-step schedule would replay or fast-forward the decay.
+  :func:`scale_schedule` re-parameterizes it to token-equivalent position
+  (plus the linear-scaling LR rule).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from paddle_operator_tpu.train.checkpoint import CheckpointManager, resume_or_init
+
+
+def resume_step_for(tokens_consumed: int, global_batch: int) -> int:
+    """Iterator fast-forward offset: the number of *new-batch* steps whose
+    data has already been consumed.  Floor — a partially-consumed batch is
+    re-read rather than skipped (repeating a fraction of one batch is
+    harmless; skipping data is not)."""
+    if global_batch <= 0:
+        raise ValueError(f"global_batch must be positive, got {global_batch}")
+    return tokens_consumed // global_batch
+
+
+def scale_schedule(base_schedule: Callable, ref_global_batch: int,
+                   global_batch: int, *, scale_lr: bool = True) -> Callable:
+    """Wrap a per-step LR schedule defined for ``ref_global_batch`` so a
+    run at ``global_batch`` traverses it at the same tokens-per-unit rate.
+
+    ``schedule(count)`` is evaluated at ``count * global_batch /
+    ref_global_batch`` — the token-equivalent position — so warmup and
+    decay land on the same *data*, not the same step index, across elastic
+    resizes.  ``scale_lr`` additionally applies the linear scaling rule
+    (LR proportional to global batch), the standard compensation when dp
+    shrink halves the batch.  With equal batches this is the identity."""
+    if ref_global_batch <= 0 or global_batch <= 0:
+        raise ValueError("global batch sizes must be positive")
+    ratio = global_batch / ref_global_batch
+
+    def sched(count):
+        lr = base_schedule(count * ratio)
+        return lr * ratio if scale_lr else lr
+
+    return sched if ratio != 1.0 else base_schedule
+
+
+def elastic_resume(ckpt: CheckpointManager, init_fn: Callable,
+                   state_like: Any = None, *,
+                   saved_global_batch: Optional[int] = None,
+                   global_batch: Optional[int] = None,
+                   goodput=None,
+                   logger=None) -> Tuple[Any, bool, Dict[str, Any]]:
+    """The restart entry for an elastic gang: restore the newest complete
+    checkpoint into the *current* mesh's template (``init_fn``/
+    ``state_like`` built against the new mesh — orbax reshards), falling
+    back over corrupt steps like :func:`resume_or_init`.
+
+    Returns ``(state, resumed, plan)`` where ``plan`` carries the data
+    continuity numbers::
+
+        step             restored global step (0 when fresh)
+        tokens_consumed  step × saved_global_batch
+        data_start_step  fast-forward offset for the NEW global batch
+
+    ``goodput`` (a :class:`ft.goodput.GoodputTracker`) attributes the
+    restore wallclock to the ``restore`` badput bucket."""
+    import contextlib
+
+    phase = (goodput.phase("restore") if goodput is not None
+             else contextlib.nullcontext())
+    with phase:
+        state, resumed = resume_or_init(ckpt, init_fn, state_like,
+                                        logger=logger)
+    step = int(state.step) if resumed else 0
+    sgb = saved_global_batch or global_batch or 0
+    ngb = global_batch or saved_global_batch or 0
+    tokens = step * sgb
+    plan: Dict[str, Any] = {
+        "step": step,
+        "tokens_consumed": tokens,
+        "data_start_step": (resume_step_for(tokens, ngb) if ngb else step),
+    }
+    if resumed and logger is not None:
+        logger.info(
+            f"elastic resume: step={step} tokens={tokens} "
+            f"global_batch {sgb}->{ngb} "
+            f"data_start_step={plan['data_start_step']}")
+    return state, resumed, plan

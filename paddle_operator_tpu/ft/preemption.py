@@ -1,0 +1,184 @@
+"""Preemption drain: turn SIGTERM / maintenance notices into a clean exit.
+
+On spot/preemptible capacity the node gives the pod a grace window
+(kubelet SIGTERM on pod deletion; GKE additionally surfaces upcoming TPU
+maintenance through a notice file).  Without a handler the trainer dies
+mid-step and the whole interval since the last periodic checkpoint is
+lost work.  With this watcher the fit loop (train/trainer.py) finishes
+the in-flight step, forces a durable checkpoint (``save(force=True)`` +
+``wait()``) and the process exits ``EXIT_PREEMPTED`` — a code the
+reconciler recognizes as *capacity loss, not program failure*, so the
+gang restarts without consuming ``spec.maxRestarts``
+(controller/builders.py get_job_phase, controller/reconciler.py).
+
+The exit-code contract (docs/fault-tolerance.md):
+
+    0               clean completion
+    EXIT_PREEMPTED  drain completed; checkpoint durable; restart me
+    anything else   program failure; consumes the restart budget
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Iterable, Optional
+
+# Also defined (as the cross-layer contract constant) in api/types.py; the
+# two must agree — tests/test_ft_preemption.py pins them together.
+EXIT_PREEMPTED = 83
+
+# Env var naming the maintenance-notice file a node agent touches ahead of
+# TPU maintenance / spot reclaim (GKE: the maintenance-event metadata is
+# mirrored to a file by the node watcher DaemonSet).
+NOTICE_FILE_ENV = "TPUJOB_PREEMPTION_NOTICE_FILE"
+
+
+class PreemptionWatcher:
+    """One flag, two sources: unix signals and a maintenance-notice file.
+
+    Usage in a trainer::
+
+        watcher = PreemptionWatcher.install()
+        state, history = fit(..., preemption=watcher)
+        if watcher.draining:
+            raise SystemExit(EXIT_PREEMPTED)
+
+    ``install()`` must run on the main thread (CPython delivers signals
+    there).  The watcher chains any previously-installed handler so it
+    composes with frameworks that hook SIGTERM themselves.
+    """
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.reason: str = ""
+        self._prev: dict = {}
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+        self._callbacks: list = []
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        """True once a preemption notice arrived; the fit loop checks this
+        at every step boundary."""
+        return self._event.is_set()
+
+    def trigger(self, reason: str = "manual") -> None:
+        """Mark the process as draining (also the test hook)."""
+        if not self._event.is_set():
+            self.reason = reason
+            self._event.set()
+            for cb in self._callbacks:
+                try:
+                    cb(reason)
+                except Exception:
+                    pass
+
+    def on_drain(self, cb: Callable[[str], None]) -> None:
+        """Register a callback fired once when the drain starts (e.g. to
+        stamp the goodput tracker or log)."""
+        self._callbacks.append(cb)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    # -- installation ------------------------------------------------------
+
+    @classmethod
+    def install(cls, signals: Iterable[int] = (signal.SIGTERM,),
+                notice_file: Optional[str] = None,
+                poll_interval: float = 1.0) -> "PreemptionWatcher":
+        """Install handlers and (when a notice file is configured) start
+        the poll thread.  ``notice_file`` defaults to
+        ``$TPUJOB_PREEMPTION_NOTICE_FILE``; no file, no poller."""
+        w = cls()
+        for sig in signals:
+            prev = signal.signal(sig, w._make_handler(sig))
+            w._prev[sig] = prev
+        notice_file = notice_file or os.environ.get(NOTICE_FILE_ENV, "")
+        if notice_file:
+            w.watch_file(notice_file, poll_interval)
+        return w
+
+    def _make_handler(self, sig: int):
+        def handler(signum, frame):
+            self.trigger(f"signal:{signal.Signals(signum).name}")
+            prev = self._prev.get(sig)
+            if callable(prev):
+                prev(signum, frame)
+        return handler
+
+    def watch_file(self, path: str, poll_interval: float = 1.0) -> None:
+        """Poll ``path``; its appearance (or pre-existence) triggers the
+        drain with the file's first line as the reason."""
+
+        def poll() -> None:
+            while not self._poll_stop.is_set():
+                if os.path.exists(path):
+                    reason = "notice-file"
+                    try:
+                        with open(path) as f:
+                            line = f.readline().strip()
+                        if line:
+                            reason = f"notice-file:{line}"
+                    except OSError:
+                        pass
+                    self.trigger(reason)
+                    return
+                self._poll_stop.wait(poll_interval)
+
+        self._poll_thread = threading.Thread(target=poll, daemon=True,
+                                             name="preemption-notice")
+        self._poll_thread.start()
+
+    def uninstall(self) -> None:
+        """Restore previous signal handlers and stop the file poller
+        (test hygiene; production processes exit instead)."""
+        self._poll_stop.set()
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, TypeError):
+                pass  # not on the main thread / handler not restorable
+        self._prev.clear()
+
+
+def inject_preemption(batches, at_step: int, watcher: PreemptionWatcher,
+                      *, signal_self: bool = False):
+    """Test/bench harness shared by bench.py, the dryrun gate, and the
+    drain tests: pass ``batches`` through, raising the preemption flag
+    just before yielding batch index ``at_step`` — so the step consuming
+    that batch is the "in-flight" step the drain must finish.
+    ``signal_self`` delivers a real SIGTERM to this process (the watcher
+    must be installed) instead of flipping the flag directly."""
+    for k, b in enumerate(batches):
+        if k == at_step:
+            if signal_self:
+                os.kill(os.getpid(), signal.SIGTERM)
+            else:
+                watcher.trigger("injected")
+        yield b
+
+
+def drain_checkpoint(checkpoint, state, step: int) -> bool:
+    """The durable-checkpoint half of the drain sequence: force a save at
+    ``step`` and block until it is on storage.  Returns True when a
+    checkpoint manager was active (the exit code should then be
+    ``EXIT_PREEMPTED``; without one the work is simply lost)."""
+    if checkpoint is None or not getattr(checkpoint, "enabled", False):
+        return False
+    if step not in checkpoint.all_steps() and \
+            checkpoint.latest_step() != step:
+        try:
+            checkpoint.save(step, state, force=True)
+        except ValueError:
+            # the loop's interval save of this very step is still in
+            # flight (orbax tracks scheduled steps before they commit);
+            # the wait below makes it durable either way
+            pass
+    checkpoint.wait()
+    return True

@@ -164,6 +164,38 @@ def job_mesh(env: Optional[JobEnv] = None):
     return make_mesh(env.mesh)
 
 
+def run_supervised(argv: List[str]) -> int:
+    """Drain-aware child supervision (``TPUJOB_DRAIN=1``): run the user
+    command as a child process, forward SIGTERM/SIGINT to it, and
+    propagate its exit code — so a trainer that finishes its preemption
+    drain with ``EXIT_PREEMPTED`` (ft/preemption.py) surfaces that exact
+    code as the POD's exit code, which is what the reconciler's
+    budget-free restart path reads (controller/builders.py
+    is_pod_preempted).  A child killed by a signal it did not handle maps
+    to the shell convention 128+N (burns the budget — correctly: it never
+    drained)."""
+    import signal
+    import subprocess
+
+    child = subprocess.Popen(argv)
+
+    def forward(signum, frame):
+        try:
+            child.send_signal(signum)
+        except (ProcessLookupError, OSError):
+            pass
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, forward)
+    try:
+        rc = child.wait()
+    finally:
+        for sig, h in prev.items():
+            signal.signal(sig, h)
+    return 128 - rc if rc < 0 else rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """CLI shim: ``python -m paddle_operator_tpu.launch.launcher -- cmd...``
     enriches the environment (slice-local TPU_WORKER_HOSTNAMES etc.) and
@@ -203,6 +235,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             "mesh": env.mesh.to_dict(), "topology": env.topology,
         }))
         return 0
+    if os.environ.get("TPUJOB_DRAIN", "").lower() in ("1", "true", "yes"):
+        # Supervised mode: as container PID 1 the exec'd trainer would
+        # IGNORE an unhandled SIGTERM (kernel PID-1 semantics) and ride
+        # out the grace period to SIGKILL; the shim stays alive instead,
+        # forwards the signal to a normal-PID child, and propagates its
+        # exit code (EXIT_PREEMPTED included) as the pod's.
+        return run_supervised(argv)
     os.execvp(argv[0], argv)
 
 

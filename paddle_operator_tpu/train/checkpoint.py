@@ -53,13 +53,37 @@ class CheckpointManager:
             return None
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list:
+        """Committed checkpoint steps, ascending (restore fallback walks
+        this backwards when the newest step turns out corrupt)."""
+        if not self._mgr:
+            return []
+        return sorted(self._mgr.all_steps())
+
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
         """Save (async).  Returns True if a save was actually scheduled
-        (the manager applies save_interval_steps unless forced)."""
+        (the manager applies save_interval_steps unless forced).
+
+        On the CPU backend the state is snapshotted to host numpy first:
+        CPU ``jax.Array`` shards are ZERO-COPY views, so an async save
+        racing a training loop that DONATES the state into the next step
+        (trainer.fit does) would read buffers XLA has already reused —
+        silent corruption or a heap abort.  On TPU/GPU the async writer's
+        blocking D2H copy makes the snapshot redundant, and multi-process
+        arrays are not host-gatherable, so both skip it."""
         if not self._mgr:
             return False
         import orbax.checkpoint as ocp
 
+        will_save = force or getattr(self._mgr, "should_save",
+                                     lambda s: True)(step)
+        if will_save and jax.default_backend() == "cpu" \
+                and jax.process_count() == 1:
+            import numpy as np
+
+            state = jax.tree_util.tree_map(
+                lambda x: np.array(x) if isinstance(x, jax.Array) else x,
+                state)
         return self._mgr.save(step, args=ocp.args.StandardSave(state),
                               force=force)
 
@@ -107,16 +131,46 @@ class CheckpointManager:
             self._mgr.wait_until_finished()
 
     def close(self) -> None:
+        """Flush pending async saves, then close.  ``wait()`` first is
+        load-bearing: orbax's close() does not drain the async commit, so
+        an exiting trainer that saved-then-closed would silently drop its
+        newest checkpoint — exactly the step a preemption drain forced."""
         if self._mgr:
+            self._mgr.wait_until_finished()
             self._mgr.close()
 
 
-def resume_or_init(ckpt: CheckpointManager, init_fn, state_like=None):
+def resume_or_init(ckpt: CheckpointManager, init_fn, state_like=None, *,
+                   logger=None):
     """The restart-recovery entry: restore the latest checkpoint if one
     exists, else initialize fresh.  `init_fn()` builds a fresh sharded
     state; `state_like` (defaults to the fresh state) pins structure and
-    shardings for restore."""
+    shardings for restore.
+
+    A corrupt/partial newest step (torn write during the kill that caused
+    this very restart) falls back to the previous complete step with a
+    logged warning instead of failing the whole restart; only when every
+    step fails does the newest step's error surface."""
     if ckpt.enabled and ckpt.latest_step() is not None:
+        if logger is None:
+            # The fallback must never be silent: rolling back to an
+            # older step re-does (or serves stale) work and the operator
+            # needs the trace even from callers that pass no logger.
+            from paddle_operator_tpu.utils.observability import get_logger
+
+            logger = get_logger()
         like = state_like if state_like is not None else init_fn()
-        return ckpt.restore(like), True
+        steps = ckpt.all_steps() or [ckpt.latest_step()]
+        first_err: Optional[Exception] = None
+        for step in reversed(steps):
+            try:
+                return ckpt.restore(like, step=step), True
+            except Exception as err:
+                if first_err is None:
+                    first_err = err
+                logger.warning(
+                    f"checkpoint step {step} failed to restore "
+                    f"({type(err).__name__}: {err}); trying the "
+                    f"previous complete step")
+        raise first_err
     return init_fn(), False

@@ -37,6 +37,50 @@ def synthetic_lm_batches(batch_size: int, seq_len: int, vocab: int,
             0, vocab, (batch_size, seq_len), dtype=np.int32)}
 
 
+def deterministic_lm_batches(global_batch: int, seq_len: int, vocab: int,
+                             *, seed: int = 0, start_step: int = 0
+                             ) -> Iterator[Dict[str, np.ndarray]]:
+    """Elastic-resume data source: the batch for global step *k* is a pure
+    function of ``(seed, k)`` — independent of process count, mesh shape,
+    and iteration history — so a gang resumed on a different dp size
+    replays the exact same global batch sequence.  ``start_step`` is the
+    fast-forward: resuming at step *s* means ``start_step=s`` and the
+    stream continues with step *s*'s batch, no repeated or skipped data
+    (ft/elastic.py computes the offset when the global batch changed).
+
+    Contrast with :func:`synthetic_lm_batches`, whose per-process RNG
+    stream makes replay impossible once the world reshapes."""
+    step = start_step
+    while True:
+        rng = np.random.default_rng(np.random.SeedSequence([seed, step]))
+        yield {"tokens": rng.integers(
+            0, vocab, (global_batch, seq_len), dtype=np.int32)}
+        step += 1
+
+
+def process_slice(batch: Dict[str, np.ndarray],
+                  process_index: Optional[int] = None,
+                  process_count: Optional[int] = None
+                  ) -> Dict[str, np.ndarray]:
+    """This process's row block of a *global* batch (what
+    ``make_array_from_process_local_data`` expects).  Deterministic
+    sources yield global batches so every world shape sees the same data;
+    each process then feeds only its contiguous shard."""
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if pc == 1:
+        return batch
+    out = {}
+    for k, v in batch.items():
+        if v.shape[0] % pc:
+            raise ValueError(
+                f"global batch {v.shape[0]} not divisible by "
+                f"{pc} processes for key {k!r}")
+        per = v.shape[0] // pc
+        out[k] = v[pi * per:(pi + 1) * per]
+    return out
+
+
 class NativeTokenFile:
     """ctypes binding to the native mmap gather (native/dataio.cpp): one C
     call assembles a whole [B, win] int32 batch from a flat token file."""

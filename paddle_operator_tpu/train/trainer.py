@@ -636,7 +636,9 @@ def fit(state: TrainState, step_fn: Callable, batches,
         logger=None,
         log_every: int = 0,
         eval_fn: Optional[Callable] = None,
-        eval_every: int = 0) -> Tuple[TrainState, List[Dict[str, float]]]:
+        eval_every: int = 0,
+        preemption=None,
+        goodput=None) -> Tuple[TrainState, List[Dict[str, float]]]:
     """The reusable training loop: drive `step_fn` over `batches` (any
     iterator of device-ready batch dicts — typically a
     :class:`train.data.DevicePrefetcher`), saving through a
@@ -647,6 +649,14 @@ def fit(state: TrainState, step_fn: Callable, batches,
     (e.g. a :func:`make_eval_step` closure over a held-out batch); its
     float metrics land in that step's history entry under ``eval_*`` keys.
 
+    ``preemption`` (:class:`ft.preemption.PreemptionWatcher`) makes the
+    loop drain-aware: once draining, the in-flight step finishes, a
+    checkpoint is FORCED and made durable (``save(force=True)`` +
+    ``wait()``), and the loop returns early — the caller then exits
+    ``EXIT_PREEMPTED``.  ``goodput``
+    (:class:`ft.goodput.GoodputTracker`) is ticked once per completed
+    step, accruing productive time against wallclock.
+
     Replaces the per-model ad-hoc loops; every BASELINE family (LLaMA,
     ERNIE, Wide&Deep, ResNet) trains through this one function.  Returns
     the final state and the per-step float metrics history.
@@ -655,8 +665,19 @@ def fit(state: TrainState, step_fn: Callable, batches,
     # One sync up front; per-step host conversion would block on every
     # step's completion and defeat async dispatch + prefetch overlap.
     start_step = int(state.step)
+    step_no = start_step
     it = iter(batches)
+    if goodput is not None:
+        # Disarm the step clock: the gap since the tracker's last tick
+        # (init, restore, a previous fit segment's drain) is not
+        # productive, and neither is the FIRST step of this segment —
+        # its wallclock is dominated by batch-fetch + trace/compile, so
+        # the first in-loop tick below only re-arms and accrual starts
+        # from step 2.
+        goodput.pause()
     for i in range(steps):
+        if preemption is not None and preemption.draining:
+            break
         try:
             batch = next(it)
         except StopIteration:
@@ -664,11 +685,15 @@ def fit(state: TrainState, step_fn: Callable, batches,
         state, metrics = step_fn(state, batch)
         if timer is not None:
             timer.tick()
+        if goodput is not None:
+            goodput.tick()
         step_no = start_step + i + 1
         if eval_fn is not None and eval_every and step_no % eval_every == 0:
             metrics = dict(metrics)
             metrics.update({f"eval_{k}": v
                             for k, v in eval_fn(state).items()})
+            if goodput is not None:
+                goodput.pause()   # eval gap is not productive step time
         raw_history.append(metrics)   # device scalars: no host sync
         if checkpoint is not None and checkpoint.enabled:
             checkpoint.save(step_no, state)
@@ -678,6 +703,19 @@ def fit(state: TrainState, step_fn: Callable, batches,
             if timer is not None:
                 msg += " " + timer.report()
             logger.info(msg)
+    if preemption is not None and preemption.draining:
+        # Drain sequence (docs/fault-tolerance.md): the step that was in
+        # flight when the signal landed has completed above; force a
+        # durable checkpoint of it so at most one SAVE INTERVAL — not one
+        # preemption interval — of work is ever lost.
+        from paddle_operator_tpu.ft.preemption import drain_checkpoint
+
+        jax.block_until_ready(jax.tree_util.tree_leaves(state.params))
+        saved = drain_checkpoint(checkpoint, state, step_no)
+        if logger is not None:
+            logger.info(
+                f"preemption drain ({preemption.reason}): step={step_no} "
+                f"checkpoint={'saved' if saved else 'DISABLED'}")
     history = [{k: float(v) for k, v in m.items()} for m in raw_history]
     return state, history
 

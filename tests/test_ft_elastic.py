@@ -1,0 +1,145 @@
+"""Topology-elastic resume: save under one dp width, resume under another,
+and the run must be indistinguishable from never having restarted —
+step-for-step loss parity and exact data continuity.
+
+The mesh-bearing half runs in a fresh interpreter via tests/ft_worker.py
+(device-subset-mesh executables corrupt this jax/XLA:CPU build's heap
+when compiled into a long-lived suite process — rationale in the worker's
+docstring); the continuity math and iterator contracts are in-process.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_operator_tpu.ft.elastic import (
+    elastic_resume,
+    resume_step_for,
+    scale_schedule,
+)
+from paddle_operator_tpu.train import trainer as T
+from paddle_operator_tpu.train.checkpoint import CheckpointManager
+from paddle_operator_tpu.train.data import (
+    deterministic_lm_batches,
+    process_slice,
+)
+from tests.ft_worker import launch
+
+STEPS, SPLIT = 6, 3
+
+
+class TestElasticResumeParity:
+    @pytest.fixture(scope="class")
+    def worker(self):
+        """One fresh-interpreter run: uninterrupted dp=4 baseline, save at
+        step 3, resume at dp=2 AND dp=1."""
+        return launch("elastic")
+
+    @pytest.mark.parametrize("dp_resume", ["2", "1"])
+    def test_save_dp4_resume_smaller(self, worker, dp_resume):
+        res = worker["resumes"][dp_resume]
+        assert res["resumed"]
+        assert res["plan"]["step"] == SPLIT
+        assert res["plan"]["data_start_step"] == SPLIT   # batch unchanged
+        # restored arrays landed on the NEW (smaller) mesh
+        assert res["mesh_devices"] == int(dp_resume)
+        # step-for-step parity with the uninterrupted dp=4 run: only
+        # cross-shard float reduction order may differ
+        np.testing.assert_allclose(
+            worker["losses_a"] + res["losses_b"], worker["baseline"],
+            rtol=2e-4, atol=2e-5)
+
+    def test_trajectories_actually_trained(self, worker):
+        b = worker["baseline"]
+        assert len(b) == STEPS
+        assert b[-1] < b[0]          # loss moved, not a frozen state
+
+
+class TestDataContinuity:
+    def test_data_iterator_no_repeat_no_skip(self):
+        """Fast-forward continuity: batches from start_step=k are exactly
+        batches k.. of the from-scratch stream."""
+        fresh = deterministic_lm_batches(4, 9, 97, seed=3)
+        ahead = deterministic_lm_batches(4, 9, 97, seed=3, start_step=5)
+        skipped = [next(fresh)["tokens"] for _ in range(5)]
+        for _ in range(4):
+            np.testing.assert_array_equal(next(fresh)["tokens"],
+                                          next(ahead)["tokens"])
+        # and steps are genuinely distinct batches (no repetition)
+        resumed_first = deterministic_lm_batches(4, 9, 97, seed=3,
+                                                 start_step=5)
+        assert not np.array_equal(skipped[-1],
+                                  next(resumed_first)["tokens"])
+
+    def test_iterator_independent_of_history(self):
+        """Batch k is a pure function of (seed, k) — no hidden RNG state
+        that a restart would lose."""
+        a = deterministic_lm_batches(2, 5, 31, seed=11, start_step=8)
+        b = deterministic_lm_batches(2, 5, 31, seed=11)
+        for _ in range(8):
+            next(b)
+        np.testing.assert_array_equal(next(a)["tokens"],
+                                      next(b)["tokens"])
+
+
+class TestProcessSlice:
+    def test_single_process_identity(self):
+        batch = {"tokens": np.arange(12).reshape(6, 2)}
+        assert process_slice(batch, 0, 1) is batch
+
+    def test_row_blocks(self):
+        batch = {"tokens": np.arange(12).reshape(6, 2)}
+        np.testing.assert_array_equal(
+            process_slice(batch, 1, 3)["tokens"],
+            batch["tokens"][2:4])
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            process_slice({"x": np.zeros((5, 2))}, 0, 2)
+
+
+class TestContinuityMath:
+    def test_resume_step_floor_rereads_partial_batch(self):
+        assert resume_step_for(1000, 100) == 10
+        assert resume_step_for(1050, 100) == 10   # re-read, never skip
+        with pytest.raises(ValueError):
+            resume_step_for(10, 0)
+
+    def test_scale_schedule_token_equivalent(self):
+        base = lambda count: 0.1 * count          # linear ramp per step
+        # halved global batch: position advances half as fast, LR halves
+        sched = scale_schedule(base, ref_global_batch=512,
+                               global_batch=256)
+        assert sched(10) == pytest.approx(0.1 * 5 * 0.5)
+        # unscaled variant keeps LR, remaps position only
+        sched2 = scale_schedule(base, 512, 256, scale_lr=False)
+        assert sched2(10) == pytest.approx(0.1 * 5)
+        # equal batches: identity (the common elastic case — global batch
+        # preserved, per-replica batch grows as dp shrinks)
+        assert scale_schedule(base, 512, 512) is base
+
+    def test_elastic_resume_fresh_when_no_checkpoint(self):
+        state, resumed, plan = elastic_resume(
+            CheckpointManager(""), lambda: {"w": jnp.zeros(2)},
+            saved_global_batch=64, global_batch=32)
+        assert not resumed
+        assert plan == {"step": 0, "tokens_consumed": 0,
+                        "data_start_step": 0}
+
+    def test_resume_plan_batch_change(self, tmp_path):
+        """Global batch halved on resume: the iterator offset doubles so
+        step × batch (tokens) is preserved."""
+        path = str(tmp_path / "ck")
+        ckpt = CheckpointManager(path, save_interval_steps=1)
+        st = T.TrainState(step=jnp.asarray(6, jnp.int32),
+                          params={"w": jnp.zeros(2)},
+                          opt_state={"n": jnp.zeros(())})
+        ckpt.save(6, st, force=True)
+        ckpt.wait(); ckpt.close()
+        state, resumed, plan = elastic_resume(
+            CheckpointManager(path), lambda: st, st,
+            saved_global_batch=64, global_batch=32)
+        assert resumed
+        assert plan["tokens_consumed"] == 6 * 64
+        assert plan["data_start_step"] == 12

@@ -1,0 +1,169 @@
+"""Goodput accounting: tracker ledger arithmetic (fake clock), the
+status/condition plumbing through the reconciler, and the manager's
+/metrics export — the scrapeable face of the subsystem."""
+
+import socket
+import urllib.request
+
+from paddle_operator_tpu.api import ResourceSpec, TPUJob, TPUJobSpec
+from paddle_operator_tpu.controller.fake_api import FakeAPI, FakeFleet
+from paddle_operator_tpu.controller.manager import Manager, Metrics, _serve
+from paddle_operator_tpu.controller.reconciler import (
+    KIND_JOB,
+    TPUJobReconciler,
+    run_to_settled,
+)
+from paddle_operator_tpu.ft.goodput import (
+    GoodputTracker,
+    goodput_condition,
+    goodput_gauges,
+)
+
+NS = "default"
+TMPL = {"spec": {"containers": [{"name": "m", "image": "jax:latest"}]}}
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestTrackerLedger:
+    def test_productive_vs_badput_sums_to_wallclock(self):
+        clk = FakeClock()
+        tr = GoodputTracker(clock=clk)
+        with tr.phase("init"):
+            clk.advance(10)
+        tr.tick()                      # arm
+        for _ in range(4):
+            clk.advance(2)
+            tr.tick()                  # 4 steps x 2s productive
+        clk.advance(3)                 # unattributed tail
+        assert tr.productive_seconds == 8
+        assert tr.steps == 4
+        bp = tr.badput()
+        assert bp["init"] == 10
+        assert bp["other"] == 3
+        assert tr.wallclock_seconds == 21
+        assert abs(tr.goodput_ratio - 8 / 21) < 1e-9
+        assert tr.productive_seconds + sum(bp.values()) == \
+            tr.wallclock_seconds
+
+    def test_restore_phase_and_lost_work(self):
+        clk = FakeClock()
+        tr = GoodputTracker(clock=clk)
+        with tr.phase("restore"):
+            clk.advance(5)
+        tr.record_lost_steps(3, 2.0)
+        bp = tr.badput()
+        assert bp["restore"] == 5
+        assert bp["lost_work"] == 6.0
+
+    def test_pause_disarms_step_clock(self):
+        clk = FakeClock()
+        tr = GoodputTracker(clock=clk)
+        tr.tick()
+        clk.advance(2); tr.tick()
+        tr.pause()
+        clk.advance(50)                # eval gap: not productive
+        tr.tick()                      # re-arm
+        clk.advance(2); tr.tick()
+        assert tr.productive_seconds == 4
+
+    def test_to_status_shape(self):
+        clk = FakeClock()
+        tr = GoodputTracker(clock=clk)
+        tr.tick(); clk.advance(1); tr.tick()
+        st = tr.to_status()
+        assert set(st) == {"ratio", "productiveSeconds",
+                           "wallclockSeconds", "steps", "badput"}
+        assert st["steps"] == 1
+        assert set(st["badput"]) >= {"init", "restore", "lost_work",
+                                     "other"}
+
+    def test_gauges_naming(self):
+        g = goodput_gauges({"ratio": 0.9, "productiveSeconds": 9,
+                            "wallclockSeconds": 10,
+                            "badput": {"init": 1}}, "default/j")
+        assert g['tpujob_goodput_ratio{job="default/j"}'] == 0.9
+        assert g['tpujob_badput_seconds{job="default/j",kind="init"}'] == 1
+
+
+class TestStatusPlumbing:
+    def _running_job_with_goodput(self, api, rec, fleet, goodput):
+        job = TPUJob(name="gj", namespace=NS, spec=TPUJobSpec(
+            worker=ResourceSpec(replicas=2, template=TMPL)))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "gj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "gj")
+        # workload publishes its tracker snapshot into the status
+        raw = api.get(KIND_JOB, NS, "gj")
+        raw["status"]["goodput"] = goodput
+        api.update_status(KIND_JOB, raw)
+
+    def test_reconciler_preserves_goodput_and_sets_condition(self):
+        api, rec, fleet = FakeAPI(), None, None
+        rec = TPUJobReconciler(api)
+        fleet = FakeFleet(api, NS)
+        self._running_job_with_goodput(
+            api, rec, fleet,
+            {"ratio": 0.87, "productiveSeconds": 87.0,
+             "wallclockSeconds": 100.0, "steps": 10,
+             "badput": {"init": 8.0, "restore": 3.0, "lost_work": 0.0,
+                        "other": 2.0}})
+        run_to_settled(rec, NS, "gj")     # status sync must NOT wipe it
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "gj"))
+        assert got.status.goodput["ratio"] == 0.87
+        conds = {c["type"]: c for c in got.status.conditions}
+        assert conds["Goodput"]["status"] == "True"
+        assert "87" in conds["Goodput"]["message"]
+
+    def test_manager_serves_goodput_on_metrics_endpoint(self):
+        """Acceptance: tpujob_goodput_ratio is scrapeable from the
+        manager's /metrics."""
+        api = FakeAPI()
+        rec_api = api
+        mgr = Manager(rec_api, namespace=NS)
+        fleet = FakeFleet(api, NS)
+        self._running_job_with_goodput(
+            api, mgr.reconciler, fleet,
+            {"ratio": 0.91, "productiveSeconds": 91.0,
+             "wallclockSeconds": 100.0, "steps": 12,
+             "badput": {"init": 5.0, "restore": 2.0, "lost_work": 1.0,
+                        "other": 1.0}})
+        mgr.run_once()
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        _serve(("127.0.0.1", port), mgr.metrics, lambda: True)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            body = r.read().decode()
+        assert 'tpujob_goodput_ratio{job="default/gj"} 0.91' in body
+        assert 'tpujob_badput_seconds{job="default/gj",kind="restore"} ' \
+               '2.0' in body
+        assert 'tpujob_goodput_wallclock_seconds{job="default/gj"} ' \
+               '100.0' in body
+
+    def test_condition_transition_time_stable(self):
+        st = goodput_condition({"ratio": 0.8}, "t1")
+        from paddle_operator_tpu.api.types import TPUJobStatus
+
+        status = TPUJobStatus()
+        status.set_condition(st)
+        status.set_condition(goodput_condition({"ratio": 0.82}, "t2"))
+        (c,) = status.conditions
+        assert c["lastTransitionTime"] == "t1"    # status unchanged
+        status.set_condition(goodput_condition({"ratio": 0.2}, "t3"))
+        (c,) = status.conditions
+        assert c["status"] == "False"
+        assert c["lastTransitionTime"] == "t3"    # real transition

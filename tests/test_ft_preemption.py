@@ -1,0 +1,250 @@
+"""Preemption drain unit + integration: the watcher, the drain-aware fit
+loop, the launcher's supervised exit-code propagation, and the checkpoint
+satellites (flush-on-close, corrupt-step fallback)."""
+
+import glob
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_operator_tpu.api.types import EXIT_PREEMPTED as API_EXIT_PREEMPTED
+from paddle_operator_tpu.api.types import MeshSpec
+from paddle_operator_tpu.ft.preemption import (
+    EXIT_PREEMPTED,
+    PreemptionWatcher,
+    drain_checkpoint,
+    inject_preemption,
+)
+from paddle_operator_tpu.launch.launcher import run_supervised
+from paddle_operator_tpu.models import llama as L
+from paddle_operator_tpu.parallel.mesh import make_mesh
+from paddle_operator_tpu.train import trainer as T
+from paddle_operator_tpu.train.checkpoint import CheckpointManager, resume_or_init
+from paddle_operator_tpu.train.data import deterministic_lm_batches
+
+
+def test_exit_code_contract_pinned():
+    """ft (workload) and api.types (controller) each define the code so
+    neither layer imports the other; they must never drift."""
+    assert EXIT_PREEMPTED == API_EXIT_PREEMPTED == 83
+
+
+class TestWatcher:
+    def test_trigger_and_callbacks(self):
+        w = PreemptionWatcher()
+        seen = []
+        w.on_drain(seen.append)
+        assert not w.draining
+        w.trigger("test")
+        assert w.draining and w.reason == "test"
+        w.trigger("second")            # first reason sticks
+        assert w.reason == "test"
+        assert seen == ["test"]
+
+    def test_sigterm_sets_draining(self):
+        w = PreemptionWatcher.install(signals=(signal.SIGTERM,))
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert w.wait(timeout=5)
+            assert w.reason == "signal:SIGTERM"
+        finally:
+            w.uninstall()
+
+    def test_chains_previous_handler(self):
+        hits = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+        try:
+            w = PreemptionWatcher.install(signals=(signal.SIGTERM,))
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert w.wait(timeout=5)
+            assert hits == [signal.SIGTERM]
+            w.uninstall()
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_notice_file_triggers(self, tmp_path):
+        notice = tmp_path / "maintenance"
+        w = PreemptionWatcher()
+        w.watch_file(str(notice), poll_interval=0.02)
+        assert not w.draining
+        notice.write_text("maintenance-event: TERMINATE_ON_HOST\n")
+        assert w.wait(timeout=5)
+        assert w.reason == "notice-file:maintenance-event: TERMINATE_ON_HOST"
+        w.uninstall()
+
+
+class _StubMgr:
+    """Slow-async-save fake orbax manager: the save is only durable after
+    wait_until_finished(); close() before that drops it."""
+
+    def __init__(self):
+        self.calls = []
+        self.pending = False
+
+    def save(self, *a, **k):
+        self.pending = True
+        self.calls.append("save")
+        return True
+
+    def wait_until_finished(self):
+        self.pending = False
+        self.calls.append("wait")
+
+    def close(self):
+        self.calls.append("close")
+        assert not self.pending, \
+            "close() with a pending async save: checkpoint dropped"
+
+    def latest_step(self):
+        return None
+
+    def all_steps(self):
+        return []
+
+
+class TestCheckpointSatellites:
+    def test_close_flushes_pending_async_save(self):
+        """Satellite 1: an exiting trainer's save-then-close must not drop
+        the newest checkpoint."""
+        ckpt = CheckpointManager("")
+        ckpt._mgr = _StubMgr()
+        ckpt.save(1, {"w": 0}, force=True)
+        ckpt.close()                       # stub asserts wait ran first
+        assert ckpt._mgr.calls == ["save", "wait", "close"]
+
+    def test_resume_falls_back_over_corrupt_newest(self, tmp_path):
+        """Satellite 2: a torn newest step (the kill that caused this very
+        restart) resumes from the previous complete step, not a crash."""
+        path = str(tmp_path / "ck")
+        state = {"w": jnp.arange(4, dtype=jnp.float32)}
+        ckpt = CheckpointManager(path, save_interval_steps=1)
+        ckpt.save(1, {"w": jnp.arange(4, dtype=jnp.float32)}, force=True)
+        ckpt.save(2, {"w": jnp.arange(4, dtype=jnp.float32) * 2},
+                  force=True)
+        ckpt.wait()
+        assert ckpt.all_steps() == [1, 2]
+        # corrupt step 2 in place: truncate every file under it
+        for f in glob.glob(os.path.join(path, "2", "**"), recursive=True):
+            if os.path.isfile(f):
+                with open(f, "w") as fh:
+                    fh.truncate(0)
+        ckpt2 = CheckpointManager(path)
+        restored, resumed = resume_or_init(ckpt2, lambda: state, state)
+        assert resumed
+        np.testing.assert_array_equal(
+            np.asarray(restored["w"]), np.arange(4, dtype=np.float32))
+        ckpt.close(); ckpt2.close()
+
+    def test_resume_raises_when_every_step_corrupt(self, tmp_path):
+        path = str(tmp_path / "ck")
+        state = {"w": jnp.zeros(2)}
+        ckpt = CheckpointManager(path, save_interval_steps=1)
+        ckpt.save(1, state, force=True)
+        ckpt.wait()
+        for f in glob.glob(os.path.join(path, "1", "**"), recursive=True):
+            if os.path.isfile(f):
+                with open(f, "w") as fh:
+                    fh.truncate(0)
+        with pytest.raises(Exception):
+            resume_or_init(CheckpointManager(path), lambda: state, state)
+        ckpt.close()
+
+
+class TestDrainInFit:
+    def test_sigterm_mid_run_forces_durable_checkpoint(self, tmp_path):
+        """The drain sequence end to end inside fit(): signal lands
+        mid-iteration → the in-flight step completes → a checkpoint is
+        FORCED (save interval ignored) and durable → loop exits early."""
+        model, cfg = L.make_model("tiny")
+        mesh = make_mesh(MeshSpec(dp=8))
+        opt = T.make_optimizer(1e-3, warmup_steps=1, decay_steps=50)
+        pats = L.partition_patterns(cfg)
+        ex = (jnp.zeros((8, 16), jnp.int32),)
+        sh, _ = T.state_shardings(model, opt, mesh, pats, ex)
+        state = T.create_state(model, opt, mesh, pats, ex)
+        step = T.make_train_step(model, opt, mesh, sh)
+        # interval larger than the run: only the drain can produce a save
+        ckpt = CheckpointManager(str(tmp_path / "ck"),
+                                 save_interval_steps=1000)
+        watcher = PreemptionWatcher.install(signals=(signal.SIGTERM,))
+        # SIGTERM arrives while step 4 is in flight
+        batches = inject_preemption(
+            deterministic_lm_batches(8, 17, cfg.vocab_size), 3, watcher,
+            signal_self=True)
+        try:
+            state, hist = T.fit(state, step, batches, steps=50,
+                                checkpoint=ckpt, preemption=watcher)
+        finally:
+            watcher.uninstall()
+        assert watcher.draining
+        # in-flight step finished, nothing after it ran
+        assert int(state.step) == 4
+        assert len(hist) == 4
+        # the forced save is already durable
+        assert ckpt.latest_step() == 4
+        ckpt.close()
+
+    def test_drain_checkpoint_disabled_manager(self):
+        assert drain_checkpoint(None, {}, 1) is False
+        assert drain_checkpoint(CheckpointManager(""), {}, 1) is False
+
+
+class TestSupervisedLauncher:
+    def test_child_exit_code_propagates(self):
+        rc = run_supervised([sys.executable, "-c",
+                             f"import sys; sys.exit({EXIT_PREEMPTED})"])
+        assert rc == EXIT_PREEMPTED
+
+    def test_sigterm_forwarded_to_child(self, tmp_path):
+        """Parent (the shim) gets SIGTERM; the child's own handler runs
+        its drain and exits EXIT_PREEMPTED, which the shim returns."""
+        ready = tmp_path / "ready"
+        child_src = (
+            "import signal, sys, time, pathlib\n"
+            f"signal.signal(signal.SIGTERM, lambda *a: sys.exit({EXIT_PREEMPTED}))\n"
+            f"pathlib.Path({str(ready)!r}).write_text('up')\n"
+            "time.sleep(30)\n"
+        )
+
+        def kill_when_ready():
+            deadline = time.monotonic() + 20
+            while not ready.exists() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.05)   # let the child reach sleep()
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        t = threading.Thread(target=kill_when_ready, daemon=True)
+        t.start()
+        rc = run_supervised([sys.executable, "-c", child_src])
+        t.join(timeout=5)
+        assert rc == EXIT_PREEMPTED
+
+    def test_unhandled_signal_maps_to_128_plus_n(self, tmp_path):
+        """A child that never drained reports 128+15 — a budget-burning
+        failure, correctly distinct from EXIT_PREEMPTED."""
+        ready = tmp_path / "ready"
+        child_src = (
+            "import time, pathlib\n"
+            f"pathlib.Path({str(ready)!r}).write_text('up')\n"
+            "time.sleep(30)\n"
+        )
+
+        def kill_when_ready():
+            deadline = time.monotonic() + 20
+            while not ready.exists() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        t = threading.Thread(target=kill_when_ready, daemon=True)
+        t.start()
+        rc = run_supervised([sys.executable, "-c", child_src])
+        t.join(timeout=5)
+        assert rc == 128 + signal.SIGTERM
