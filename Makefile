@@ -43,6 +43,12 @@ helm: gen-deploy
 bench:
 	$(PY) bench.py
 
+# CPU dry-run gate: entry forward + the 8-virtual-device multichip run
+# (all training parallelism axes, plus the serving parity lines:
+# serve-decode, serve-ring, serve-spec, ft-drain)
+dryrun:
+	$(PY) __graft_entry__.py
+
 docker-build:
 	docker build -t $(IMG) .
 
@@ -50,4 +56,4 @@ clean:
 	$(MAKE) -C native clean
 	rm -rf .pytest_cache
 
-.PHONY: all native test tier1 run gen-deploy install deploy helm bench docker-build clean
+.PHONY: all native test tier1 run gen-deploy install deploy helm bench dryrun docker-build clean

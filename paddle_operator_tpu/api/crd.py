@@ -286,6 +286,12 @@ def _status_schema() -> Dict[str, Any]:
                 "type": "object",
                 "x-kubernetes-preserve-unknown-fields": True,
             },
+            # serving telemetry block (infer/batcher.py serving_status)
+            # — exported as tpujob_serve_* manager gauges
+            "serving": {
+                "type": "object",
+                "x-kubernetes-preserve-unknown-fields": True,
+            },
             "conditions": {
                 "type": "array",
                 "items": {

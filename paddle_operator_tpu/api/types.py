@@ -406,6 +406,11 @@ class TPUJobStatus:
     # badput breakdown.  The manager exports it as tpujob_goodput_*
     # gauges on /metrics.
     goodput: Dict[str, Any] = field(default_factory=dict)
+    # Workload-published serving telemetry (infer/batcher.py
+    # ContinuousBatcher.serving_status): served tokens/sec, speculative
+    # acceptance rate, request-queue depth.  The manager exports it as
+    # tpujob_serve_* gauges on /metrics.
+    serving: Dict[str, Any] = field(default_factory=dict)
     # k8s-style status conditions; the reconciler maintains a "Goodput"
     # condition from the published block.
     conditions: List[Dict[str, Any]] = field(default_factory=list)
@@ -454,6 +459,8 @@ class TPUJobStatus:
             d["restartingReason"] = self.restarting_reason
         if self.goodput:
             d["goodput"] = self.goodput
+        if self.serving:
+            d["serving"] = self.serving
         if self.conditions:
             d["conditions"] = self.conditions
         return d
@@ -475,6 +482,7 @@ class TPUJobStatus:
             preempted_count=d.get("preemptedCount", 0),
             restarting_reason=d.get("restartingReason", ""),
             goodput=d.get("goodput", {}) or {},
+            serving=d.get("serving", {}) or {},
             conditions=d.get("conditions", []) or [],
         )
 

@@ -284,22 +284,29 @@ class Manager:
         return n
 
     def _export_goodput(self, jobs) -> None:
-        """Mirror each job's workload-published ``status.goodput`` block
-        (ft/goodput.py) into per-job ``tpujob_goodput_*`` /
-        ``tpujob_badput_seconds`` gauges on ``/metrics`` — the scrapeable
-        face of the goodput accounting.  Gauges of deleted jobs (and
+        """Mirror each job's workload-published telemetry blocks into
+        per-job gauges on ``/metrics``: ``status.goodput``
+        (ft/goodput.py -> ``tpujob_goodput_*``/``tpujob_badput_seconds``)
+        and ``status.serving`` (infer/batcher.py serving_status ->
+        ``tpujob_serve_tokens_per_sec``/``tpujob_serve_accept_rate``/
+        ``tpujob_serve_queue_depth``).  Gauges of deleted jobs (and
         gauge names a job stopped publishing) are pruned, so /metrics
         never serves stale readings and the registry stays bounded."""
         from paddle_operator_tpu.ft.goodput import goodput_gauges
+        from paddle_operator_tpu.utils.observability import serving_gauges
 
         exported: Dict[str, Set[str]] = {}
         for j in jobs:
-            gp = (j.get("status") or {}).get("goodput")
-            if not gp:
-                continue
+            st = j.get("status") or {}
+            gauges: Dict[str, float] = {}
             ns = j["metadata"].get("namespace", self.namespace)
             key = f'{ns}/{j["metadata"]["name"]}'
-            gauges = goodput_gauges(gp, key)
+            if st.get("goodput"):
+                gauges.update(goodput_gauges(st["goodput"], key))
+            if st.get("serving"):
+                gauges.update(serving_gauges(st["serving"], key))
+            if not gauges:
+                continue
             for name, val in gauges.items():
                 self.metrics.set(name, val)
             exported[key] = set(gauges)

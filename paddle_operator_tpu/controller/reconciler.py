@@ -361,10 +361,11 @@ class TPUJobReconciler:
             restart_count=job.status.restart_count,
             preempted_count=job.status.preempted_count,
             observed_generation=job.generation,
-            # Workload-published goodput and the condition list ride along
-            # rather than being recomputed — the status sync owns pod
-            # counters, not trainer telemetry.
+            # Workload-published goodput/serving telemetry and the
+            # condition list ride along rather than being recomputed —
+            # the status sync owns pod counters, not workload telemetry.
             goodput=job.status.goodput,
+            serving=job.status.serving,
             conditions=[dict(c) for c in job.status.conditions],
         )
 

@@ -4,4 +4,5 @@ from paddle_operator_tpu.infer.decode import (  # noqa: F401
     init_cache,
     make_decode_fn,
     prefill,
+    speculative_generate,
 )

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -295,6 +296,25 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     return jax.jit(step, donate_argnums=(1,))
 
 
+def _splice_lane(ring: Dict[str, jax.Array], lane: Dict[str, jax.Array],
+                 slot, prompt_len) -> Dict[str, jax.Array]:
+    """Zero ring lane ``slot`` and splice a freshly prefilled
+    batch-of-one lane cache into it, setting the lane's fill position
+    to ``prompt_len`` — the device half of admission, shared by the
+    plain and speculative inserts so their splice semantics cannot
+    drift."""
+    k = jnp.zeros_like(ring["k"][:, 0])
+    k = jax.lax.dynamic_update_slice(k, lane["k"][:, 0], (0, 0, 0, 0))
+    v = jnp.zeros_like(ring["v"][:, 0])
+    v = jax.lax.dynamic_update_slice(v, lane["v"][:, 0], (0, 0, 0, 0))
+    new_k = jax.lax.dynamic_update_slice(
+        ring["k"], k[:, None], (0, slot, 0, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(
+        ring["v"], v[:, None], (0, slot, 0, 0, 0))
+    return {"k": new_k, "v": new_v,
+            "pos": ring["pos"].at[slot].set(prompt_len)}
+
+
 def make_prefill_insert(cfg: LlamaConfig, bucket: int,
                         top_k: Optional[int] = None,
                         top_p: Optional[float] = None, mesh=None):
@@ -326,15 +346,7 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int,
         lane = D.init_cache(cfg, 1, bucket)
         logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
         logits = logits[0, prompt_len - 1]                  # last real row
-        k = jnp.zeros_like(cache["k"][:, 0])
-        k = jax.lax.dynamic_update_slice(k, lane["k"][:, 0], (0, 0, 0, 0))
-        v = jnp.zeros_like(cache["v"][:, 0])
-        v = jax.lax.dynamic_update_slice(v, lane["v"][:, 0], (0, 0, 0, 0))
-        new_k = jax.lax.dynamic_update_slice(
-            cache["k"], k[:, None], (0, slot, 0, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(
-            cache["v"], v[:, None], (0, slot, 0, 0, 0))
-        pos = cache["pos"].at[slot].set(prompt_len)
+        new_cache = _splice_lane(cache, lane, slot, prompt_len)
         # first token through the SHARED sampling rule (_sample_tokens),
         # batch-of-one shaped
         key = jax.random.PRNGKey(seed)
@@ -342,13 +354,51 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int,
             logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
             key[None], jnp.reshape(prompt_len - 1, (1,)),
             top_k, top_p)[0]
-        return ({"k": new_k, "v": new_v, "pos": pos},
+        return (new_cache,
                 tok.at[slot].set(first),
                 temp.at[slot].set(temp_val),
                 keys.at[slot].set(key),
                 first)
 
     return jax.jit(insert, donate_argnums=(1, 2, 3, 4))
+
+
+def make_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
+                             bucket: int, top_k: Optional[int] = None,
+                             top_p: Optional[float] = None, mesh=None):
+    """Admission for the SPECULATIVE ring: one compiled dispatch that
+    prefills the prompt into BOTH the target and the draft lane (the
+    draft's logits are discarded — it only needs the KV context to
+    propose from) and samples the first token from the target, with the
+    same exactness-with-padding story as :func:`make_prefill_insert`.
+
+    ``insert(params, dparams, cache, dcache, tok, temp, keys,
+    prompt [1,bucket], prompt_len, slot, temp_val, seed)
+    -> (cache', dcache', tok', temp', keys', first_token)``
+    """
+
+    def insert(params, dparams, cache, dcache, tok, temp, keys, prompt,
+               prompt_len, slot, temp_val, seed):
+        lane = D.init_cache(cfg, 1, bucket)
+        logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
+        logits = logits[0, prompt_len - 1]
+        new_cache = _splice_lane(cache, lane, slot, prompt_len)
+        dlane = D.init_cache(dcfg, 1, bucket)
+        _, dlane = D._forward(dcfg, dparams, prompt, dlane,
+                              last_only=True, mesh=mesh)
+        new_dcache = _splice_lane(dcache, dlane, slot, prompt_len)
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache, new_dcache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(2, 3, 4, 5, 6))
 
 
 # ---------------------------------------------------------------------------
@@ -369,10 +419,17 @@ def _fold_seed(seed: int) -> int:
     return x & 0x7FFFFFFF
 
 
+class QueueFull(RuntimeError):
+    """submit() backpressure signal: the bounded request queue stayed
+    full past the put timeout.  A RuntimeError subclass so serve.py's
+    generic 503 mapping already handles it (retry/fail-over, not a
+    client error) while callers that care can catch it specifically."""
+
+
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
                  "done", "out", "error", "_stream", "_cancel",
-                 "dev_prompt", "bucket")
+                 "dev_prompt", "bucket", "accepted", "drafted")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
                  wants_stream=False):
@@ -385,6 +442,11 @@ class _Request:
         self.out: Optional[List[int]] = None
         self.error: Optional[Exception] = None
         self._cancel = False
+        # speculative-decoding telemetry (spec_k > 0 rings): drafts
+        # offered / accepted for THIS request — serve.py surfaces the
+        # rate per response
+        self.accepted = 0
+        self.drafted = 0
         # padded prompt, transferred to device on the SUBMIT thread
         # (batcher.submit): on relayed chips a host->device copy costs a
         # full round-trip, and paying it on the decode-ring thread
@@ -403,6 +465,15 @@ class _Request:
         if self.error is not None:
             raise self.error
         return self.out
+
+    @property
+    def accept_rate(self) -> Optional[float]:
+        """Speculative acceptance rate for this request (accepted
+        drafts / offered drafts), or None when the ring is not
+        speculative (or no round has consumed yet)."""
+        if not self.drafted:
+            return None
+        return round(self.accepted / self.drafted, 4)
 
     def cancel(self) -> None:
         """Stop decoding this request: the ring evicts its lane at the
@@ -449,7 +520,12 @@ class ContinuousBatcher:
                  prefill_buckets: Tuple[int, ...] = (),
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None,
-                 pipeline_depth: int = 2, mesh=None) -> None:
+                 pipeline_depth: int = 2, mesh=None,
+                 draft_params: Any = None,
+                 draft_cfg: Optional[LlamaConfig] = None,
+                 spec_k: int = 0,
+                 max_queue: int = 0,
+                 queue_timeout: float = 5.0) -> None:
         # ``mesh`` (parallel/mesh.py make_serving_mesh): serve
         # tensor-parallel — params are laid out over tp once here, the
         # ring cache shards over the kv-head axis, and the resident
@@ -475,11 +551,48 @@ class ContinuousBatcher:
         self.buckets = tuple(sorted(prefill_buckets)) or _default_buckets(
             self.max_len)
         self._top_k, self._top_p = top_k, top_p
-        self._step = make_chunk_step(cfg, chunk_tokens, top_k, top_p,
-                                     mesh=mesh)
-        self._inserts = {b: make_prefill_insert(cfg, b, top_k, top_p,
-                                                mesh=mesh)
-                         for b in self.buckets}
+        # speculative mode (spec_k > 0): the resident step becomes ONE
+        # draft-propose + chunked-verify round (infer/speculative.py) —
+        # per round every active lane advances by its OWN accept length
+        # (1..spec_k+1 tokens), landing in the per-lane pos vector, so
+        # divergent accepts cost no extra compiles.  A second ring cache
+        # holds the draft's KV, admitted/rewound in lockstep.
+        self.spec_k = int(spec_k)
+        self.draft_cfg = draft_cfg
+        if self.spec_k > 0:
+            from paddle_operator_tpu.infer.speculative import (
+                check_draft_compat,
+                make_spec_round_fn,
+            )
+
+            if draft_params is None or draft_cfg is None:
+                raise ValueError("spec_k > 0 requires draft_params and "
+                                 "draft_cfg (see LlamaConfig.draft())")
+            check_draft_compat(cfg, draft_cfg)
+            if self.max_len > draft_cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len ({draft_cfg.max_seq_len}) < ring "
+                    f"max_len ({self.max_len}); derive the draft with "
+                    "cfg.draft() to inherit the target's RoPE table")
+            if mesh is not None and D.mesh_tp(mesh) > 1:
+                draft_params = D.shard_params_for_serving(
+                    draft_params, draft_cfg, mesh)
+            self.draft_params = draft_params
+            self._spec_step = make_spec_round_fn(
+                cfg, draft_cfg, self.spec_k, top_k, top_p, mesh=mesh)
+            self._inserts = {b: make_spec_prefill_insert(
+                cfg, draft_cfg, b, top_k, top_p, mesh=mesh)
+                for b in self.buckets}
+            self.dcache = init_ring_cache(draft_cfg, slots, self.max_len,
+                                          mesh=mesh)
+        else:
+            self.draft_params = None
+            self.dcache = None
+            self._step = make_chunk_step(cfg, chunk_tokens, top_k, top_p,
+                                         mesh=mesh)
+            self._inserts = {b: make_prefill_insert(cfg, b, top_k, top_p,
+                                                    mesh=mesh)
+                             for b in self.buckets}
 
         self.cache = init_ring_cache(cfg, slots, self.max_len, mesh=mesh)
         self.tok = jnp.zeros((slots,), jnp.int32)
@@ -492,11 +605,22 @@ class ContinuousBatcher:
         # materialized at the next chunk consume (async admission)
         self._lane_first: List[Optional[jax.Array]] = [None] * slots
 
-        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        # bounded admission queue (max_queue > 0): submit() blocks up to
+        # queue_timeout for a slot, then REJECTS (QueueFull) — saturation
+        # degrades into backpressure instead of unbounded request RAM
+        self.max_queue = int(max_queue)
+        self._queue_timeout = queue_timeout
+        self._pending: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=self.max_queue)
         self._wake = threading.Event()
         self._stop = threading.Event()
         self.stats = {"admitted": 0, "evicted": 0, "chunks": 0,
-                      "max_active": 0}
+                      "max_active": 0, "rejected_queue_full": 0,
+                      "spec_accepted": 0, "spec_drafted": 0}
+        # served-token telemetry for serving_status(): cumulative emitted
+        # tokens since construction (the /metrics tokens-per-sec gauge)
+        self._tokens_emitted = 0
+        self._t_start = time.monotonic()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="decode-ring")
         self._thread.start()
@@ -529,21 +653,47 @@ class ContinuousBatcher:
             raise ValueError(
                 f"prompt length {len(prompt)} exceeds the largest prefill "
                 f"bucket ({self.buckets[-1]})")
-        # the FIRST token is sampled from the prefill logits, so only
-        # max_new-1 tokens ride chunk steps; the worst-case cache position
-        # is prompt + ceil((max_new-1)/chunk)*chunk (validating with
-        # ceil(max_new/chunk) rejected requests up to chunk-1 tokens
-        # INSIDE capacity)
-        budget = -(-(max_new_tokens - 1) // self.chunk) * self.chunk
-        if len(prompt) + budget > self.max_len:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + chunk-rounded budget ({budget}) "
-                f"exceeds max_len ({self.max_len})")
+        if self.spec_k:
+            # a verify round starting at the last in-budget position
+            # (prompt + max_new - 2) writes rows through pos + spec_k,
+            # so spec_k - 1 positions of headroom must exist past
+            # prompt + max_new (infer/speculative.py has the derivation)
+            if len(prompt) + max_new_tokens + self.spec_k - 1 > self.max_len:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + max_new_tokens "
+                    f"({max_new_tokens}) + speculative headroom "
+                    f"({self.spec_k - 1}) exceeds max_len ({self.max_len})")
+        else:
+            # the FIRST token is sampled from the prefill logits, so only
+            # max_new-1 tokens ride chunk steps; the worst-case cache
+            # position is prompt + ceil((max_new-1)/chunk)*chunk
+            # (validating with ceil(max_new/chunk) rejected requests up
+            # to chunk-1 tokens INSIDE capacity)
+            budget = -(-(max_new_tokens - 1) // self.chunk) * self.chunk
+            if len(prompt) + budget > self.max_len:
+                raise ValueError(
+                    f"prompt ({len(prompt)}) + chunk-rounded budget "
+                    f"({budget}) exceeds max_len ({self.max_len})")
         # int32-range seeds pass through untouched; wide/negative seeds
         # hash-fold (see docstring)
         seed = int(seed)
         if not 0 <= seed < 0x80000000:
             seed = _fold_seed(seed)
+        if self.max_queue and self._pending.full():
+            # shed BEFORE the host->device prompt transfer below: the
+            # rejection path is the overload path, and a full round-trip
+            # device copy per shed request (relayed chips) would spend
+            # exactly the bandwidth backpressure exists to protect.
+            # Non-authoritative (racy) — the timed put below enforces
+            # the bound; this only waits for space to appear first.
+            deadline = time.monotonic() + self._queue_timeout
+            while self._pending.full():
+                if time.monotonic() >= deadline:
+                    self.stats["rejected_queue_full"] += 1
+                    raise QueueFull(
+                        f"request queue full (max_queue={self.max_queue},"
+                        f" waited {self._queue_timeout}s)")
+                time.sleep(0.005)
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        eos_token, wants_stream=stream)
         # pad + ship the prompt to the device HERE, on the caller's
@@ -552,7 +702,17 @@ class ContinuousBatcher:
         padded = np.zeros((1, req.bucket), np.int32)
         padded[0, :len(prompt)] = prompt
         req.dev_prompt = jnp.asarray(padded)
-        self._pending.put(req)
+        try:
+            # bounded queue: block briefly for a slot (smooths bursts),
+            # then reject — the caller's thread, not the decode ring,
+            # pays the wait
+            self._pending.put(req, timeout=(self._queue_timeout
+                                            if self.max_queue else None))
+        except queue.Full:
+            self.stats["rejected_queue_full"] += 1
+            raise QueueFull(
+                f"request queue full (max_queue={self.max_queue}, "
+                f"waited {self._queue_timeout}s)") from None
         if self._stop.is_set() and not req.done.is_set():
             # loop died between the liveness check above and the put:
             # fail the request instead of letting result() hang
@@ -560,6 +720,22 @@ class ContinuousBatcher:
             return req
         self._wake.set()
         return req
+
+    def serving_status(self) -> Dict[str, Any]:
+        """The ``TPUJob.status.serving`` block (camelCase, like
+        GoodputTracker.to_status): cumulative served-token throughput,
+        speculative acceptance rate, and current queue depth — what the
+        manager exports as ``tpujob_serve_*`` gauges on /metrics
+        (utils/observability.py serving_gauges)."""
+        elapsed = max(1e-9, time.monotonic() - self._t_start)
+        drafted = self.stats["spec_drafted"]
+        return {
+            "tokensPerSec": round(self._tokens_emitted / elapsed, 2),
+            "acceptRate": (round(self.stats["spec_accepted"] / drafted, 4)
+                           if drafted else 0.0),
+            "queueDepth": self._pending.qsize(),
+            "tokensTotal": self._tokens_emitted,
+        }
 
     def close(self) -> None:
         self._stop.set()
@@ -583,11 +759,18 @@ class ContinuousBatcher:
         served throughput.  The first token stays a device future,
         materialized at the next chunk consume
         (:meth:`_materialize_first`)."""
-        self.cache, self.tok, self.temp, self.keys, first = \
-            self._inserts[req.bucket](
-                self.params, self.cache, self.tok, self.temp, self.keys,
-                req.dev_prompt, len(req.prompt), slot,
-                float(req.temperature), req.seed)
+        if self.spec_k:
+            (self.cache, self.dcache, self.tok, self.temp, self.keys,
+             first) = self._inserts[req.bucket](
+                self.params, self.draft_params, self.cache, self.dcache,
+                self.tok, self.temp, self.keys, req.dev_prompt,
+                len(req.prompt), slot, float(req.temperature), req.seed)
+        else:
+            self.cache, self.tok, self.temp, self.keys, first = \
+                self._inserts[req.bucket](
+                    self.params, self.cache, self.tok, self.temp,
+                    self.keys, req.dev_prompt, len(req.prompt), slot,
+                    float(req.temperature), req.seed)
         try:                            # ship the first token host-ward
             first.copy_to_host_async()  # early: TTFT then needs no
         except AttributeError:          # extra round-trip at consume
@@ -613,6 +796,7 @@ class ContinuousBatcher:
         self._lane_first[i] = None
         t = int(fd)
         self._lane_out[i].append(t)
+        self._tokens_emitted += 1
         if req._stream is not None:
             req._stream.put(t)
         self._lane_left[i] -= 1
@@ -665,20 +849,35 @@ class ContinuousBatcher:
                 break
             self._finish(req, RuntimeError("batcher closed"))
 
-    def _consume(self, chunk_reqs, toks) -> None:
+    def _consume(self, chunk_reqs, toks, counts=None) -> None:
         """Apply one finished chunk's tokens ([chunk, slots] on host).
         ``chunk_reqs`` pins each lane to the REQUEST the chunk was
         dispatched for: under pipelining a lane may have been evicted
         (and even re-admitted) since dispatch — such in-flight tokens
-        belong to the old request and are dropped."""
+        belong to the old request and are dropped.
+
+        ``counts`` (speculative mode): per-lane count of VALID rows in
+        ``toks`` — the variable accept-length advance.  Lane i takes
+        ``toks[:counts[i], i]`` (its accepted drafts + the correction
+        token); None means every row is valid (plain chunk mode).  The
+        budget/eos walk below is shared, so an eos landing mid-
+        speculated-block truncates exactly like one landing mid-chunk —
+        no tokens after eos ever reach the result or the stream."""
         for i, req in chunk_reqs:
             if req is None or self.lane[i] is not req:
                 continue
             self._materialize_first(i, req)
-            for t in toks[:, i]:
+            n = toks.shape[0] if counts is None else int(counts[i])
+            if counts is not None:
+                self.stats["spec_drafted"] += self.spec_k
+                self.stats["spec_accepted"] += max(0, n - 1)
+                req.drafted += self.spec_k
+                req.accepted += max(0, n - 1)
+            for t in toks[:n, i]:
                 if self._lane_left[i] <= 0:
                     break
                 self._lane_out[i].append(int(t))
+                self._tokens_emitted += 1
                 if req._stream is not None:
                     req._stream.put(int(t))
                 self._lane_left[i] -= 1
@@ -696,7 +895,7 @@ class ContinuousBatcher:
         # with compute; depth 1 was still RTT-bound on relayed chips
         # whose round-trip exceeds a chunk's device time (measured by
         # bench.py measure_ring_throughput), hence depth 2 by default.
-        pending: List[tuple] = []       # [(chunk_reqs, device toks)]
+        pending: List[tuple] = []   # [(chunk_reqs, device toks, counts)]
         while not self._stop.is_set():
             # cancelled lanes leave at the chunk boundary: the request
             # resolves with whatever tokens it has, the lane frees for
@@ -726,8 +925,10 @@ class ContinuousBatcher:
                           if r is not None]
             if not active_idx:
                 if pending:
-                    chunk_reqs, toks_dev = pending.pop(0)
-                    self._consume(chunk_reqs, np.asarray(toks_dev))
+                    chunk_reqs, toks_dev, counts_dev = pending.pop(0)
+                    self._consume(chunk_reqs, np.asarray(toks_dev),
+                                  None if counts_dev is None
+                                  else np.asarray(counts_dev))
                     continue            # eviction may have freed lanes
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
@@ -738,23 +939,33 @@ class ContinuousBatcher:
             active = jnp.asarray(
                 [r is not None for r in self.lane], bool)
             # async dispatch: returns device futures immediately
-            self.cache, self.tok, toks_dev = self._step(
-                self.params, self.cache, self.tok, self.temp, self.keys,
-                active)
+            if self.spec_k:
+                (self.cache, self.dcache, self.tok, toks_dev,
+                 counts_dev) = self._spec_step(
+                    self.params, self.draft_params, self.cache,
+                    self.dcache, self.tok, self.temp, self.keys, active)
+            else:
+                self.cache, self.tok, toks_dev = self._step(
+                    self.params, self.cache, self.tok, self.temp,
+                    self.keys, active)
+                counts_dev = None
             self.stats["chunks"] += 1
             # kick the device->host copy NOW, before the consume wait:
             # by consume time the tokens are already on the wire and
             # np.asarray is a cheap completion wait instead of a full
             # round-trip on the ring's critical path
-            try:
-                toks_dev.copy_to_host_async()
-            except AttributeError:      # interpret-mode ndarray
-                pass
+            for dev in (toks_dev, counts_dev):
+                try:
+                    dev.copy_to_host_async()
+                except AttributeError:  # None / interpret-mode ndarray
+                    pass
             pending.append(([(i, self.lane[i]) for i in active_idx],
-                            toks_dev))
+                            toks_dev, counts_dev))
             if len(pending) >= self.pipeline_depth:
-                chunk_reqs, toks_dev = pending.pop(0)
-                self._consume(chunk_reqs, np.asarray(toks_dev))
+                chunk_reqs, toks_dev, counts_dev = pending.pop(0)
+                self._consume(chunk_reqs, np.asarray(toks_dev),
+                              None if counts_dev is None
+                              else np.asarray(counts_dev))
 
 
 def _default_buckets(max_len: int) -> Tuple[int, ...]:

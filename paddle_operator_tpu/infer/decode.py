@@ -501,3 +501,19 @@ def generate(params: Dict[str, Any], cfg: LlamaConfig, prompt: jax.Array,
             else jnp.zeros((max_new_tokens, 2), jnp.uint32))
     (_, _, _), toks = jax.lax.scan(step, (logits, cache, done0), keys)
     return jnp.concatenate([prompt, toks.T], axis=1)
+
+
+def speculative_generate(params, draft_params, cfg: LlamaConfig,
+                         draft_cfg: LlamaConfig, prompt: jax.Array, **kw):
+    """Draft-propose + chunked-verify counterpart of :func:`generate`:
+    a small draft model (``LlamaConfig.draft()``) proposes ``spec_k``
+    tokens per round and the target verifies all of them in one
+    multi-token forward — token-identical to :func:`generate` at
+    temperature 0, distribution-preserving (rejection sampling) above.
+    Implementation and the full contract live in infer/speculative.py;
+    this re-export keeps the serving entrypoints in one module."""
+    from paddle_operator_tpu.infer.speculative import (
+        speculative_generate as _impl,
+    )
+
+    return _impl(params, draft_params, cfg, draft_cfg, prompt, **kw)

@@ -22,7 +22,11 @@ Two modes:
   decode side by side, lanes recycle on eos/budget, and the compile set
   is fixed regardless of arrival pattern.  Per-request knobs:
   max_new_tokens, temperature, seed, eos_token; top-k/top-p are
-  server-global statics of the resident program.
+  server-global statics of the resident program.  With
+  ``SERVE_SPEC_K > 0`` the ring decodes SPECULATIVELY (docs/serving.md):
+  a draft model proposes K tokens per round, the target verifies them
+  in one chunked forward, and every response carries its measured
+  ``accept_rate``.
 """
 
 from __future__ import annotations
@@ -107,19 +111,43 @@ class ContinuousGenerator:
                  top_p: Optional[float] = None,
                  eos_token: Optional[int] = None,
                  seed: int = 0) -> list:
+        rows, _ = self.generate_rows(
+            tokens, max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token=eos_token, seed=seed)
+        return rows
+
+    def generate_rows(self, tokens, *, max_new_tokens: int,
+                      temperature: float = 0.0,
+                      top_k: Optional[int] = None,
+                      top_p: Optional[float] = None,
+                      eos_token: Optional[int] = None, seed: int = 0):
+        """Rows + per-row speculative accept rates (None entries when
+        the ring is not speculative) — the handler surfaces the rates
+        per response when SERVE_SPEC_K is on."""
         if (top_k, top_p) != (self.batcher._top_k, self.batcher._top_p) \
                 and (top_k is not None or top_p is not None):
             raise ValueError(
                 "top_k/top_p are fixed per continuous server "
                 f"(configured: top_k={self.batcher._top_k} "
                 f"top_p={self.batcher._top_p})")
-        reqs = [self.batcher.submit(
+        reqs = []
+        try:
+            for i, row in enumerate(tokens):
+                reqs.append(self.batcher.submit(
                     row, max_new_tokens=max_new_tokens,
                     temperature=temperature, seed=seed + i,
-                    eos_token=eos_token)
-                for i, row in enumerate(tokens)]
-        # ragged rows: sequences stop at eos, so no rectangular array
-        return [r.result(timeout=600) for r in reqs]
+                    eos_token=eos_token))
+            # ragged rows: sequences stop at eos, no rectangular array
+            rows = [r.result(timeout=600) for r in reqs]
+        except Exception:
+            # a later row's submit rejected (QueueFull) or a result
+            # timed out: the already-submitted rows have no consumer —
+            # without the cancel they would decode to their full budgets
+            # and amplify exactly the overload that shed them
+            for r in reqs:
+                r.cancel()
+            raise
+        return rows, [r.accept_rate for r in reqs]
 
     def close(self) -> None:
         self.batcher.close()
@@ -195,7 +223,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             for tok in handle.stream(timeout=600):
                 emit({"token": tok})
-            emit({"done": True, "tokens": handle.result(timeout=5)})
+            done_ev = {"done": True, "tokens": handle.result(timeout=5)}
+            if handle.accept_rate is not None:   # speculative ring
+                done_ev["accept_rate"] = handle.accept_rate
+            emit(done_ev)
             self.wfile.write(b"0\r\n\r\n")
         except OSError:
             return   # client disconnected mid-stream: nothing to say
@@ -228,14 +259,21 @@ class _Handler(BaseHTTPRequestHandler):
             tokens = np.asarray(req["tokens"], np.int32)
             if tokens.ndim != 2:
                 raise ValueError("tokens must be [batch, seq]")
-            out = self.generator(
-                tokens,
+            opts = dict(
                 max_new_tokens=int(req.get("max_new_tokens", 32)),
                 temperature=float(req.get("temperature", 0.0)),
                 top_k=req.get("top_k"),
                 top_p=req.get("top_p"),
                 eos_token=req.get("eos_token"),
                 seed=int(req.get("seed", 0)))
+            gen = self.generator
+            if (isinstance(gen, ContinuousGenerator)
+                    and getattr(gen.batcher, "spec_k", 0) > 0):
+                # speculative ring: acceptance rate rides every response
+                rows, rates = gen.generate_rows(tokens, **opts)
+                self._send(200, {"tokens": rows, "accept_rate": rates})
+                return
+            out = gen(tokens, **opts)
             out = out if isinstance(out, list) else out.tolist()
             self._send(200, {"tokens": out})
         except (ValueError, KeyError, TypeError,
@@ -307,11 +345,49 @@ def main() -> int:
     # it on by default would 400 existing clients that pass them
     continuous = os.environ.get("SERVE_CONTINUOUS", "0") == "1"
     ring_kw = {}
+    spec_k = int(os.environ.get("SERVE_SPEC_K", "0"))
     if continuous:
         ring_kw = {"slots": int(os.environ.get("SERVE_SLOTS", "8")),
-                   "chunk_tokens": int(os.environ.get("SERVE_CHUNK", "8"))}
+                   "chunk_tokens": int(os.environ.get("SERVE_CHUNK", "8")),
+                   "max_queue": int(os.environ.get("SERVE_MAX_QUEUE",
+                                                   "0"))}
         if os.environ.get("SERVE_MAX_LEN"):
             ring_kw["max_len"] = int(os.environ["SERVE_MAX_LEN"])
+        if spec_k > 0:
+            # SERVE_SPEC_K=K: speculative decoding through the ring.
+            # SERVE_DRAFT names the draft config — "auto" derives the
+            # shallow/narrow companion (LlamaConfig.draft), any preset
+            # name uses that config.  Draft weights restore from
+            # TPUJOB_DRAFT_CHECKPOINT_PATH when set (fresh init
+            # otherwise — smoke mode, acceptance ~1/vocab).
+            draft_name = os.environ.get("SERVE_DRAFT", "auto")
+            if draft_name == "auto":
+                dcfg = cfg.draft()
+            else:
+                from paddle_operator_tpu.models.llama import CONFIGS
+
+                dcfg = CONFIGS[draft_name]
+            from paddle_operator_tpu.infer.speculative import (
+                check_draft_compat,
+            )
+
+            check_draft_compat(cfg, dcfg)
+            dmodel = Llama(dcfg)
+
+            def dinit():
+                dp = dmodel.init(jax.random.PRNGKey(1),
+                                 jnp.zeros((1, 8), jnp.int32))["params"]
+                return T.TrainState(step=jnp.zeros((), jnp.int32),
+                                    params=dp, opt_state=opt.init(dp))
+
+            dpath = os.environ.get("TPUJOB_DRAFT_CHECKPOINT_PATH")
+            if dpath:
+                dstate, _ = resume_or_init(CheckpointManager(dpath), dinit)
+            else:
+                dstate = dinit()
+            ring_kw.update(
+                draft_params=serving_params(dstate.params, dcfg.dtype),
+                draft_cfg=dcfg, spec_k=spec_k)
     # SERVE_TP=n: tensor-parallel serving over the pod's first n chips
     # (weights a single chip cannot hold — the 7B-on-v5e case).  The
     # mesh carries only the tp axis; DP is separate server replicas.
@@ -324,7 +400,7 @@ def main() -> int:
     print(f"serving {os.environ.get('MODEL_PRESET', '7b')} "
           f"(resumed={resumed}, "
           f"quantize={os.environ.get('QUANTIZE', 'off')}, "
-          f"tp={tp}, "
+          f"tp={tp}, spec_k={spec_k if continuous else 0}, "
           f"mode={'continuous' if continuous else 'batch'}) on :{env.port}",
           flush=True)
     srv = make_server("0.0.0.0", env.port, params, cfg,

@@ -1,0 +1,421 @@
+"""Speculative decoding: draft-model propose + chunked target verify.
+
+Decode at low batch is memory-bandwidth-bound (BENCH_r05: HBM util
+0.23-0.31 on the XLA path at batch 1-8) — every generated token streams
+the full weight set for ONE matmul-vector's worth of compute.
+Speculative decoding (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding"; Chen et al., "Accelerating
+Large Language Model Decoding with Speculative Sampling") converts that
+idle bandwidth into tokens: a small DRAFT model proposes K tokens
+autoregressively (cheap — its weight stream is a fraction of the
+target's), then the TARGET model scores all K+1 positions in ONE
+chunked forward (the same weight stream a single decode step pays) and
+accepts the longest prefix consistent with its own distribution.  Per
+accepted token the target streams its weights 1/(a+1) times.
+
+Design, in this codebase's terms:
+
+- **Draft propose** rides the existing single-token ring step
+  (infer/batcher.py ``_ring_forward`` — per-lane positions, pallas
+  kernel on TPU) for K+1 ticks: the last tick's logits are discarded
+  but its cache write appends d_K's KV, so ANY accept length can rewind
+  without a gap (the standard "feed the last draft too" trick).
+- **Chunked verify** is one multi-token forward at per-lane offsets
+  (:func:`_multi_forward`) — the prefill math of infer/decode.py
+  ``_layer`` generalized to a per-lane position vector, reusing the
+  cache-append layout the ring path established.  XLA einsum attention:
+  T = K+1 is a handful of rows, the weight stream dominates.
+- **Acceptance**: exact greedy equality at temperature 0 (output is
+  BIT-IDENTICAL to autoregressive ``decode.generate`` — pinned by
+  tests and the dryrun ``serve-spec`` gate), and textbook rejection
+  sampling (accept d_i with prob min(1, p/q); on rejection sample the
+  normalized residual max(0, p-q)) for temperature > 0, which preserves
+  the target distribution exactly in expectation.
+- **Cache rollback is a write-index rewind, no copy**: rejected
+  positions' K/V rows simply stay behind the rewound per-lane ``pos``;
+  the causal/fill mask never attends past ``pos`` and later writes
+  overwrite them — the same invariant idle ring lanes already rely on.
+- **No divergent compiles**: one jitted round serves every accept
+  pattern; per-lane accept lengths land in a ``pos`` vector, and the
+  greedy/sampled rules are computed side by side and selected per lane
+  by ``temp > 0`` (the ``_sample_tokens`` discipline).
+
+Capacity: a round starting at position p writes verify rows p..p+K, so
+callers must leave ``spec_k - 1`` positions of headroom past
+prompt+max_new_tokens (speculative_generate grows its allocation;
+ContinuousBatcher.submit enforces it against max_len).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.infer import decode as D
+from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
+
+
+def check_draft_compat(cfg: LlamaConfig, draft_cfg: LlamaConfig) -> None:
+    """The one hard compatibility invariant: only TOKEN IDS cross
+    between draft and target, so they must share a tokenizer.  Raises a
+    clear error on vocab mismatch (everything else — depth, width,
+    head counts — may differ freely; ``LlamaConfig.draft()`` builds a
+    compatible config)."""
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"draft/target vocab mismatch: draft vocab_size="
+            f"{draft_cfg.vocab_size} vs target {cfg.vocab_size} — "
+            "speculative decoding exchanges token ids between the two "
+            "models, so they must share one tokenizer")
+
+
+# ---------------------------------------------------------------------------
+# Device side: multi-token verify forward at per-lane positions
+# ---------------------------------------------------------------------------
+
+
+def _write_rows(cache_l: jax.Array, kv: jax.Array,
+                pos: jax.Array) -> jax.Array:
+    """[B, H, S, D] cache layer <- [B, H, T, D] new rows at per-lane
+    start positions ``pos``.  Unrolled per lane (static slot count) for
+    the same reason as batcher._write_lane_stacked: a vmapped update
+    over ragged positions lowers to a scatter that copies the carry."""
+    for lane in range(kv.shape[0]):
+        cache_l = jax.lax.dynamic_update_slice(
+            cache_l, kv[lane][None], (lane, 0, pos[lane], 0))
+    return cache_l
+
+
+def _layer_multi(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+                 cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
+                 v_cache: jax.Array, pos: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer over [B, T] new tokens starting at PER-LANE
+    offsets ``pos`` [B] — decode._layer's math with the scalar position
+    generalized to a vector (and batcher._layer_step's with one token
+    generalized to T).  Row (b, j) sits at absolute position pos[b]+j
+    and attends cache cols [0, pos[b]+j]."""
+    b, t, _ = x.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
+    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    abs_pos = pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
+    cos_b = cos[abs_pos][:, :, None, :]                      # [B, T, 1, d/2]
+    sin_b = sin[abs_pos][:, :, None, :]
+
+    def rot(u):
+        u1, u2 = jnp.split(u.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [u1 * cos_b - u2 * sin_b, u2 * cos_b + u1 * sin_b],
+            axis=-1).astype(u.dtype)
+
+    q, k = rot(q), rot(k)
+    k_cache = _write_rows(k_cache, k.transpose(0, 2, 1, 3), pos)
+    v_cache = _write_rows(v_cache, v.transpose(0, 2, 1, 3), pos)
+
+    n_rep = hq // hkv
+    s = k_cache.shape[2]
+    qg = q.reshape(b, t, hkv, n_rep, d)
+    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    mask = jnp.arange(s)[None, None, :] <= abs_pos[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    out = out.reshape(b, t, hq * d).astype(cfg.dtype)
+    return D._finish_layer(cfg, lp, x, out), k_cache, v_cache
+
+
+def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
+                   toks: jax.Array, cache: Dict[str, jax.Array],
+                   mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """[B, T] new tokens at per-lane cache['pos'] -> ([B, T, vocab]
+    logits, advanced cache).  The chunked-verify forward: every einsum
+    is the ring path's, so under a serving mesh the whole thing rides
+    GSPMD off the param/cache shardings (T is a handful of rows — the
+    pallas single-query kernel has nothing to win here)."""
+    pos = cache["pos"]
+    x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+
+    def body(x, layer_in):
+        lp, k_c, v_c = layer_in
+        y, k_c, v_c = _layer_multi(cfg, lp, x, cos, sin, k_c, v_c, pos)
+        return y, (k_c, v_c)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"]))
+    x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    logits = D._mm(x, params["lm_head"]["kernel"],
+                   cfg.dtype).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
+
+
+# ---------------------------------------------------------------------------
+# The speculative round: propose K, verify K+1, commit a+1, rewind
+# ---------------------------------------------------------------------------
+
+
+def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None, mesh=None):
+    """One jitted speculative round over ring-style caches (per-lane
+    ``pos`` vectors), BOTH caches donated.
+
+    ``round(params, dparams, tcache, dcache, tok [B], temp [B],
+    keys [B,2], active [B]) -> (tcache', dcache', tok', committed
+    [spec_k+1, B], n_commit [B])``
+
+    ``tok`` is the per-lane carry token — committed but not yet in
+    either cache.  ``committed[:n_commit[b], b]`` are lane b's newly
+    committed tokens this round (accepted drafts then the
+    correction/bonus token); inactive lanes freeze entirely
+    (n_commit 0, pos unchanged, tok unchanged) so the compiled program
+    is one shape for every arrival/accept pattern."""
+    from paddle_operator_tpu.infer.batcher import _ring_forward
+
+    kk = spec_k
+
+    def round_fn(params, dparams, tcache, dcache, tok, temp, keys, active):
+        b = tok.shape[0]
+        tpos0, dpos0 = tcache["pos"], dcache["pos"]
+        # decoupled sampling streams: draft draws, acceptance uniforms
+        # and residual draws must not reuse each other's bits
+        dkeys = jax.vmap(lambda u: jax.random.fold_in(u, 1))(keys)
+        akeys = jax.vmap(lambda u: jax.random.fold_in(u, 2))(keys)
+        rkeys = jax.vmap(lambda u: jax.random.fold_in(u, 3))(keys)
+
+        def draft_tick(carry, _):
+            dc, tk = carry
+            p0 = dc["pos"]
+            logits, dc = _ring_forward(dcfg, dparams, tk, dc, mesh=mesh)
+            greedy = logits.argmax(-1).astype(jnp.int32)
+            filt = D._filter_logits(
+                logits / jnp.maximum(temp, 1e-6)[:, None], top_k, top_p)
+            qdist = jax.nn.softmax(filt, axis=-1)            # [B, V] f32
+            sub = jax.vmap(jax.random.fold_in)(dkeys, p0)
+            drawn = jax.vmap(
+                lambda u, l: jax.random.categorical(u, l))(sub, filt)
+            nxt = jnp.where(temp > 0, drawn.astype(jnp.int32), greedy)
+            return (dc, nxt), (nxt, qdist)
+
+        # K+1 ticks: K proposals, plus one extra feed whose logits are
+        # discarded but whose cache write appends d_K's KV — the rewind
+        # then has no gap at full acceptance (module docstring)
+        (dcache2, _), (ds, qdists) = jax.lax.scan(
+            draft_tick, (dcache, tok), None, length=kk + 1)
+        drafts = ds[:kk].T                                   # [B, K]
+        q = jnp.transpose(qdists[:kk], (1, 0, 2))            # [B, K, V]
+
+        seq = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, K+1]
+        tlogits, tcache2 = _multi_forward(cfg, params, seq, tcache,
+                                          mesh=mesh)
+        tgt = tlogits.argmax(-1).astype(jnp.int32)           # [B, K+1]
+
+        # greedy rule: accept while the draft equals the target argmax
+        accept_g = drafts == tgt[:, :kk]
+        # sampled rule: accept d_i with prob min(1, p(d_i)/q(d_i))
+        tfilt = D._filter_logits(
+            tlogits / jnp.maximum(temp, 1e-6)[:, None, None], top_k, top_p)
+        pdist = jax.nn.softmax(tfilt, axis=-1)               # [B, K+1, V]
+        p_tok = jnp.take_along_axis(
+            pdist[:, :kk], drafts[..., None], -1)[..., 0]    # [B, K]
+        q_tok = jnp.take_along_axis(q, drafts[..., None], -1)[..., 0]
+        sub_a = jax.vmap(jax.random.fold_in)(akeys, tpos0)
+        u = jax.vmap(lambda s_: jax.random.uniform(s_, (kk,)))(sub_a)
+        accept_s = u * q_tok < p_tok
+        accept = jnp.where(temp[:, None] > 0, accept_s, accept_g)
+        # longest accepted prefix per lane, 0..K
+        a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+        # the token after the accepted prefix: at a < K the correction
+        # (greedy: target argmax; sampled: the normalized residual
+        # max(0, p - q)), at a == K the bonus from the target's K-th
+        # distribution — the same gather covers both (q padded with 0)
+        nxt_g = jnp.take_along_axis(tgt, a[:, None], 1)[:, 0]
+        q_pad = jnp.concatenate([q, jnp.zeros_like(q[:, :1])], axis=1)
+        pd_a = jnp.take_along_axis(pdist, a[:, None, None], 1)[:, 0]
+        qd_a = jnp.take_along_axis(q_pad, a[:, None, None], 1)[:, 0]
+        resid = jnp.clip(pd_a - qd_a, 0.0, None)
+        rs = resid.sum(-1, keepdims=True)
+        resid = jnp.where(rs > 0, resid, pd_a)   # numerically-empty residual
+        sub_r = jax.vmap(jax.random.fold_in)(rkeys, tpos0)
+        nxt_s = jax.vmap(
+            lambda s_, r: jax.random.categorical(s_, jnp.log(r)))(
+            sub_r, resid).astype(jnp.int32)
+        nxt = jnp.where(temp > 0, nxt_s, nxt_g)
+
+        n_commit = jnp.where(active, a + 1, 0)
+        drafts_pad = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)  # [B, K+1]
+        idx = jnp.arange(kk + 1)[None, :]
+        committed = jnp.where(
+            idx < a[:, None], drafts_pad,
+            jnp.where(idx == a[:, None], nxt[:, None], 0))
+        tok_out = jnp.where(active, nxt, tok)
+        # ROLLBACK: monotone write-index rewind — both caches advanced
+        # spec_k+1 rows, committed only a+1; rejected rows stay behind
+        # pos, never attended, overwritten by later writes
+        tcache2["pos"] = jnp.where(active, tpos0 + a + 1, tpos0)
+        dcache2["pos"] = jnp.where(active, dpos0 + a + 1, dpos0)
+        return tcache2, dcache2, tok_out, committed.T, n_commit
+
+    return jax.jit(round_fn, donate_argnums=(2, 3))
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_round_fn(cfg, dcfg, spec_k, top_k, top_p, mesh):
+    """Round programs keyed by (configs, K, filters, mesh) so repeated
+    speculative_generate calls (bench sweeps, tests) reuse compiles."""
+    return make_spec_round_fn(cfg, dcfg, spec_k, top_k, top_p, mesh=mesh)
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_prefill(cfg, alloc_len, mesh):
+    return jax.jit(lambda p, t: D.prefill(p, cfg, t, alloc_len, mesh=mesh))
+
+
+# ---------------------------------------------------------------------------
+# Host side: the standalone generate loop
+# ---------------------------------------------------------------------------
+
+
+def speculative_generate(params: Dict[str, Any],
+                         draft_params: Dict[str, Any],
+                         cfg: LlamaConfig, draft_cfg: LlamaConfig,
+                         prompt: jax.Array, *, max_new_tokens: int,
+                         spec_k: int = 4, temperature: float = 0.0,
+                         top_k: Optional[int] = None,
+                         top_p: Optional[float] = None,
+                         key: Optional[jax.Array] = None,
+                         max_len: Optional[int] = None,
+                         eos_token: Optional[int] = None, mesh=None,
+                         return_stats: bool = False):
+    """Speculative counterpart of decode.generate: prompt [B, S] ->
+    [B, S + max_new_tokens].  At temperature 0 the output is exactly
+    token-identical to ``decode.generate`` (greedy acceptance only ever
+    commits tokens the target itself would have produced); at
+    temperature > 0 rejection sampling preserves the target
+    distribution (streams differ from generate's — distributional, not
+    bitwise, equivalence).  Host-driven: rounds commit a data-dependent
+    1..spec_k+1 tokens each, so the loop runs until every lane has its
+    budget (lanes that finish early freeze via the active mask).
+
+    ``mesh`` (make_serving_mesh): BOTH param trees must be laid out
+    with decode.shard_params_for_serving; the draft's single-token
+    steps and the chunked verify ride the same tp axis.
+
+    ``return_stats``: also return {"accept_rate", "accepted",
+    "drafted", "rounds", "spec_k"} — the serving acceptance telemetry.
+    """
+    check_draft_compat(cfg, draft_cfg)
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1 (got {spec_k})")
+    b, s = prompt.shape
+    cache_len = max_len or cfg.max_seq_len
+    need = s + max_new_tokens
+    if need > cache_len:
+        raise ValueError(f"prompt ({s}) + max_new_tokens "
+                         f"({max_new_tokens}) = {need} exceeds the cache "
+                         f"({cache_len} positions)")
+    # a verify round may write spec_k rows past the last committed
+    # token; grow the allocation within the RoPE table and fail clearly
+    # when it cannot fit
+    alloc_len = min(cfg.max_seq_len, cache_len + spec_k)
+    if need + spec_k - 1 > D.cache_alloc_len(alloc_len):
+        raise ValueError(
+            f"speculative decoding needs {spec_k - 1} positions of cache "
+            f"headroom past prompt+max_new_tokens ({need}) but the RoPE "
+            f"table caps the allocation at {alloc_len} "
+            f"(cfg.max_seq_len={cfg.max_seq_len}); lower spec_k or "
+            f"max_new_tokens")
+    if alloc_len > draft_cfg.max_seq_len:
+        raise ValueError(
+            f"draft max_seq_len ({draft_cfg.max_seq_len}) is smaller than "
+            f"the serving context ({alloc_len}); derive the draft with "
+            f"cfg.draft() to inherit the target's RoPE table")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, b)
+
+    logits, tc = _cached_prefill(cfg, alloc_len, mesh)(params, prompt)
+    _, dc = _cached_prefill(draft_cfg, alloc_len, mesh)(draft_params,
+                                                        prompt)
+    # two distinct pos buffers: the round donates BOTH caches, and a
+    # shared array would be donated twice
+    tcache = {"k": tc["k"], "v": tc["v"],
+              "pos": jnp.full((b,), s, jnp.int32)}
+    dcache = {"k": dc["k"], "v": dc["v"],
+              "pos": jnp.full((b,), s, jnp.int32)}
+
+    temp_vec = jnp.full((b,), float(temperature), jnp.float32)
+    if temperature <= 0:
+        tok = logits.argmax(-1).astype(jnp.int32)
+    else:
+        filt = D._filter_logits(logits / temperature, top_k, top_p)
+        tok = jax.vmap(lambda u, l: jax.random.categorical(u, l))(
+            jax.vmap(lambda u: jax.random.fold_in(u, 0))(keys),
+            filt).astype(jnp.int32)
+
+    out = [[] for _ in range(b)]
+    done = [False] * b
+    first = np.asarray(tok)
+    for i in range(b):
+        t0 = int(first[i])
+        out[i].append(t0)
+        if eos_token is not None and t0 == eos_token:
+            done[i] = True
+
+    round_fn = _cached_round_fn(cfg, draft_cfg, spec_k, top_k, top_p, mesh)
+    accepted = drafted = rounds = 0
+    while True:
+        act = [not done[i] and len(out[i]) < max_new_tokens
+               for i in range(b)]
+        if not any(act):
+            break
+        tcache, dcache, tok, committed, n_commit = round_fn(
+            params, draft_params, tcache, dcache, tok, temp_vec, keys,
+            jnp.asarray(act))
+        committed = np.asarray(committed)             # [K+1, B]
+        n_commit = np.asarray(n_commit)
+        rounds += 1
+        for i in range(b):
+            if not act[i]:
+                continue
+            n = int(n_commit[i])
+            drafted += spec_k
+            accepted += n - 1
+            for t in committed[:n, i]:
+                if len(out[i]) >= max_new_tokens:
+                    break
+                out[i].append(int(t))
+                if eos_token is not None and int(t) == eos_token:
+                    done[i] = True
+                    break
+
+    # finished lanes keep emitting eos for their remaining positions —
+    # decode.generate's static-shape eos semantics
+    pad = eos_token if eos_token is not None else 0
+    res = np.full((b, s + max_new_tokens), pad, np.int32)
+    res[:, :s] = np.asarray(prompt)
+    for i in range(b):
+        res[i, s:s + len(out[i])] = out[i]
+    tokens = jnp.asarray(res, prompt.dtype)
+    if return_stats:
+        stats = {
+            "accept_rate": round(accepted / drafted, 4) if drafted else 0.0,
+            "accepted": accepted, "drafted": drafted,
+            "rounds": rounds, "spec_k": spec_k,
+        }
+        return tokens, stats
+    return tokens

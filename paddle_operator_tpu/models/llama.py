@@ -97,6 +97,32 @@ class LlamaConfig:
         return tp <= 1 or (self.n_kv_heads % tp == 0
                            and self.n_heads % tp == 0)
 
+    def draft(self, **overrides) -> "LlamaConfig":
+        """The companion draft-model config for speculative decoding
+        (infer/speculative.py): shallow (depth/4) and narrow (heads/2 at
+        the SAME head_dim, so the decode kernel's lane alignment is
+        inherited), sharing everything that couples draft to target —
+        tokenizer (vocab_size), RoPE table shape/theta, dtypes, decode
+        attention impl.  The draft is a separate param tree with its own
+        KV cache; only the token ids cross between the models, which is
+        why vocab_size is the one compatibility invariant
+        (speculative.check_draft_compat enforces it).  ``overrides``
+        replace any field of the derived config (a hand-tuned draft
+        preset can be passed straight through)."""
+        n_heads = max(1, self.n_heads // 2)
+        n_kv = max(1, self.n_kv_heads // 2)
+        while n_heads % n_kv:       # GQA grouping must survive the halving
+            n_kv -= 1
+        kw = dict(
+            n_layers=max(1, self.n_layers // 4),
+            n_heads=n_heads,
+            n_kv_heads=n_kv,
+            dim=self.head_dim * n_heads,
+            ffn_dim=max(self.head_dim, self.ffn_dim // 2),
+        )
+        kw.update(overrides)
+        return dataclasses.replace(self, **kw)
+
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads

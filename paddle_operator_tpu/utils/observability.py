@@ -77,6 +77,24 @@ class StepTimer:
         return s
 
 
+def serving_gauges(status_serving: dict, job: str) -> dict:
+    """Prometheus gauge lines for one job's workload-published
+    ``status.serving`` block (infer/batcher.py
+    ContinuousBatcher.serving_status) — shared by the manager's
+    /metrics export (controller/manager.py) so names cannot drift from
+    docs/serving.md.  ``job`` is ``namespace/name``.  Lives here (not
+    in infer/) because the manager process must not import jax."""
+    lbl = f'{{job="{job}"}}'
+    return {
+        f"tpujob_serve_tokens_per_sec{lbl}":
+            float(status_serving.get("tokensPerSec", 0.0)),
+        f"tpujob_serve_accept_rate{lbl}":
+            float(status_serving.get("acceptRate", 0.0)),
+        f"tpujob_serve_queue_depth{lbl}":
+            float(status_serving.get("queueDepth", 0.0)),
+    }
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """``with trace('/tmp/trace'):`` profiles the enclosed steps; load the

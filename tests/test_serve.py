@@ -221,6 +221,39 @@ class TestContinuousServe:
         assert "fixed per continuous server" in json.loads(
             ei.value.read())["error"]
 
+    def test_speculative_server_surfaces_accept_rate(self):
+        """SERVE_SPEC_K-shaped server (continuous + draft): responses
+        carry per-row accept_rate, tokens still match plain generate
+        (greedy speculative is token-identical)."""
+        from paddle_operator_tpu.models.llama import Llama
+
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        dcfg = cfg.draft()
+        dparams = Llama(dcfg).init(jax.random.PRNGKey(1),
+                                   jnp.zeros((1, 8), jnp.int32))["params"]
+        srv = make_server("127.0.0.1", 0, params, cfg, continuous=True,
+                          slots=2, max_len=64, chunk_tokens=4,
+                          prefill_buckets=(16, 64), draft_params=dparams,
+                          draft_cfg=dcfg, spec_k=3)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            prompt = [[3, 1, 4, 1, 5, 9]]
+            code, out = _post(base, {"tokens": prompt,
+                                     "max_new_tokens": 6})
+            assert code == 200
+            ref = D.generate(params, cfg, jnp.asarray(prompt, jnp.int32),
+                             max_new_tokens=6, max_len=64)
+            assert out["tokens"][0] == np.asarray(ref[0]).tolist()
+            assert "accept_rate" in out
+            assert len(out["accept_rate"]) == 1
+            assert 0.0 <= out["accept_rate"][0] <= 1.0
+        finally:
+            srv.shutdown()
+            srv.generator.close()
+
     def test_streaming_rejected_on_batch_server(self):
         model, cfg = make_model("tiny", dtype=jnp.float32)
         params = model.init(jax.random.PRNGKey(0),

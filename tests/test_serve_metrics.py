@@ -1,0 +1,147 @@
+"""Serving telemetry: the ``status.serving`` block
+(infer/batcher.py ContinuousBatcher.serving_status) plumbed through the
+CRD status, preserved by the reconciler's status sync, and exported by
+the manager as ``tpujob_serve_*`` gauges on /metrics — the speculative
+acceptance rate, served-token throughput, and queue depth next to the
+PR 2 goodput gauges."""
+
+import socket
+import urllib.request
+
+from paddle_operator_tpu.api import ResourceSpec, TPUJob, TPUJobSpec
+from paddle_operator_tpu.controller.fake_api import FakeAPI, FakeFleet
+from paddle_operator_tpu.controller.manager import Manager, _serve
+from paddle_operator_tpu.controller.reconciler import (
+    KIND_JOB,
+    TPUJobReconciler,
+    run_to_settled,
+)
+from paddle_operator_tpu.utils.observability import serving_gauges
+
+NS = "default"
+TMPL = {"spec": {"containers": [{"name": "m", "image": "jax:latest"}]}}
+
+SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
+           "tokensTotal": 9000}
+
+
+class TestGaugeNaming:
+    def test_serving_gauges(self):
+        g = serving_gauges(SERVING, "default/j")
+        assert g['tpujob_serve_tokens_per_sec{job="default/j"}'] == 123.4
+        assert g['tpujob_serve_accept_rate{job="default/j"}'] == 0.72
+        assert g['tpujob_serve_queue_depth{job="default/j"}'] == 3.0
+
+    def test_missing_keys_default_zero(self):
+        g = serving_gauges({}, "ns/x")
+        assert all(v == 0.0 for v in g.values())
+
+
+def _running_job_with_serving(api, rec, fleet, serving, name="sj"):
+    job = TPUJob(name=name, namespace=NS, spec=TPUJobSpec(
+        worker=ResourceSpec(replicas=2, template=TMPL)))
+    api.create(KIND_JOB, job.to_dict())
+    run_to_settled(rec, NS, name)
+    fleet.run_all()
+    run_to_settled(rec, NS, name)
+    # serving worker publishes its telemetry block into the status
+    raw = api.get(KIND_JOB, NS, name)
+    raw["status"]["serving"] = serving
+    api.update_status(KIND_JOB, raw)
+
+
+class TestStatusPlumbing:
+    def test_reconciler_preserves_serving_block(self):
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        fleet = FakeFleet(api, NS)
+        _running_job_with_serving(api, rec, fleet, SERVING)
+        run_to_settled(rec, NS, "sj")     # status sync must NOT wipe it
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "sj"))
+        assert got.status.serving["acceptRate"] == 0.72
+        assert got.status.serving["tokensPerSec"] == 123.4
+
+    def test_crd_schema_keeps_serving(self):
+        """A structural-schema apiserver prunes unknown status fields —
+        the CRD must declare the serving block."""
+        from paddle_operator_tpu.api.crd import generate_crd
+
+        crd = generate_crd()
+        status = crd["spec"]["versions"][0]["schema"][
+            "openAPIV3Schema"]["properties"]["status"]["properties"]
+        assert "serving" in status
+        assert status["serving"]["x-kubernetes-preserve-unknown-fields"]
+
+    def test_manager_serves_serving_gauges_on_metrics_endpoint(self):
+        """Acceptance: tpujob_serve_* gauges are scrapeable from the
+        manager's /metrics, next to the goodput gauges."""
+        api = FakeAPI()
+        mgr = Manager(api, namespace=NS)
+        fleet = FakeFleet(api, NS)
+        _running_job_with_serving(api, mgr.reconciler, fleet, SERVING)
+        # goodput riding alongside proves both blocks export together
+        raw = api.get(KIND_JOB, NS, "sj")
+        raw["status"]["goodput"] = {"ratio": 0.9, "productiveSeconds": 9,
+                                    "wallclockSeconds": 10}
+        api.update_status(KIND_JOB, raw)
+        mgr.run_once()
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        _serve(("127.0.0.1", port), mgr.metrics, lambda: True)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as r:
+            body = r.read().decode()
+        assert 'tpujob_serve_tokens_per_sec{job="default/sj"} 123.4' in body
+        assert 'tpujob_serve_accept_rate{job="default/sj"} 0.72' in body
+        assert 'tpujob_serve_queue_depth{job="default/sj"} 3.0' in body
+        assert 'tpujob_goodput_ratio{job="default/sj"} 0.9' in body
+
+    def test_stale_serving_gauges_pruned(self):
+        """A job that stops publishing serving telemetry must disappear
+        from /metrics (bounded registry, no stale readings)."""
+        api = FakeAPI()
+        mgr = Manager(api, namespace=NS)
+        fleet = FakeFleet(api, NS)
+        _running_job_with_serving(api, mgr.reconciler, fleet, SERVING)
+        mgr.run_once()
+        assert any("tpujob_serve_tokens_per_sec" in k
+                   for k in mgr.metrics.counters)
+        raw = api.get(KIND_JOB, NS, "sj")
+        raw["status"].pop("serving")
+        api.update_status(KIND_JOB, raw)
+        mgr.run_once()
+        assert not any("tpujob_serve_tokens_per_sec" in k
+                       for k in mgr.metrics.counters)
+
+
+class TestBatcherServingStatus:
+    def test_serving_status_block_shape(self):
+        """The producer side: a live ring reports the camelCase block
+        the gauges consume, with emitted tokens counted."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+        from paddle_operator_tpu.models.llama import make_model
+
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        b = ContinuousBatcher(params, cfg, slots=1, max_len=32,
+                              chunk_tokens=2, prefill_buckets=(16, 32))
+        try:
+            b.submit([1, 2, 3], max_new_tokens=4).result(timeout=300)
+            st = b.serving_status()
+        finally:
+            b.close()
+        assert set(st) == {"tokensPerSec", "acceptRate", "queueDepth",
+                           "tokensTotal"}
+        assert st["tokensTotal"] == 4
+        assert st["tokensPerSec"] > 0
+        assert st["acceptRate"] == 0.0         # non-speculative ring
+        g = serving_gauges(st, "ns/j")
+        assert g['tpujob_serve_tokens_per_sec{job="ns/j"}'] > 0
