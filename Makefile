@@ -15,10 +15,12 @@ native:
 test: native
 	$(PY) -m pytest tests/ -x -q
 
-# The ROADMAP.md tier-1 verify command, verbatim — the bar every PR must
-# keep no worse than the seed.
+# The ROADMAP.md tier-1 verify command (plus --durations=15, which
+# changes no outcome but makes the slow spec/paged serving tests
+# visible in CI logs) — the bar every PR must keep no worse than the
+# seed.
 tier1:
-	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
+	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --durations=15 --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Run the controller locally against the current kube context
 run:
@@ -45,7 +47,7 @@ bench:
 
 # CPU dry-run gate: entry forward + the 8-virtual-device multichip run
 # (all training parallelism axes, plus the serving parity lines:
-# serve-decode, serve-ring, serve-spec, ft-drain)
+# serve-decode, serve-ring, serve-spec, serve-paged, ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

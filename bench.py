@@ -409,6 +409,117 @@ def measure_sharded_serving(cfg, params, *, tp: int = 2,
     return result
 
 
+def _pctl(xs, q):
+    """Percentile over a small latency sample (nearest-rank) — TTFT
+    distributions are what the paged sweep reports, not means (a single
+    cold compile or relay hiccup poisons a mean)."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(q * (len(xs) - 1))))]
+
+
+def measure_paged_serving(cfg, params, *, slots: int = 4,
+                          prompt_lens=(128, 2048),
+                          hit_ratios=(0.0, 0.5, 0.9),
+                          new_tokens: int = 32, max_len: int = None,
+                          block_size: int = 256, chunk: int = 16,
+                          requests: int = 10, mesh=None) -> list:
+    """Paged-KV serving sweep (docs/serving.md): TTFT p50/p95 for
+    prefix-HIT vs COLD admissions at hit ratio x prompt length, through
+    a SERVE_PAGED ring with the radix prefix cache on.
+
+    Per (ratio, prompt_len) cell a FRESH ring is built (cache state is
+    the variable under test), one leader request seeds the shared
+    prompt's blocks, then ``requests`` sequential streaming probes
+    measure submit -> first-token: ``round(ratio * requests)`` of them
+    reuse the shared prompt (admission maps its cached blocks and runs
+    a 1-token forward — the TTFT the prefix cache buys), the rest are
+    unique prompts (cold prefill, the baseline the hit must beat).
+    ``paged_ttft_hit_ms``/``paged_ttft_cold_ms`` are the p50s;
+    ``prefix_hit_rate``/``kv_blocks_hwm`` come from the allocator.
+    Greedy parity with the contiguous ring is the DRYRUN's job
+    (serve-paged line) — this function measures, it does not assert."""
+    import numpy as np
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+
+    max_len = max_len or (max(prompt_lens) + new_tokens)
+    rng = np.random.default_rng(0)
+    out = []
+    for prompt_len in prompt_lens:
+        if prompt_len + new_tokens > max_len:
+            continue
+        # only FULL blocks publish to the radix cache: a prompt shorter
+        # than one block can never hit, so the cell's block size shrinks
+        # to the prompt (the 128-prompt cell runs 128-blocks, the
+        # 2048-prompt cell the kernel-aligned default)
+        cell_bs = min(block_size, prompt_len)
+        shared = rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+        for ratio in hit_ratios:
+            b = ContinuousBatcher(
+                params, cfg, slots=slots, max_len=max_len,
+                chunk_tokens=chunk, prefill_buckets=(prompt_len, max_len),
+                paged=True, block_size=cell_bs, mesh=mesh)
+            try:
+                # seed the cache + warm the compile set (insert, suffix
+                # insert, chunk step) OUTSIDE the timed probes
+                b.submit(shared, max_new_tokens=chunk).result(timeout=600)
+                b.submit(shared, max_new_tokens=chunk).result(timeout=600)
+                # hit-rate accounting restarts here: the reported rate
+                # reflects the measured plan, not the warmup
+                b.pool.stats.update(prefix_lookup_tokens=0,
+                                    prefix_hit_tokens=0,
+                                    prefix_lookups=0, prefix_full_hits=0)
+                n_hit = int(round(ratio * requests))
+                plan = [True] * n_hit + [False] * (requests - n_hit)
+                rng.shuffle(plan)
+                t_hit, t_cold = [], []
+                t0 = time.perf_counter()
+                generated = 0
+                for want_hit in plan:
+                    p = shared if want_hit else rng.integers(
+                        0, cfg.vocab_size, (prompt_len,)).tolist()
+                    t1 = time.perf_counter()
+                    probe = b.submit(p, max_new_tokens=new_tokens,
+                                     stream=True)
+                    next(probe.stream(timeout=600))
+                    (t_hit if want_hit else t_cold).append(
+                        (time.perf_counter() - t1) * 1000)
+                    generated += len(probe.result(timeout=600)) - prompt_len
+                dt = time.perf_counter() - t0
+                if t_hit and b.pool.hit_rate() == 0:
+                    # intended hits never landed (e.g. a cache state
+                    # bug): report them as what they were — cold — so
+                    # paged_ttft_hit_ms can never mean "cold prefill"
+                    t_cold += t_hit
+                    t_hit = []
+                row = {
+                    "paged_hit_ratio": ratio,
+                    "paged_prompt_len": prompt_len,
+                    "paged_block_size": cell_bs,
+                    "paged_requests": requests,
+                    "paged_ttft_p50_ms": round(_pctl(t_hit + t_cold, 0.5), 1),
+                    "paged_ttft_p95_ms": round(_pctl(t_hit + t_cold, 0.95), 1),
+                    "paged_tok_per_sec": round(generated / dt, 1),
+                    "paged_prefix_hit_rate": b.pool.hit_rate(),
+                    "paged_kv_blocks_hwm": b.pool.stats["blocks_hwm"],
+                    "paged_kv_blocks_free": b.pool.blocks_free(),
+                    "paged_cow_copies": b.stats["cow_copies"],
+                }
+                if t_hit:
+                    row["paged_ttft_hit_ms"] = round(_pctl(t_hit, 0.5), 1)
+                    row["paged_ttft_hit_p95_ms"] = round(
+                        _pctl(t_hit, 0.95), 1)
+                if t_cold:
+                    row["paged_ttft_cold_ms"] = round(_pctl(t_cold, 0.5), 1)
+                b.pool.check_invariant()
+            finally:
+                b.close()
+            out.append(row)
+    return out
+
+
 def _pattern_tokens(batch: int, seq: int, vocab: int, seed: int = 0):
     """Deterministic LEARNABLE sequences: tok_{t+1} = (tok_t*5 + 17) %
     vocab — a bijective next-token map a tiny model masters in tens of
@@ -888,6 +999,27 @@ def main() -> int:
                 summary["sharded_tok_per_sec"] = \
                     sharded["sharded_tok_per_sec"]
 
+            # paged-KV serving: TTFT distribution with the radix prefix
+            # cache at hit ratio x prompt length — the 0.9-hit 2048-
+            # prompt row against its own cold column is the tentpole's
+            # headline (prefill skipped over cached blocks)
+            paged = guarded("paged", lambda: measure_paged_serving(
+                dcfg, dparams, slots=8, prompt_lens=(128, 2048),
+                new_tokens=64, max_len=2240, block_size=256, chunk=48))
+            if isinstance(paged, list):
+                for entry in paged:
+                    emit("paged_sweep", entry)
+                hits = [e for e in paged if "paged_ttft_hit_ms" in e]
+                if hits:
+                    top = max(hits, key=lambda e: (e["paged_hit_ratio"],
+                                                   e["paged_prompt_len"]))
+                    summary["paged_ttft_hit_ms"] = top["paged_ttft_hit_ms"]
+                    summary["prefix_hit_rate"] = \
+                        top["paged_prefix_hit_rate"]
+                    summary["kv_blocks_hwm"] = top["paged_kv_blocks_hwm"]
+            else:
+                emit("paged_sweep", paged)
+
             # speculative decoding: a pattern-trained target+draft pair
             # (train_spec_pair — random-init drafts accept ~1/vocab and
             # measure only overhead), K x batch sweep with accept-rate
@@ -931,6 +1063,35 @@ def main() -> int:
                 max_len=32, slots=2, requests=2, chunk=2)
 
         emit("sharded_serving", guarded("sharded", cpu_sharded))
+
+        # paged serving on CPU: tiny shapes — latencies are meaningless
+        # but the hit-vs-cold TTFT split, hit-rate accounting and the
+        # allocator invariant all run for real
+        def cpu_paged():
+            from paddle_operator_tpu.infer.quant import serving_params
+
+            tcfg = L.CONFIGS["tiny"]
+            tparams = serving_params(L.Llama(tcfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"], tcfg.dtype)
+            return measure_paged_serving(
+                tcfg, tparams, slots=2, prompt_lens=(16,),
+                hit_ratios=(0.0, 0.5), new_tokens=4, max_len=32,
+                block_size=8, chunk=2, requests=4)
+
+        paged = guarded("paged", cpu_paged)
+        if isinstance(paged, list):
+            for entry in paged:
+                emit("paged_sweep", entry)
+            hits = [e for e in paged if "paged_ttft_hit_ms" in e]
+            if hits:
+                summary["paged_ttft_hit_ms"] = \
+                    hits[-1]["paged_ttft_hit_ms"]
+                summary["prefix_hit_rate"] = \
+                    hits[-1]["paged_prefix_hit_rate"]
+                summary["kv_blocks_hwm"] = hits[-1]["paged_kv_blocks_hwm"]
+        else:
+            emit("paged_sweep", paged)
 
         # speculative sweep on CPU: tiny pattern-trained pair — speeds
         # are meaningless but accept-rate and the greedy-parity path run

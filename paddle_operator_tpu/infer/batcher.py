@@ -281,11 +281,12 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                                               mesh=mesh)
             nxt = _sample_tokens(logits, temp, keys, cache["pos"],
                                  top_k, top_p)
-            # frozen lanes: position does not advance, cache rows keep
-            # whatever the (ignored) write put at their current pos —
-            # the next admission overwrites from its prompt start anyway
-            new_cache["pos"] = jnp.where(active, new_cache["pos"],
-                                         cache["pos"])
+            # retired/free lanes: position ZEROED (a stale fill
+            # position must never outlive its request — the
+            # serving_status staleness fix); their (ignored) writes
+            # land at row 0, which the next admission's splice
+            # overwrites along with the rest of the lane
+            new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
             nxt = jnp.where(active, nxt, tok)
             return (new_cache, nxt), nxt
 
@@ -513,7 +514,29 @@ class ContinuousBatcher:
     budget.  ``stats`` counts admissions, evictions, decoded chunks and
     the high-water mark of concurrently active lanes — the numbers the
     slot-reuse tests pin.
+
+    ``paged=True`` (infer/paged.py) swaps the per-lane contiguous KV
+    region for a global block pool + per-lane block tables with a radix
+    prefix cache: blocks allocate on demand as a lane's ``pos`` crosses
+    block boundaries, free when the lane retires, and admissions that
+    hit a cached prefix map those blocks read-only (CoW before the
+    first divergent write) and prefill only the suffix.  Greedy token
+    streams are BIT-IDENTICAL to the contiguous ring — ``paged=False``
+    is both the fallback and the parity oracle.  ``block_size`` sets
+    pool-block granularity (keep it at ops/decode_attention.py
+    DEFAULT_BLOCK_K on TPU so the paged kernel's key block IS the pool
+    block), ``num_blocks`` the pool size (default: contiguous-HBM
+    parity, slots * blocks-per-lane), ``prefix_cache=False`` disables
+    radix reuse (it is also off in speculative mode, where admission
+    must prefill the draft lane anyway).
     """
+
+    # a prefix hit with a LONGER divergent suffix admits through the
+    # cold scatter prefill instead: the suffix insert's per-row pool
+    # writes unroll O(rows) (paged._write_rows_paged), and past this
+    # many rows the block-granular cold path compiles and runs faster
+    # than what the cached prefix saves
+    SUFFIX_PREFILL_MAX_ROWS = 256
 
     def __init__(self, params: Any, cfg: LlamaConfig, *, slots: int = 8,
                  max_len: Optional[int] = None, chunk_tokens: int = 8,
@@ -525,7 +548,11 @@ class ContinuousBatcher:
                  draft_cfg: Optional[LlamaConfig] = None,
                  spec_k: int = 0,
                  max_queue: int = 0,
-                 queue_timeout: float = 5.0) -> None:
+                 queue_timeout: float = 5.0,
+                 paged: bool = False,
+                 block_size: int = 256,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True) -> None:
         # ``mesh`` (parallel/mesh.py make_serving_mesh): serve
         # tensor-parallel — params are laid out over tp once here, the
         # ring cache shards over the kv-head axis, and the resident
@@ -551,6 +578,33 @@ class ContinuousBatcher:
         self.buckets = tuple(sorted(prefill_buckets)) or _default_buckets(
             self.max_len)
         self._top_k, self._top_p = top_k, top_p
+        # paged mode (infer/paged.py): the per-lane contiguous KV region
+        # becomes a global block pool + per-lane block tables — blocks
+        # allocate on demand as each lane's pos crosses a block boundary
+        # and free when the lane retires, and completed-prefill blocks
+        # feed a radix prefix cache so shared prompts prefill ONCE.  The
+        # contiguous ring stays the paged path's parity oracle
+        # (SERVE_PAGED=0); greedy token streams are bit-identical.
+        self.paged = bool(paged)
+        self.pool: Optional[Any] = None
+        if self.paged:
+            from paddle_operator_tpu.infer import paged as PG
+
+            self._pg = PG
+            self.block_size = int(block_size)
+            # prefix reuse needs one canonical prefill per prefix;
+            # speculative admission prefills target AND draft, so the
+            # cache is disabled there (paging itself still applies)
+            self.pool = PG.PagedCacheManager(
+                slots, self.max_len, self.block_size, num_blocks,
+                prefix_cache=prefix_cache and not spec_k)
+            # prefill buckets scatter whole blocks: round each up to a
+            # block multiple, capped at the lane view
+            self.buckets = tuple(sorted(
+                {min(-(-b // self.block_size) * self.block_size,
+                     self.pool.view_len) for b in self.buckets}))
+            self._copy_block = PG.make_block_copier()
+            self._suffix_inserts: Dict[int, Any] = {}
         # speculative mode (spec_k > 0): the resident step becomes ONE
         # draft-propose + chunked-verify round (infer/speculative.py) —
         # per round every active lane advances by its OWN accept length
@@ -579,28 +633,53 @@ class ContinuousBatcher:
                     draft_params, draft_cfg, mesh)
             self.draft_params = draft_params
             self._spec_step = make_spec_round_fn(
-                cfg, draft_cfg, self.spec_k, top_k, top_p, mesh=mesh)
-            self._inserts = {b: make_spec_prefill_insert(
-                cfg, draft_cfg, b, top_k, top_p, mesh=mesh)
-                for b in self.buckets}
+                cfg, draft_cfg, self.spec_k, top_k, top_p, mesh=mesh,
+                paged=self.paged)
+            if self.paged:
+                # target prefill scatters into the pool; the DRAFT lane
+                # stays a contiguous splice (speculative.py docstring)
+                self._inserts = {b: self._pg.make_paged_spec_prefill_insert(
+                    cfg, draft_cfg, b, self.block_size, top_k, top_p,
+                    mesh=mesh) for b in self.buckets}
+            else:
+                self._inserts = {b: make_spec_prefill_insert(
+                    cfg, draft_cfg, b, top_k, top_p, mesh=mesh)
+                    for b in self.buckets}
             self.dcache = init_ring_cache(draft_cfg, slots, self.max_len,
                                           mesh=mesh)
         else:
             self.draft_params = None
             self.dcache = None
-            self._step = make_chunk_step(cfg, chunk_tokens, top_k, top_p,
-                                         mesh=mesh)
-            self._inserts = {b: make_prefill_insert(cfg, b, top_k, top_p,
-                                                    mesh=mesh)
-                             for b in self.buckets}
+            if self.paged:
+                self._step = self._pg.make_paged_chunk_step(
+                    cfg, chunk_tokens, top_k, top_p, mesh=mesh)
+                self._inserts = {b: self._pg.make_paged_prefill_insert(
+                    cfg, b, self.block_size, top_k, top_p, mesh=mesh)
+                    for b in self.buckets}
+            else:
+                self._step = make_chunk_step(cfg, chunk_tokens, top_k,
+                                             top_p, mesh=mesh)
+                self._inserts = {b: make_prefill_insert(cfg, b, top_k,
+                                                        top_p, mesh=mesh)
+                                 for b in self.buckets}
 
-        self.cache = init_ring_cache(cfg, slots, self.max_len, mesh=mesh)
+        if self.paged:
+            self.cache = self._pg.init_paged_cache(
+                cfg, slots, self.pool.total, self.block_size, mesh=mesh)
+        else:
+            self.cache = init_ring_cache(cfg, slots, self.max_len,
+                                         mesh=mesh)
         self.tok = jnp.zeros((slots,), jnp.int32)
         self.temp = jnp.zeros((slots,), jnp.float32)
         self.keys = jnp.zeros((slots, 2), jnp.uint32)
         self.lane: List[Optional[_Request]] = [None] * slots
         self._lane_out: List[List[int]] = [[] for _ in range(slots)]
         self._lane_left = [0] * slots
+        # host mirror of each lane's device fill position — set by
+        # admission, advanced at consume, ZEROED on eviction so
+        # serving_status never reports a retired lane's stale pos (and,
+        # paged, so on-demand block mapping tracks the true frontier)
+        self._lane_pos = [0] * slots
         # per-lane device future of the admission-sampled first token,
         # materialized at the next chunk consume (async admission)
         self._lane_first: List[Optional[jax.Array]] = [None] * slots
@@ -616,7 +695,12 @@ class ContinuousBatcher:
         self._stop = threading.Event()
         self.stats = {"admitted": 0, "evicted": 0, "chunks": 0,
                       "max_active": 0, "rejected_queue_full": 0,
-                      "spec_accepted": 0, "spec_drafted": 0}
+                      "spec_accepted": 0, "spec_drafted": 0,
+                      # prefill accounting: the prefix-cache acceptance
+                      # gate — a full prefix hit admits with ZERO
+                      # prefill forward passes over cached blocks
+                      "prefill_calls": 0, "prefill_tokens": 0,
+                      "cow_copies": 0}
         # served-token telemetry for serving_status(): cumulative emitted
         # tokens since construction (the /metrics tokens-per-sec gauge)
         self._tokens_emitted = 0
@@ -630,9 +714,17 @@ class ContinuousBatcher:
     def submit(self, prompt, *, max_new_tokens: int,
                temperature: float = 0.0, seed: int = 0,
                eos_token: Optional[int] = None,
-               stream: bool = False) -> _Request:
+               stream: bool = False,
+               request_id: Optional[str] = None) -> _Request:
         """Queue one generation request; returns a handle whose
         ``result()``/``stream()`` deliver the tokens.
+
+        ``request_id`` (optional, e.g. serve.py's per-row id) is woven
+        into every validation error so an operator reading a rejection
+        in a multi-request log knows WHICH request overflowed —
+        validation runs (and raises) BEFORE the host-side tokenize copy
+        and device transfer below, so a rejected request costs no
+        bandwidth.
 
         ``seed``: sampling seed with an effective range of [0, 2**31) —
         it rides into the compiled insert as an int32 traced argument.
@@ -642,27 +734,29 @@ class ContinuousBatcher:
         derives seed+i per row) is folded through a splitmix64 hash
         rather than truncated, so distinct wide seeds keep distinct
         streams (masking would collide s with s + 2**31)."""
-        prompt = list(map(int, prompt))
-        if not prompt:
-            raise ValueError("empty prompt")
+        rid = f" [request {request_id}]" if request_id is not None else ""
+        n = len(prompt)
+        if not n:
+            raise ValueError(f"empty prompt{rid}")
         if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
+            raise ValueError(f"max_new_tokens must be >= 1{rid}")
         if self._stop.is_set() or not self._thread.is_alive():
             raise RuntimeError("batcher closed")
-        if len(prompt) > self.buckets[-1]:
+        if n > self.buckets[-1]:
             raise ValueError(
-                f"prompt length {len(prompt)} exceeds the largest prefill "
-                f"bucket ({self.buckets[-1]})")
+                f"prompt length {n} exceeds the largest prefill "
+                f"bucket ({self.buckets[-1]}){rid}")
         if self.spec_k:
             # a verify round starting at the last in-budget position
             # (prompt + max_new - 2) writes rows through pos + spec_k,
             # so spec_k - 1 positions of headroom must exist past
             # prompt + max_new (infer/speculative.py has the derivation)
-            if len(prompt) + max_new_tokens + self.spec_k - 1 > self.max_len:
+            if n + max_new_tokens + self.spec_k - 1 > self.max_len:
                 raise ValueError(
-                    f"prompt ({len(prompt)}) + max_new_tokens "
+                    f"prompt ({n}) + max_new_tokens "
                     f"({max_new_tokens}) + speculative headroom "
-                    f"({self.spec_k - 1}) exceeds max_len ({self.max_len})")
+                    f"({self.spec_k - 1}) exceeds max_len "
+                    f"({self.max_len}){rid}")
         else:
             # the FIRST token is sampled from the prefill logits, so only
             # max_new-1 tokens ride chunk steps; the worst-case cache
@@ -670,10 +764,12 @@ class ContinuousBatcher:
             # (validating with ceil(max_new/chunk) rejected requests up
             # to chunk-1 tokens INSIDE capacity)
             budget = -(-(max_new_tokens - 1) // self.chunk) * self.chunk
-            if len(prompt) + budget > self.max_len:
+            if n + budget > self.max_len:
                 raise ValueError(
-                    f"prompt ({len(prompt)}) + chunk-rounded budget "
-                    f"({budget}) exceeds max_len ({self.max_len})")
+                    f"prompt ({n}) + chunk-rounded budget "
+                    f"({budget}) exceeds max_len ({self.max_len}){rid}")
+        # validation passed: NOW pay the tokenize copy
+        prompt = list(map(int, prompt))
         # int32-range seeds pass through untouched; wide/negative seeds
         # hash-fold (see docstring)
         seed = int(seed)
@@ -729,12 +825,24 @@ class ContinuousBatcher:
         (utils/observability.py serving_gauges)."""
         elapsed = max(1e-9, time.monotonic() - self._t_start)
         drafted = self.stats["spec_drafted"]
+        # per-lane visibility EXCLUDES retired lanes: _evict zeroes the
+        # host pos mirror (and the compiled step zeroes the device pos),
+        # so a freed lane can never leak its last request's fill
+        # position or tokens into the telemetry (test_serve_metrics)
         return {
             "tokensPerSec": round(self._tokens_emitted / elapsed, 2),
             "acceptRate": (round(self.stats["spec_accepted"] / drafted, 4)
                            if drafted else 0.0),
             "queueDepth": self._pending.qsize(),
             "tokensTotal": self._tokens_emitted,
+            "activeLanes": sum(r is not None for r in self.lane),
+            "lanePos": [int(p) for p in self._lane_pos],
+            "prefixHitRate": (self.pool.hit_rate() if self.pool is not None
+                              else 0.0),
+            "kvBlocksFree": (self.pool.blocks_free()
+                             if self.pool is not None else 0),
+            "kvBlocksHwm": (self.pool.stats["blocks_hwm"]
+                            if self.pool is not None else 0),
         }
 
     def close(self) -> None:
@@ -750,6 +858,21 @@ class ContinuousBatcher:
                 return b
         raise ValueError(f"no bucket fits prompt length {n}")
 
+    def _suffix_bucket(self, n: int) -> int:
+        """Compile bucket for a prefix-hit SUFFIX forward — sized
+        independently of the prompt buckets (whose smallest entry can
+        be prompt-sized: a 1-token suffix must not pay a 2048-row
+        forward).  Power-of-two ladder up to one block, then block
+        multiples; the compile set stays bounded by
+        log2(block_size) + SUFFIX_PREFILL_MAX_ROWS / block_size."""
+        cap = self.pool.view_len
+        b = 8
+        while b < min(n, self.block_size):
+            b *= 2
+        if b < n:
+            b = -(-n // self.block_size) * self.block_size
+        return min(b, cap)
+
     def _admit(self, slot: int, req: _Request) -> None:
         """Admission is ONE compiled dispatch and nothing else on the
         device path (make_prefill_insert does the splice, first-token
@@ -759,18 +882,25 @@ class ContinuousBatcher:
         served throughput.  The first token stays a device future,
         materialized at the next chunk consume
         (:meth:`_materialize_first`)."""
-        if self.spec_k:
+        n = len(req.prompt)
+        if self.paged:
+            first = self._admit_paged(slot, req)
+        elif self.spec_k:
             (self.cache, self.dcache, self.tok, self.temp, self.keys,
              first) = self._inserts[req.bucket](
                 self.params, self.draft_params, self.cache, self.dcache,
                 self.tok, self.temp, self.keys, req.dev_prompt,
-                len(req.prompt), slot, float(req.temperature), req.seed)
+                n, slot, float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
         else:
             self.cache, self.tok, self.temp, self.keys, first = \
                 self._inserts[req.bucket](
                     self.params, self.cache, self.tok, self.temp,
-                    self.keys, req.dev_prompt, len(req.prompt), slot,
+                    self.keys, req.dev_prompt, n, slot,
                     float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
         try:                            # ship the first token host-ward
             first.copy_to_host_async()  # early: TTFT then needs no
         except AttributeError:          # extra round-trip at consume
@@ -779,12 +909,74 @@ class ContinuousBatcher:
         self._lane_out[slot] = []
         self._lane_first[slot] = first
         self._lane_left[slot] = req.max_new
+        self._lane_pos[slot] = n
         self.stats["admitted"] += 1
         if req.max_new == 1:
             # degenerate budget: sync now and free the lane immediately
             # rather than riding a whole wasted chunk
             self._materialize_first(slot, req)
             self._evict(slot)
+
+    def _admit_paged(self, slot: int, req: _Request):
+        """Paged admission: map blocks (radix hits read-only, CoW'd
+        where the suffix will write, fresh for the rest), then ONE
+        compiled insert — the full-prompt scatter insert cold, the
+        suffix-only insert on a prefix hit.  A full prefix hit runs a
+        ONE-token forward (the first sampled token needs the last
+        prompt position's logits — logits are not cached, KV is) and
+        zero forwards over cached blocks; the prefill-call counters are
+        the tests' acceptance gate for that claim."""
+        n = len(req.prompt)
+        # max_suffix: beyond it a prefix hit is not worth taking — the
+        # suffix insert's per-row pool writes (paged._write_rows_paged)
+        # unroll O(rows), so a long divergent suffix admits faster
+        # through the cold block-granular scatter prefill; the
+        # allocator then maps fresh blocks instead of the cached ones
+        # (never written over) when spec mode is off
+        hit_len, cow = self.pool.admit(          # NoFreeBlocks -> req fails
+            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
+        for src, dst in cow:
+            self.cache["k"], self.cache["v"] = self._copy_block(
+                self.cache["k"], self.cache["v"], src, dst)
+        self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+        tbl_row = jnp.asarray(self.pool.table[slot])
+        if self.spec_k:
+            (self.cache, self.dcache, self.tok, self.temp, self.keys,
+             first) = self._inserts[req.bucket](
+                self.params, self.draft_params, self.cache, self.dcache,
+                tbl_row, self.tok, self.temp, self.keys, req.dev_prompt,
+                n, slot, float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+        elif hit_len:
+            suffix = req.prompt[hit_len:]
+            sb = self._suffix_bucket(len(suffix))
+            ins = self._suffix_inserts.get(sb)
+            if ins is None:
+                ins = self._pg.make_paged_suffix_insert(
+                    self.cfg, sb, self.block_size, self._top_k,
+                    self._top_p, mesh=self.mesh)
+                self._suffix_inserts[sb] = ins
+            padded = np.zeros((1, sb), np.int32)
+            padded[0, :len(suffix)] = suffix
+            self.cache, self.tok, self.temp, self.keys, first = ins(
+                self.params, self.cache, tbl_row, self.tok, self.temp,
+                self.keys, jnp.asarray(padded), len(suffix), hit_len,
+                slot, float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += len(suffix)
+        else:
+            self.cache, self.tok, self.temp, self.keys, first = \
+                self._inserts[req.bucket](
+                    self.params, self.cache, tbl_row, self.tok,
+                    self.temp, self.keys, req.dev_prompt, n, slot,
+                    float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+        # register this lane's full prompt blocks for future admissions
+        # (content is valid for any later dispatch — same device stream)
+        self.pool.publish(slot, req.prompt)
+        return first
 
     def _materialize_first(self, i: int, req: _Request) -> None:
         """Bring the admission-sampled first token to the host (the only
@@ -821,6 +1013,13 @@ class ContinuousBatcher:
         # inside its compiled insert.
         req = self.lane[slot]
         self.lane[slot] = None
+        self._lane_pos[slot] = 0        # retired lanes report no pos
+        if self.pool is not None:
+            # return the lane's blocks: published prompt blocks become
+            # reclaimable cache, private ones rejoin the free list; the
+            # zeroed table row routes any in-flight pipelined write for
+            # this lane into the trash block
+            self.pool.retire(slot)
         self.stats["evicted"] += 1
         if req is not None:
             # error-path evictions can race ahead of the first consume
@@ -868,6 +1067,9 @@ class ContinuousBatcher:
                 continue
             self._materialize_first(i, req)
             n = toks.shape[0] if counts is None else int(counts[i])
+            # the host fill-position mirror advances exactly like the
+            # device pos (chunk ticks, or the spec round's commit count)
+            self._lane_pos[i] += n
             if counts is not None:
                 self.stats["spec_drafted"] += self.spec_k
                 self.stats["spec_accepted"] += max(0, n - 1)
@@ -920,6 +1122,12 @@ class ContinuousBatcher:
                 except Exception as e:          # bad request: fail it only
                     self._finish(req, e)
                     self.lane[slot] = None
+                    self._lane_pos[slot] = 0
+                    if self.pool is not None:
+                        # admission may have mapped blocks before the
+                        # dispatch failed — unmap them (no-op when the
+                        # allocator itself rejected)
+                        self.pool.retire(slot)
 
             active_idx = [i for i, r in enumerate(self.lane)
                           if r is not None]
@@ -936,14 +1144,52 @@ class ContinuousBatcher:
             self.stats["max_active"] = max(self.stats["max_active"],
                                            len(active_idx))
 
+            tbl = None
+            if self.paged:
+                # on-demand block mapping: grow each active lane's table
+                # to cover this dispatch PLUS every chunk already in
+                # flight for it (the host pos mirror lags dispatched-
+                # but-unconsumed work; spec rounds advance a
+                # data-dependent 1..K+1, so the bound is the worst case).
+                # An UNDERSIZED pool (num_blocks oversubscription) can
+                # run dry mid-generation: only the lane that cannot
+                # grow fails — evicting it (its request resolves with
+                # the error) frees its blocks for the rest of the ring,
+                # which must keep serving.
+                advance = (self.spec_k + 1) if self.spec_k else self.chunk
+                for i in list(active_idx):
+                    inflight = sum(
+                        1 for chunk_reqs, _, _ in pending
+                        for j, r in chunk_reqs
+                        if j == i and r is self.lane[i])
+                    try:
+                        self.pool.ensure(
+                            i, self._lane_pos[i] + (inflight + 1) * advance)
+                    except self._pg.NoFreeBlocks as e:
+                        r = self.lane[i]
+                        if r is not None and r.error is None:
+                            r.error = e
+                        self._evict(i)
+                        active_idx.remove(i)
+                if not active_idx:
+                    continue        # every lane starved: retry the loop
+                tbl = self.pool.device_table()
             active = jnp.asarray(
                 [r is not None for r in self.lane], bool)
             # async dispatch: returns device futures immediately
             if self.spec_k:
+                spec_args = (self.params, self.draft_params, self.cache,
+                             self.dcache)
+                if self.paged:
+                    spec_args += (tbl,)
                 (self.cache, self.dcache, self.tok, toks_dev,
                  counts_dev) = self._spec_step(
-                    self.params, self.draft_params, self.cache,
-                    self.dcache, self.tok, self.temp, self.keys, active)
+                    *spec_args, self.tok, self.temp, self.keys, active)
+            elif self.paged:
+                self.cache, self.tok, toks_dev = self._step(
+                    self.params, self.cache, tbl, self.tok, self.temp,
+                    self.keys, active)
+                counts_dev = None
             else:
                 self.cache, self.tok, toks_dev = self._step(
                     self.params, self.cache, self.tok, self.temp,

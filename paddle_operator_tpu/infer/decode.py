@@ -405,6 +405,32 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
     return logits[:, 0], cache
 
 
+def paged_prefill(params: Dict[str, Any], cfg: LlamaConfig,
+                  tokens: jax.Array, pool_cache: Dict[str, jax.Array],
+                  table_row: jax.Array, *, block_size: Optional[int] = None,
+                  mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill a whole [1, bucket] prompt and write its KV into the
+    PAGED block pool (infer/paged.py) as block-aligned chunks at the
+    lane's ``table_row`` entries — the cold-admission half of paged
+    serving.  The forward itself is exactly :func:`prefill`'s (same
+    compiled ops — what keeps the paged ring's first token
+    bit-identical to the contiguous ring's); only the destination
+    changes: block ``j`` of the lane cache lands in pool block
+    ``table_row[j]``, pad blocks land wherever the table maps them
+    (the trash block when unmapped — exactness-with-padding,
+    block-granular).  Returns ([1, bucket, vocab] logits — the caller
+    samples at ``prompt_len - 1`` — and the pool cache with this
+    lane's position untouched (the caller's insert sets it)."""
+    from paddle_operator_tpu.infer.paged import _scatter_prompt_blocks
+
+    bs = block_size or pool_cache["k"].shape[3]
+    lane = init_cache(cfg, 1, tokens.shape[1])
+    logits, lane = _forward(cfg, params, tokens, lane, mesh=mesh)
+    k = _scatter_prompt_blocks(pool_cache["k"], lane["k"], table_row, bs)
+    v = _scatter_prompt_blocks(pool_cache["v"], lane["v"], table_row, bs)
+    return logits, {"k": k, "v": v, "pos": pool_cache["pos"]}
+
+
 def decode_step(params: Dict[str, Any], cfg: LlamaConfig,
                 token: jax.Array, cache: Dict[str, jax.Array],
                 mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:

@@ -1,0 +1,782 @@
+"""Paged KV cache + radix prefix reuse for the serving ring.
+
+The continuous-batching ring (infer/batcher.py) allocates one
+contiguous ``[L, slots, H_kv, max_len, D]`` KV region per lane and
+re-prefills every prompt from scratch: every resident lane pays
+worst-case ``max_len`` HBM whether it holds 40 tokens or 2000, and a
+fleet of requests sharing a 2k system prompt pays the same prefill over
+and over (BENCH_r05: TTFT ~279 ms at prompt 128; decode throughput
+2801 -> 1606 tok/s as cache_len grows 128 -> 2240).  This module is the
+vLLM/SGLang answer (PagedAttention, Kwon et al. SOSP'23; RadixAttention,
+Zheng et al. 2024) in this codebase's TPU-native terms:
+
+- **Block pool** ``[L, num_blocks, H_kv, block_size, D]`` plus per-lane
+  block tables ``[slots, max_blocks_per_lane]`` int32: lane KV is a
+  list of pool blocks, allocated on demand as the lane's ``pos``
+  crosses a block boundary and returned to a free list when the lane
+  retires.  Pool block 0 is a reserved TRASH block — freed lanes and
+  pad rows write there, so an in-flight pipelined chunk can never
+  corrupt a block that was re-allocated under it.
+- **Radix prefix cache** (host side): completed-prefill FULL blocks are
+  keyed by a rolling hash chain of their token prefix.  A new request
+  that hits a cached prefix maps those blocks READ-ONLY into its table
+  (refcounted) and prefills only the suffix — a shared system prompt
+  costs one prefill ever.  A partially-filled tail that matches the
+  prefix of a cached block maps that block too (zero prefill beyond the
+  mandatory last-token forward) and is **copied-on-write** before the
+  lane's first write lands in it.
+- **Kernel/fallback split**: on TPU the pallas decode kernel walks the
+  block table through its *index map*
+  (ops/decode_attention.py ``paged_decode_attention`` — blocks stream
+  straight from their pool rows, dead tails skipped); the XLA einsum
+  path gathers the lane view with one ``take`` per layer
+  (:func:`_gather_lane_view`) — the copy the kernel exists to avoid,
+  kept as the CPU/odd-shape fallback.
+- **Exactness**: greedy token streams are bit-identical to the
+  contiguous ring (the ``SERVE_PAGED=0`` fallback and parity oracle) —
+  the gathered/paged view presents the same values at every attendable
+  position and masked tail columns contribute exact zeros, the same
+  invariant the contiguous ring's pad rows already rely on.  Pinned by
+  tests/test_paged.py and the dryrun ``serve-paged`` line.
+
+Mesh/TP: the pool shards over its kv-head axis exactly like the ring
+cache (parallel/sharding.py kv_cache_sharding — the pool's axis 2);
+tables and lengths replicate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.infer import decode as D
+from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
+
+TRASH_BLOCK = 0
+
+
+class NoFreeBlocks(RuntimeError):
+    """The pool has no free block and no reclaimable (refcount-0)
+    cached block — admission/growth must fail loudly rather than
+    corrupt a mapped block."""
+
+
+# ---------------------------------------------------------------------------
+# Host side: block allocator + radix prefix cache
+# ---------------------------------------------------------------------------
+
+
+class _CacheEntry:
+    __slots__ = ("key", "block", "chunk", "parent", "freed_at")
+
+    def __init__(self, key, block, chunk, parent):
+        self.key = key
+        self.block = block
+        self.chunk = chunk        # the bs tokens this block's KV encodes
+        self.parent = parent      # chain key of the preceding block
+        self.freed_at: Optional[int] = None   # LRU clock at refcount 0
+
+
+class PagedCacheManager:
+    """Host-side truth for the pool: free list, per-block lane
+    refcounts, the per-slot block tables (numpy mirror shipped to the
+    device with every dispatch), and the radix prefix cache.
+
+    Block states partition the allocatable ids (1..num_blocks; 0 is the
+    trash block):
+
+    - **free**: on the free list;
+    - **mapped**: referenced by >= 1 lane table (``ref[b] > 0``) —
+      possibly ALSO cached (a published prompt block still in use);
+    - **cached**: in the radix cache at refcount 0 — reclaimable, LRU
+      by refcount-0 age when the free list runs dry.
+
+    ``check_invariant()`` asserts the partition exactly
+    (free + mapped + cached-only == num_blocks, refcounts == table
+    occurrences) — the leak/double-free gate the tests run across
+    admit/retire/cancel/CoW paths.
+    """
+
+    def __init__(self, slots: int, max_len: int, block_size: int,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True) -> None:
+        alloc = D.cache_alloc_len(max_len)
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1 (got {block_size})")
+        self.bs = int(block_size)
+        self.max_blocks = -(-alloc // self.bs)          # per-lane table width
+        self.view_len = self.max_blocks * self.bs       # gathered lane view
+        # default pool = contiguous-ring HBM parity: every lane can still
+        # reach max_len; the paging win is that lanes that DON'T leave
+        # the rest free (for more lanes, or for the prefix cache)
+        self.num_blocks = int(num_blocks or slots * self.max_blocks)
+        if self.num_blocks < self.max_blocks:
+            raise ValueError(
+                f"num_blocks ({self.num_blocks}) smaller than one lane's "
+                f"worst case ({self.max_blocks} blocks)")
+        self.total = self.num_blocks + 1                # + trash block 0
+        self.free: List[int] = list(range(self.total - 1, 0, -1))
+        self.ref = np.zeros((self.total,), np.int64)
+        self.table = np.zeros((slots, self.max_blocks), np.int32)
+        self.mapped_count = [0] * slots
+        self.prefix_cache = bool(prefix_cache)
+        self.entries: Dict[Any, _CacheEntry] = {}       # chain key -> entry
+        self.by_block: Dict[int, Any] = {}              # block -> chain key
+        self.children: Dict[Any, set] = {}              # parent key -> keys
+        self._tick = 0
+        self.stats = {
+            "prefix_lookup_tokens": 0, "prefix_hit_tokens": 0,
+            "prefix_lookups": 0, "prefix_full_hits": 0,
+            "cow_copies": 0, "cache_evictions": 0, "blocks_hwm": 0,
+        }
+
+    # -- allocation --------------------------------------------------------
+
+    def blocks_free(self) -> int:
+        return len(self.free)
+
+    def blocks_cached(self) -> int:
+        """Cached blocks currently reclaimable (refcount 0)."""
+        return sum(1 for e in self.entries.values()
+                   if self.ref[e.block] == 0)
+
+    def _alloc_one(self) -> int:
+        if not self.free:
+            self._evict_lru()
+        blk = self.free.pop()
+        used = self.num_blocks - len(self.free)
+        self.stats["blocks_hwm"] = max(self.stats["blocks_hwm"], used)
+        return blk
+
+    def _evict_lru(self) -> None:
+        """Reclaim ONE cached refcount-0 block, preferring leaves (no
+        cached children — evicting an inner node only strands its
+        subtree for later aging) and oldest refcount-0 age among them."""
+        victims = [e for e in self.entries.values()
+                   if self.ref[e.block] == 0]
+        if not victims:
+            raise NoFreeBlocks(
+                f"all {self.num_blocks} pool blocks are lane-mapped; "
+                "grow num_blocks or retire lanes first")
+        leaves = [e for e in victims
+                  if not self.children.get(e.key)]
+        pool = leaves or victims
+        victim = min(pool, key=lambda e: (e.freed_at
+                                          if e.freed_at is not None else 0))
+        self._drop_entry(victim)
+        self.free.append(victim.block)
+        self.stats["cache_evictions"] += 1
+
+    def _drop_entry(self, e: _CacheEntry) -> None:
+        del self.entries[e.key]
+        self.by_block.pop(e.block, None)
+        kids = self.children.get(e.parent)
+        if kids is not None:
+            kids.discard(e.key)
+            if not kids:
+                del self.children[e.parent]
+
+    def _release_block(self, blk: int) -> None:
+        """One lane unmaps ``blk``: decref; at 0 it either becomes a
+        reclaimable cached block (stamped with its LRU age) or goes
+        straight back to the free list."""
+        if blk == TRASH_BLOCK:
+            return
+        if self.ref[blk] <= 0:
+            raise AssertionError(f"double free of pool block {blk}")
+        self.ref[blk] -= 1
+        if self.ref[blk] == 0:
+            key = self.by_block.get(blk)
+            if key is not None:
+                self._tick += 1
+                self.entries[key].freed_at = self._tick
+            else:
+                self.free.append(blk)
+
+    # -- radix cache -------------------------------------------------------
+
+    @staticmethod
+    def _chain_key(parent, chunk: Tuple[int, ...]):
+        """Rolling key for one full block: hash-chained on the parent
+        key so equal chunks under different prefixes never collide; the
+        stored entry keeps the raw chunk, so a (vanishingly unlikely)
+        hash collision is caught by the equality check in lookup."""
+        return hash((parent, chunk))
+
+    def _lookup(self, tokens: Tuple[int, ...]):
+        """Walk the cached chain: full-block hits, then at most one
+        partial-tail hit (a cached child block whose chunk STARTS with
+        the remaining < bs tokens — mappable read-only, CoW'd before
+        the lane's first write into it).  Returns
+        (blocks, full_hit_tokens, used_partial)."""
+        bs = self.bs
+        blocks: List[int] = []
+        key = None
+        j = 0
+        n = len(tokens)
+        while (j + 1) * bs <= n:
+            chunk = tokens[j * bs:(j + 1) * bs]
+            k2 = self._chain_key(key, chunk)
+            e = self.entries.get(k2)
+            if e is None or e.chunk != chunk:
+                break
+            blocks.append(e.block)
+            key = k2
+            j += 1
+        hit = j * bs
+        partial = False
+        rem = tokens[j * bs:]
+        if rem and len(rem) < bs:
+            for ck in self.children.get(key, ()):
+                e = self.entries[ck]
+                if e.chunk[:len(rem)] == rem:
+                    blocks.append(e.block)
+                    hit += len(rem)
+                    partial = True
+                    break
+        return blocks, hit, partial
+
+    # -- lane lifecycle ----------------------------------------------------
+
+    def admit(self, slot: int, prompt,
+              max_suffix: Optional[int] = None
+              ) -> Tuple[int, List[Tuple[int, int]]]:
+        """Map blocks for a new lane: radix hits read-only (refcounted),
+        copy-on-write for any shared block the suffix/decode writes will
+        land in, fresh blocks for the rest of the prompt.  Returns
+        ``(hit_len, cow)`` — the usable prefix length (the suffix
+        ``prompt[hit_len:]`` still needs a forward; always >= 1 token,
+        since the first sampled token needs the last prompt position's
+        logits) and the [(src, dst)] block copies the caller must run
+        BEFORE the admission dispatch.
+
+        ``max_suffix``: a hit whose remaining suffix exceeds it is NOT
+        taken (fresh blocks throughout, hit_len 0) — the caller's
+        suffix forward may be worse than a cold prefill past some
+        width, and declining the hit up front means cached blocks are
+        never mapped into a lane that will scatter over them."""
+        tokens = tuple(int(t) for t in prompt)
+        n = len(tokens)
+        bs = self.bs
+        if self.mapped_count[slot]:
+            raise AssertionError(f"slot {slot} still holds blocks")
+        if self.prefix_cache:
+            hit_blocks, hit_full, _partial = self._lookup(tokens)
+            self.stats["prefix_lookups"] += 1
+            self.stats["prefix_lookup_tokens"] += n
+            if (max_suffix is not None
+                    and n - min(hit_full, n - 1) > max_suffix):
+                hit_blocks, hit_full = [], 0
+        else:
+            hit_blocks, hit_full = [], 0
+        hit_len = min(hit_full, n - 1)
+        self.stats["prefix_hit_tokens"] += hit_len
+        if hit_len and hit_len == n - 1 and hit_full >= n:
+            self.stats["prefix_full_hits"] += 1
+
+        row = self.table[slot]
+        try:
+            for j, blk in enumerate(hit_blocks):
+                row[j] = blk
+                self.ref[blk] += 1
+                self.mapped_count[slot] = j + 1
+            # CoW: every shared block at/after the first written block
+            # (index hit_len // bs) gets a private copy — by
+            # construction that is at most the last hit block
+            cow: List[Tuple[int, int]] = []
+            first_write_blk = hit_len // bs
+            for j in range(first_write_blk, len(hit_blocks)):
+                src = int(row[j])
+                dst = self._alloc_one()
+                self.ref[dst] += 1
+                self._release_block(src)
+                row[j] = dst
+                cow.append((src, dst))
+                self.stats["cow_copies"] += 1
+            # fresh blocks for the rest of the prompt
+            need = -(-n // bs)
+            while self.mapped_count[slot] < need:
+                blk = self._alloc_one()
+                self.ref[blk] += 1
+                row[self.mapped_count[slot]] = blk
+                self.mapped_count[slot] += 1
+        except NoFreeBlocks:
+            self.retire(slot)
+            raise
+        return hit_len, cow
+
+    def publish(self, slot: int, prompt) -> None:
+        """Register the lane's FULL prompt blocks in the radix cache
+        (called once the admission prefill is dispatched — later
+        readers are later dispatches on the same stream, so they
+        observe the written blocks).  Blocks already cached under the
+        same key are left alone (a racing lane prefilled the same
+        prefix — its copy stays canonical)."""
+        if not self.prefix_cache:
+            return
+        tokens = tuple(int(t) for t in prompt)
+        bs = self.bs
+        key = None
+        for j in range(len(tokens) // bs):
+            chunk = tokens[j * bs:(j + 1) * bs]
+            k2 = self._chain_key(key, chunk)
+            e = self.entries.get(k2)
+            if e is None:
+                blk = int(self.table[slot, j])
+                if blk != TRASH_BLOCK and blk not in self.by_block:
+                    self.entries[k2] = _CacheEntry(k2, blk, chunk, key)
+                    self.by_block[blk] = k2
+                    self.children.setdefault(key, set()).add(k2)
+            key = k2
+
+    def ensure(self, slot: int, pos_needed: int) -> None:
+        """Grow the lane's table so blocks cover positions
+        [0, pos_needed) — the on-demand allocation the decode loop runs
+        before each dispatch as ``pos`` approaches a block boundary.
+        Capped at the lane view; overshoot rows (pipelined chunks past
+        the budget) self-route to the trash block / the lane's own last
+        block and are discarded with the lane."""
+        need = min(-(-int(pos_needed) // self.bs), self.max_blocks)
+        row = self.table[slot]
+        while self.mapped_count[slot] < need:
+            blk = self._alloc_one()
+            self.ref[blk] += 1
+            row[self.mapped_count[slot]] = blk
+            self.mapped_count[slot] += 1
+
+    def retire(self, slot: int) -> None:
+        """Lane done (eos/budget/cancel/error): unmap every block —
+        published ones become reclaimable cache, private ones go back
+        to the free list — and zero the table row so any in-flight
+        pipelined chunk writes land in the trash block."""
+        row = self.table[slot]
+        for j in range(self.mapped_count[slot]):
+            self._release_block(int(row[j]))
+        row[:] = TRASH_BLOCK
+        self.mapped_count[slot] = 0
+
+    def device_table(self) -> jax.Array:
+        return jnp.asarray(self.table)
+
+    # -- accounting --------------------------------------------------------
+
+    def hit_rate(self) -> float:
+        lk = self.stats["prefix_lookup_tokens"]
+        return round(self.stats["prefix_hit_tokens"] / lk, 4) if lk else 0.0
+
+    def check_invariant(self) -> None:
+        """free + mapped + cached-only == num_blocks, with refcounts
+        exactly equal to table occurrences and no id in two states."""
+        free = set(self.free)
+        assert len(free) == len(self.free), "free list holds duplicates"
+        assert TRASH_BLOCK not in free, "trash block leaked to free list"
+        occurrences: Dict[int, int] = {}
+        for row in self.table:
+            for blk in row:
+                if blk != TRASH_BLOCK:
+                    occurrences[int(blk)] = occurrences.get(int(blk), 0) + 1
+        for blk, cnt in occurrences.items():
+            assert self.ref[blk] == cnt, \
+                f"block {blk}: ref {self.ref[blk]} != {cnt} table uses"
+            assert blk not in free, f"block {blk} mapped AND free"
+        mapped = set(occurrences)
+        for blk in range(1, self.total):
+            if self.ref[blk] and blk not in mapped:
+                raise AssertionError(f"block {blk} refcounted but unmapped")
+        cached_only = {e.block for e in self.entries.values()
+                       if self.ref[e.block] == 0}
+        assert not (cached_only & free), "cached block on the free list"
+        assert len(free) + len(mapped) + len(cached_only) \
+            == self.num_blocks, (
+            f"pool partition broken: {len(free)} free + {len(mapped)} "
+            f"mapped + {len(cached_only)} cached != {self.num_blocks}")
+
+
+# ---------------------------------------------------------------------------
+# Device side: pool init, writes, gather view, forwards
+# ---------------------------------------------------------------------------
+
+
+def init_paged_cache(cfg: LlamaConfig, slots: int, total_blocks: int,
+                     block_size: int, mesh=None) -> Dict[str, jax.Array]:
+    """The paged ring state: k/v pools [L, total_blocks, H_kv, bs, D]
+    (kv-head-sharded under a serving mesh, like the ring cache) plus
+    the per-lane fill position vector.  ``total_blocks`` INCLUDES the
+    trash block (PagedCacheManager.total)."""
+    shape = (cfg.n_layers, total_blocks, cfg.n_kv_heads, block_size,
+             cfg.head_dim)
+    return {
+        "k": D.alloc_kv_buffer(cfg, shape, mesh),
+        "v": D.alloc_kv_buffer(cfg, shape, mesh),
+        "pos": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def _write_token_paged(pool: jax.Array, kv: jax.Array, li: jax.Array,
+                       table: jax.Array, pos: jax.Array,
+                       block_size: int) -> jax.Array:
+    """[L, N, H, bs, D] pool <- [B, H, 1, D] new rows, lane b's row at
+    pool block ``table[b, pos_b // bs]`` offset ``pos_b % bs``.  Static
+    unroll over lanes for the same reason as batcher._write_lane_stacked
+    (a vmapped ragged update lowers to a carry-copying scatter)."""
+    for lane in range(kv.shape[0]):
+        blk = table[lane, pos[lane] // block_size]
+        pool = jax.lax.dynamic_update_slice(
+            pool, kv[lane][None, None],
+            (li, blk, 0, pos[lane] % block_size, 0))
+    return pool
+
+
+def _write_rows_paged(pool: jax.Array, kv: jax.Array, li: jax.Array,
+                      table: jax.Array, pos: jax.Array, block_size: int,
+                      limit: Optional[jax.Array] = None) -> jax.Array:
+    """[L, N, H, bs, D] pool <- [B, H, T, D] rows at per-lane start
+    positions ``pos`` — rows land in whatever pool block the table maps
+    for their absolute position (a row span may straddle blocks; every
+    row is placed independently).  Rows at/after ``limit`` (per-lane;
+    suffix-prefill pads) are redirected to the trash block instead of
+    being masked out — the unroll stays branch-free."""
+    b, _, t, _ = kv.shape
+    for lane in range(b):
+        for j in range(t):
+            p = pos[lane] + j
+            blk = table[lane, p // block_size]
+            if limit is not None:
+                blk = jnp.where(p < limit[lane], blk, TRASH_BLOCK)
+            pool = jax.lax.dynamic_update_slice(
+                pool, kv[lane, :, j][None, None, :, None, :],
+                (li, blk, 0, p % block_size, 0))
+    return pool
+
+
+def _gather_lane_view(pool: jax.Array, table: jax.Array,
+                      li: jax.Array) -> jax.Array:
+    """XLA ``take`` fallback view: pool layer ``li`` gathered through
+    the block tables into the contiguous [B, H, M*bs, D] layout the
+    einsum attention expects.  This is a materialized copy per layer —
+    exactly what the paged kernel's table-driven index map avoids — and
+    exists for the CPU / odd-shape / GSPMD-einsum paths."""
+    layer = jax.lax.dynamic_index_in_dim(pool, li, 0, keepdims=False)
+    b, m = table.shape
+    _, h, bs, d = layer.shape
+    v = jnp.take(layer, table.reshape(-1), axis=0)      # [B*M, H, bs, D]
+    v = v.reshape(b, m, h, bs, d).transpose(0, 2, 1, 3, 4)
+    return v.reshape(b, h, m * bs, d)
+
+
+def _attend_einsum(cfg: LlamaConfig, q: jax.Array, k_view: jax.Array,
+                   v_view: jax.Array, pos: jax.Array) -> jax.Array:
+    """batcher._layer_step's attention block, lifted so the paged
+    forward runs the IDENTICAL einsum/mask/softmax op sequence over the
+    gathered view — columns [0, pos_b] hold the same values as the
+    contiguous ring, masked tail columns contribute exact zeros, so
+    greedy streams stay bit-identical to the oracle."""
+    b = q.shape[0]
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    n_rep = hq // hkv
+    s = k_view.shape[2]
+    qg = q.reshape(b, 1, hkv, n_rep, d)
+    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_view,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    mask = jnp.arange(s)[None, :] <= pos[:, None]        # [B, S]
+    scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
+                     v_view, preferred_element_type=jnp.float32)
+    return out.reshape(b, 1, hq * d).astype(cfg.dtype)
+
+
+def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
+                       tok: jax.Array, cache: Dict[str, jax.Array],
+                       table: jax.Array, mesh=None
+                       ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """batcher._ring_forward over the paged pool: tok [B] at per-lane
+    cache['pos'] -> (logits [B, V], advanced cache).  The pools ride
+    the layer scan as CARRY (block ids are dynamic; slicing a layer out
+    per step would materialize it anyway), the kernel path hands the
+    stacked pools + table to paged_decode_attention, the einsum path
+    gathers the lane view per layer."""
+    from paddle_operator_tpu.infer.batcher import _qkv_ring
+
+    pos = cache["pos"]
+    block_size = cache["k"].shape[3]
+    x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tok[:, None]]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+
+    attn_impl = cfg.resolved_decode_attn()
+    use_sharded = D._use_sharded_kernel(cfg, mesh, attn_impl)
+    if D.mesh_tp(mesh) > 1 and not use_sharded:
+        attn_impl = "xla"
+    if use_sharded:
+        from paddle_operator_tpu.ops.decode_attention import (
+            sharded_paged_decode_attention,
+        )
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc = _write_token_paged(kc, k.transpose(0, 2, 1, 3), li,
+                                    table, pos, block_size)
+            vc = _write_token_paged(vc, v.transpose(0, 2, 1, 3), li,
+                                    table, pos, block_size)
+            proj = sharded_paged_decode_attention(
+                mesh, q[:, 0], kc, vc, table, pos + 1,
+                lp["attn"]["wo"]["kernel"], layer=li,
+                interpret=(attn_impl == "pallas-interpret"),
+                compute_dtype=cfg.dtype)
+            x = x + proj[:, None].astype(cfg.dtype)
+            return (D._ffn_residual(cfg, lp, x), kc, vc), ()
+    elif attn_impl != "xla":
+        from paddle_operator_tpu.ops.decode_attention import (
+            paged_decode_attention,
+        )
+
+        b = x.shape[0]
+        hq, d = cfg.n_heads, cfg.head_dim
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc = _write_token_paged(kc, k.transpose(0, 2, 1, 3), li,
+                                    table, pos, block_size)
+            vc = _write_token_paged(vc, v.transpose(0, 2, 1, 3), li,
+                                    table, pos, block_size)
+            out = paged_decode_attention(
+                q[:, 0], kc, vc, table, pos + 1, layer=li,
+                interpret=(attn_impl == "pallas-interpret"))
+            out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+            return (D._finish_layer(cfg, lp, x, out), kc, vc), ()
+    else:
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc = _write_token_paged(kc, k.transpose(0, 2, 1, 3), li,
+                                    table, pos, block_size)
+            vc = _write_token_paged(vc, v.transpose(0, 2, 1, 3), li,
+                                    table, pos, block_size)
+            out = _attend_einsum(cfg, q,
+                                 _gather_lane_view(kc, table, li),
+                                 _gather_lane_view(vc, table, li), pos)
+            return (D._finish_layer(cfg, lp, x, out), kc, vc), ()
+
+    (x, k_new, v_new), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    logits = D._mm(x, params["lm_head"]["kernel"],
+                   cfg.dtype).astype(jnp.float32)
+    return logits[:, 0], {"k": k_new, "v": v_new, "pos": pos + 1}
+
+
+def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
+                          top_k: Optional[int] = None,
+                          top_p: Optional[float] = None, mesh=None):
+    """The resident compiled decode program of the PAGED ring — the
+    exact contract of batcher.make_chunk_step plus the block table:
+
+    ``step(params, cache, table, tok, temp, keys, active)
+    -> (cache', tok', toks [chunk, B])``
+
+    Retired/inactive lanes additionally get their position ZEROED (the
+    serving-status staleness fix) — their writes route to the trash
+    block through the zeroed table row, so nothing they do can touch a
+    re-allocated block."""
+    from paddle_operator_tpu.infer.batcher import _sample_tokens
+
+    def step(params, cache, table, tok, temp, keys, active):
+        def tick(carry, _):
+            cache, tok = carry
+            logits, new_cache = paged_ring_forward(cfg, params, tok, cache,
+                                                   table, mesh=mesh)
+            nxt = _sample_tokens(logits, temp, keys, cache["pos"],
+                                 top_k, top_p)
+            new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
+            nxt = jnp.where(active, nxt, tok)
+            return (new_cache, nxt), nxt
+
+        (cache, tok), toks = jax.lax.scan(
+            tick, (cache, tok), None, length=chunk_tokens)
+        return cache, tok, toks
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def _scatter_prompt_blocks(pool: jax.Array, lane: jax.Array,
+                           table_row: jax.Array,
+                           block_size: int) -> jax.Array:
+    """Write a contiguous [L, 1, H, bucket, D] prefilled lane cache
+    into the pool as block-aligned chunks at the lane's table entries.
+    Pad blocks past the real prompt scatter into whatever the table
+    maps there — the trash block for unmapped entries, a future decode
+    block otherwise, where every row is overwritten before it becomes
+    attendable (the contiguous ring's exactness-with-padding story,
+    block-granular)."""
+    bucket = lane.shape[3]
+    for j in range(bucket // block_size):
+        blk = jax.lax.slice_in_dim(lane, j * block_size,
+                                   (j + 1) * block_size, axis=3)
+        pool = jax.lax.dynamic_update_slice(
+            pool, blk, (0, table_row[j], 0, 0, 0))
+    return pool
+
+
+def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
+                              block_size: int,
+                              top_k: Optional[int] = None,
+                              top_p: Optional[float] = None, mesh=None):
+    """Cold (no prefix hit) paged admission — the contiguous
+    make_prefill_insert with the splice replaced by a block scatter.
+    The prefill forward and first-token sample are the SAME compiled
+    ops as the contiguous insert, which is what makes the first token
+    bit-identical between the two rings.
+
+    ``insert(params, cache, table_row, tok, temp, keys,
+    prompt [1,bucket], prompt_len, slot, temp_val, seed)
+    -> (cache', tok', temp', keys', first_token)``
+    """
+    from paddle_operator_tpu.infer.batcher import _sample_tokens
+
+    if bucket % block_size:
+        raise ValueError(f"prefill bucket {bucket} not a multiple of the "
+                         f"block size {block_size}")
+
+    def insert(params, cache, table_row, tok, temp, keys, prompt,
+               prompt_len, slot, temp_val, seed):
+        logits, new_cache = D.paged_prefill(params, cfg, prompt, cache,
+                                            table_row,
+                                            block_size=block_size,
+                                            mesh=mesh)
+        logits = logits[0, prompt_len - 1]
+        new_cache["pos"] = new_cache["pos"].at[slot].set(prompt_len)
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(1, 3, 4, 5))
+
+
+def make_paged_suffix_insert(cfg: LlamaConfig, suffix_bucket: int,
+                             block_size: int,
+                             top_k: Optional[int] = None,
+                             top_p: Optional[float] = None, mesh=None):
+    """Prefix-HIT paged admission: the lane's table already maps the
+    cached prefix blocks (read-only; CoW'd where the suffix will
+    write), so the forward runs over the SUFFIX ONLY — a multi-token
+    per-lane-offset forward (speculative._multi_forward_paged) whose
+    attention walks the block table.  A shared 2048-token system prompt
+    costs its followers exactly the suffix; the prefill-call counter
+    the tests assert on never ticks for the cached prefix.
+
+    ``insert(params, cache, table_row [M], tok, temp, keys,
+    suffix [1, suffix_bucket], suffix_len, hit_len, slot, temp_val,
+    seed) -> (cache', tok', temp', keys', first_token)``
+    """
+    from paddle_operator_tpu.infer.batcher import _sample_tokens
+    from paddle_operator_tpu.infer.speculative import _multi_forward_paged
+
+    def insert(params, cache, table_row, tok, temp, keys, suffix,
+               suffix_len, hit_len, slot, temp_val, seed):
+        prompt_len = hit_len + suffix_len
+        lane_cache = {"k": cache["k"], "v": cache["v"],
+                      "pos": jnp.reshape(hit_len, (1,))}
+        logits, new_lane = _multi_forward_paged(
+            cfg, params, suffix, lane_cache, table_row[None, :],
+            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh)
+        logits = logits[0, suffix_len - 1]
+        new_cache = {"k": new_lane["k"], "v": new_lane["v"],
+                     "pos": cache["pos"].at[slot].set(prompt_len)}
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(1, 3, 4, 5))
+
+
+def make_paged_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
+                                   bucket: int, block_size: int,
+                                   top_k: Optional[int] = None,
+                                   top_p: Optional[float] = None,
+                                   mesh=None):
+    """Speculative paged admission: target prefill scatters into the
+    pool, the DRAFT lane stays a contiguous ring splice (the draft
+    cache is small — paging it buys nothing, and the draft's propose
+    loop keeps the fast contiguous write path).
+
+    ``insert(params, dparams, cache, dcache, table_row, tok, temp,
+    keys, prompt, prompt_len, slot, temp_val, seed)
+    -> (cache', dcache', tok', temp', keys', first_token)``
+    """
+    from paddle_operator_tpu.infer.batcher import (
+        _sample_tokens,
+        _splice_lane,
+    )
+
+    if bucket % block_size:
+        raise ValueError(f"prefill bucket {bucket} not a multiple of the "
+                         f"block size {block_size}")
+
+    def insert(params, dparams, cache, dcache, table_row, tok, temp, keys,
+               prompt, prompt_len, slot, temp_val, seed):
+        logits, new_cache = D.paged_prefill(params, cfg, prompt, cache,
+                                            table_row,
+                                            block_size=block_size,
+                                            mesh=mesh)
+        logits = logits[0, prompt_len - 1]
+        new_cache["pos"] = new_cache["pos"].at[slot].set(prompt_len)
+        dlane = D.init_cache(dcfg, 1, bucket)
+        _, dlane = D._forward(dcfg, dparams, prompt, dlane,
+                              last_only=True, mesh=mesh)
+        new_dcache = _splice_lane(dcache, dlane, slot, prompt_len)
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache, new_dcache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(2, 3, 5, 6, 7))
+
+
+@functools.lru_cache(maxsize=4)
+def make_block_copier():
+    """The CoW device op: copy pool block ``src`` over block ``dst``
+    (all layers, K and V) in one donated jit — dispatched once per
+    copy-on-write admission, BEFORE the admission insert, so the
+    insert's gather reads the private copy."""
+
+    def cp(k, v, src, dst):
+        ks = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
+        vs = jax.lax.dynamic_slice_in_dim(v, src, 1, axis=1)
+        k = jax.lax.dynamic_update_slice_in_dim(k, ks, dst, axis=1)
+        v = jax.lax.dynamic_update_slice_in_dim(v, vs, dst, axis=1)
+        return k, v
+
+    return jax.jit(cp, donate_argnums=(0, 1))

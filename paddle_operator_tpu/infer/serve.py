@@ -26,7 +26,11 @@ Two modes:
   ``SERVE_SPEC_K > 0`` the ring decodes SPECULATIVELY (docs/serving.md):
   a draft model proposes K tokens per round, the target verifies them
   in one chunked forward, and every response carries its measured
-  ``accept_rate``.
+  ``accept_rate``.  With ``SERVE_PAGED=1`` the ring's KV lives in a
+  block pool with radix prefix reuse (infer/paged.py): requests
+  sharing a cached prompt prefix skip its prefill entirely, and the
+  ``status.serving`` block gains ``prefixHitRate``/``kvBlocksFree``
+  for the manager's /metrics gauges.
 """
 
 from __future__ import annotations
@@ -120,10 +124,13 @@ class ContinuousGenerator:
                       temperature: float = 0.0,
                       top_k: Optional[int] = None,
                       top_p: Optional[float] = None,
-                      eos_token: Optional[int] = None, seed: int = 0):
+                      eos_token: Optional[int] = None, seed: int = 0,
+                      request_id: Optional[str] = None):
         """Rows + per-row speculative accept rates (None entries when
         the ring is not speculative) — the handler surfaces the rates
-        per response when SERVE_SPEC_K is on."""
+        per response when SERVE_SPEC_K is on.  ``request_id`` (the
+        client's, or the handler's fallback) is threaded into
+        ``submit`` per row so capacity rejections name the offender."""
         if (top_k, top_p) != (self.batcher._top_k, self.batcher._top_p) \
                 and (top_k is not None or top_p is not None):
             raise ValueError(
@@ -136,7 +143,9 @@ class ContinuousGenerator:
                 reqs.append(self.batcher.submit(
                     row, max_new_tokens=max_new_tokens,
                     temperature=temperature, seed=seed + i,
-                    eos_token=eos_token))
+                    eos_token=eos_token,
+                    request_id=(f"{request_id}/row{i}"
+                                if request_id is not None else None)))
             # ragged rows: sequences stop at eos, no rectangular array
             rows = [r.result(timeout=600) for r in reqs]
         except Exception:
@@ -204,7 +213,7 @@ class _Handler(BaseHTTPRequestHandler):
             tokens[0], max_new_tokens=int(req.get("max_new_tokens", 32)),
             temperature=float(req.get("temperature", 0.0)),
             seed=int(req.get("seed", 0)), eos_token=req.get("eos_token"),
-            stream=True)
+            stream=True, request_id=req.get("request_id"))
 
         def emit(obj) -> None:
             body = json.dumps(obj).encode() + b"\n"
@@ -267,11 +276,16 @@ class _Handler(BaseHTTPRequestHandler):
                 eos_token=req.get("eos_token"),
                 seed=int(req.get("seed", 0)))
             gen = self.generator
-            if (isinstance(gen, ContinuousGenerator)
-                    and getattr(gen.batcher, "spec_k", 0) > 0):
-                # speculative ring: acceptance rate rides every response
-                rows, rates = gen.generate_rows(tokens, **opts)
-                self._send(200, {"tokens": rows, "accept_rate": rates})
+            if isinstance(gen, ContinuousGenerator):
+                # request_id (client-supplied) flows into submit so
+                # validation errors in multi-request logs name their row
+                rows, rates = gen.generate_rows(
+                    tokens, request_id=req.get("request_id"), **opts)
+                if getattr(gen.batcher, "spec_k", 0) > 0:
+                    # speculative ring: acceptance rides every response
+                    self._send(200, {"tokens": rows, "accept_rate": rates})
+                else:
+                    self._send(200, {"tokens": rows})
                 return
             out = gen(tokens, **opts)
             out = out if isinstance(out, list) else out.tolist()
@@ -353,6 +367,22 @@ def main() -> int:
                                                    "0"))}
         if os.environ.get("SERVE_MAX_LEN"):
             ring_kw["max_len"] = int(os.environ["SERVE_MAX_LEN"])
+        # SERVE_PAGED=1: block-pool KV cache + radix prefix reuse
+        # (infer/paged.py; docs/serving.md has the layout/eviction/CoW
+        # story).  SERVE_BLOCK_SIZE sets pool-block granularity (keep
+        # at the decode kernel's key block, 256, on TPU);
+        # SERVE_PREFIX_CACHE=0 disables radix reuse while keeping
+        # paging; SERVE_NUM_BLOCKS oversizes/undersizes the pool from
+        # its contiguous-HBM-parity default.  SERVE_PAGED=0 (default)
+        # keeps the contiguous ring — the parity oracle.
+        if os.environ.get("SERVE_PAGED", "0") == "1":
+            ring_kw["paged"] = True
+            ring_kw["block_size"] = int(
+                os.environ.get("SERVE_BLOCK_SIZE", "256"))
+            ring_kw["prefix_cache"] = os.environ.get(
+                "SERVE_PREFIX_CACHE", "1") == "1"
+            if os.environ.get("SERVE_NUM_BLOCKS"):
+                ring_kw["num_blocks"] = int(os.environ["SERVE_NUM_BLOCKS"])
         if spec_k > 0:
             # SERVE_SPEC_K=K: speculative decoding through the ring.
             # SERVE_DRAFT names the draft config — "auto" derives the

@@ -161,6 +161,95 @@ def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
     return logits, {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
 
 
+def _layer_multi_paged(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+                       cos: jax.Array, sin: jax.Array, k_pool: jax.Array,
+                       v_pool: jax.Array, li: jax.Array, table: jax.Array,
+                       pos: jax.Array, limit: Optional[jax.Array]
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`_layer_multi` over the PAGED pool (infer/paged.py): new
+    rows land in whatever pool block the lane's table maps for their
+    absolute position (rows past ``limit`` route to the trash block —
+    suffix-prefill pads), and the attention walks the table through the
+    gathered lane view.  Same einsum/mask sequence as the contiguous
+    verify, so greedy paged-vs-contiguous streams stay bit-identical."""
+    from paddle_operator_tpu.infer.paged import (
+        _gather_lane_view,
+        _write_rows_paged,
+    )
+
+    b, t, _ = x.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
+    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    abs_pos = pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
+    cos_b = cos[abs_pos][:, :, None, :]
+    sin_b = sin[abs_pos][:, :, None, :]
+
+    def rot(u):
+        u1, u2 = jnp.split(u.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [u1 * cos_b - u2 * sin_b, u2 * cos_b + u1 * sin_b],
+            axis=-1).astype(u.dtype)
+
+    q, k = rot(q), rot(k)
+    block_size = k_pool.shape[3]
+    k_pool = _write_rows_paged(k_pool, k.transpose(0, 2, 1, 3), li,
+                               table, pos, block_size, limit)
+    v_pool = _write_rows_paged(v_pool, v.transpose(0, 2, 1, 3), li,
+                               table, pos, block_size, limit)
+    k_view = _gather_lane_view(k_pool, table, li)
+    v_view = _gather_lane_view(v_pool, table, li)
+
+    n_rep = hq // hkv
+    s = k_view.shape[2]
+    qg = q.reshape(b, t, hkv, n_rep, d)
+    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_view,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    mask = jnp.arange(s)[None, None, :] <= abs_pos[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
+                     v_view, preferred_element_type=jnp.float32)
+    out = out.reshape(b, t, hq * d).astype(cfg.dtype)
+    return D._finish_layer(cfg, lp, x, out), k_pool, v_pool
+
+
+def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
+                         toks: jax.Array, cache: Dict[str, jax.Array],
+                         table: jax.Array,
+                         limit: Optional[jax.Array] = None,
+                         mesh=None
+                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """:func:`_multi_forward` with the target cache PAGED: the
+    chunked-verify (and paged suffix-prefill) forward whose writes and
+    attention walk the block table.  ``table`` [B, M] int32;
+    ``limit`` [B] (optional) bounds real rows per lane — pads beyond it
+    write to the trash block.  The pools ride the layer scan as carry
+    (block ids are dynamic)."""
+    pos = cache["pos"]
+    x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+
+    def body(carry, layer_in):
+        x, kc, vc = carry
+        lp, li = layer_in
+        y, kc, vc = _layer_multi_paged(cfg, lp, x, cos, sin, kc, vc, li,
+                                       table, pos, limit)
+        return (y, kc, vc), ()
+
+    (x, k_new, v_new), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    logits = D._mm(x, params["lm_head"]["kernel"],
+                   cfg.dtype).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
+
+
 # ---------------------------------------------------------------------------
 # The speculative round: propose K, verify K+1, commit a+1, rewind
 # ---------------------------------------------------------------------------
@@ -168,7 +257,8 @@ def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
 def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
                        top_k: Optional[int] = None,
-                       top_p: Optional[float] = None, mesh=None):
+                       top_p: Optional[float] = None, mesh=None,
+                       paged: bool = False):
     """One jitted speculative round over ring-style caches (per-lane
     ``pos`` vectors), BOTH caches donated.
 
@@ -179,14 +269,23 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
     ``tok`` is the per-lane carry token — committed but not yet in
     either cache.  ``committed[:n_commit[b], b]`` are lane b's newly
     committed tokens this round (accepted drafts then the
-    correction/bonus token); inactive lanes freeze entirely
-    (n_commit 0, pos unchanged, tok unchanged) so the compiled program
-    is one shape for every arrival/accept pattern."""
+    correction/bonus token); inactive lanes freeze their output
+    (n_commit 0, tok unchanged; their pos is zeroed — retired-lane
+    hygiene) so the compiled program is one shape for every
+    arrival/accept pattern.
+
+    ``paged=True``: the TARGET cache is the paged block pool
+    (infer/paged.py) — the round signature gains the block table after
+    the caches (``round(params, dparams, tcache, dcache, table, ...)``)
+    and the verify forward walks it (:func:`_multi_forward_paged`).
+    The DRAFT cache stays a contiguous ring either way: its propose
+    loop keeps the fast contiguous write path and pays no paging."""
     from paddle_operator_tpu.infer.batcher import _ring_forward
 
     kk = spec_k
 
-    def round_fn(params, dparams, tcache, dcache, tok, temp, keys, active):
+    def _round(params, dparams, tcache, dcache, tok, temp, keys, active,
+               table):
         b = tok.shape[0]
         tpos0, dpos0 = tcache["pos"], dcache["pos"]
         # decoupled sampling streams: draft draws, acceptance uniforms
@@ -218,8 +317,16 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
         q = jnp.transpose(qdists[:kk], (1, 0, 2))            # [B, K, V]
 
         seq = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, K+1]
-        tlogits, tcache2 = _multi_forward(cfg, params, seq, tcache,
-                                          mesh=mesh)
+        if paged:
+            # paged target: the verify forward walks the block table —
+            # writes land in pool blocks, attention gathers the lane
+            # view (or streams table-mapped blocks on the kernel path)
+            tlogits, tcache2 = _multi_forward_paged(cfg, params, seq,
+                                                    tcache, table,
+                                                    mesh=mesh)
+        else:
+            tlogits, tcache2 = _multi_forward(cfg, params, seq, tcache,
+                                              mesh=mesh)
         tgt = tlogits.argmax(-1).astype(jnp.int32)           # [B, K+1]
 
         # greedy rule: accept while the draft equals the target argmax
@@ -265,10 +372,25 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
         tok_out = jnp.where(active, nxt, tok)
         # ROLLBACK: monotone write-index rewind — both caches advanced
         # spec_k+1 rows, committed only a+1; rejected rows stay behind
-        # pos, never attended, overwritten by later writes
-        tcache2["pos"] = jnp.where(active, tpos0 + a + 1, tpos0)
-        dcache2["pos"] = jnp.where(active, dpos0 + a + 1, dpos0)
+        # pos, never attended, overwritten by later writes.  Inactive
+        # (retired/free) lanes get their position ZEROED rather than
+        # frozen: serving_status must never see a stale fill position,
+        # and under paging their writes route to the trash block via
+        # the zeroed table row regardless.
+        tcache2["pos"] = jnp.where(active, tpos0 + a + 1, 0)
+        dcache2["pos"] = jnp.where(active, dpos0 + a + 1, 0)
         return tcache2, dcache2, tok_out, committed.T, n_commit
+
+    if paged:
+        def round_fn(params, dparams, tcache, dcache, table, tok, temp,
+                     keys, active):
+            return _round(params, dparams, tcache, dcache, tok, temp,
+                          keys, active, table)
+    else:
+        def round_fn(params, dparams, tcache, dcache, tok, temp, keys,
+                     active):
+            return _round(params, dparams, tcache, dcache, tok, temp,
+                          keys, active, None)
 
     return jax.jit(round_fn, donate_argnums=(2, 3))
 

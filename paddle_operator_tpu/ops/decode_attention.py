@@ -249,6 +249,168 @@ def decode_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     return out
 
 
+def _paged_kernel(len_ref, tbl_ref, *refs, scale: float, block_k: int,
+                  n_rep: int, stacked: bool):
+    """Paged-cache kernel body: identical compute to :func:`_kernel` —
+    the block table participates only through the *index maps* (each
+    grid cell's K/V window is looked up in ``tbl_ref`` instead of being
+    ``ik`` itself), so the online-softmax/bandwidth story is unchanged.
+    ``tbl_ref`` rides as one more scalar-prefetch operand that the body
+    never reads."""
+    del tbl_ref
+    _kernel(len_ref, *refs, scale=scale, block_k=block_k, n_rep=n_rep,
+            stacked=stacked)
+
+
+def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
+                           v_pool: jax.Array, block_table: jax.Array,
+                           lengths: jax.Array, *,
+                           scale: Optional[float] = None,
+                           layer: Optional[jax.Array] = None,
+                           interpret: bool = False) -> jax.Array:
+    """:func:`decode_attention` over a PAGED cache: lane b's context
+    lives in pool blocks ``block_table[b, 0..ceil(len_b/bs)-1]`` instead
+    of one contiguous slab.
+
+    q: [B, Hq, D]; k_pool/v_pool: [N, Hkv, bs, D] (or stacked
+    [L, N, Hkv, bs, D] with ``layer``, the decode layer-scan layout);
+    block_table: [B, M] int32 pool ids (lane-local block j of lane b is
+    pool block ``block_table[b, j]``; entries past the lane's fill are
+    ignored); lengths: [B] — lane b attends logical positions
+    [0, lengths[b]).  Returns [B, Hq, D].
+
+    The pool's block size IS the kernel's key block: the grid stays
+    ``(B, M)`` and the only change from the contiguous kernel is the
+    cache index map — ``ik -> table[b, ik]`` with dead tail blocks
+    clamped to the lane's last live *table entry* (repeated window =>
+    Mosaic skips the DMA, exactly like the contiguous fill clamp).  The
+    gather that the XLA fallback must materialize (infer/paged.py
+    ``_gather_lane_view``) never exists here: blocks stream straight
+    from their pool rows."""
+    b, hq, d = q.shape
+    stacked = layer is not None
+    _, hkv, block_k, _ = k_pool.shape[1:] if stacked else k_pool.shape
+    if hq % hkv:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if d % 128 and not interpret:
+        raise ValueError(
+            f"paged_decode_attention requires head_dim % 128 == 0 on TPU "
+            f"(got {d}); use decode_attn='xla' for this config")
+    n_rep = hq // hkv
+    nk = block_table.shape[1]
+    if scale is None:
+        scale = 1.0 / float(d) ** 0.5
+    qt = q.transpose(0, 2, 1)
+    lengths = lengths.astype(jnp.int32)
+    block_table = block_table.astype(jnp.int32)
+
+    def blk(ik, lens, tbl, bb):
+        # pool id of this cell's window; dead tail cells repeat the
+        # lane's last live entry (no new DMA, compute pl.when-skipped)
+        live = jnp.minimum(ik, jnp.maximum(lens[bb] - 1, 0) // block_k)
+        return tbl[bb, live]
+
+    if stacked:
+        lay = jnp.reshape(layer, (1,)).astype(jnp.int32)
+        cache_spec = pl.BlockSpec(
+            (1, 1, hkv, block_k, d),
+            lambda b, ik, lens, tbl, lay: (lay[0], blk(ik, lens, tbl, b),
+                                           0, 0, 0))
+        q_spec = pl.BlockSpec((1, d, hq),
+                              lambda b, ik, lens, tbl, lay: (b, 0, 0))
+        out_spec = pl.BlockSpec((1, hq, d),
+                                lambda b, ik, lens, tbl, lay: (b, 0, 0))
+        num_prefetch, extra = 3, (lay,)
+    else:
+        cache_spec = pl.BlockSpec(
+            (1, hkv, block_k, d),
+            lambda b, ik, lens, tbl: (blk(ik, lens, tbl, b), 0, 0, 0))
+        q_spec = pl.BlockSpec((1, d, hq), lambda b, ik, lens, tbl: (b, 0, 0))
+        out_spec = pl.BlockSpec((1, hq, d),
+                                lambda b, ik, lens, tbl: (b, 0, 0))
+        num_prefetch, extra = 2, ()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_prefetch,
+        grid=(b, nk),
+        in_specs=[q_spec, cache_spec, cache_spec],
+        out_specs=out_spec,
+        scratch_shapes=[
+            pltpu.VMEM((hq, d), jnp.float32),        # acc
+            pltpu.VMEM((hq, 128), jnp.float32),      # m (col 0 live)
+            pltpu.VMEM((hq, 128), jnp.float32),      # l (col 0 live)
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, block_k=block_k,
+                          n_rep=n_rep, stacked=stacked),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
+        interpret=interpret,
+    )(lengths, block_table, *extra, qt, k_pool, v_pool)
+    return out
+
+
+def sharded_paged_decode_attention(mesh, q: jax.Array, k_pool: jax.Array,
+                                   v_pool: jax.Array,
+                                   block_table: jax.Array,
+                                   lengths: jax.Array, wo, *,
+                                   layer: Optional[jax.Array] = None,
+                                   axis_name: str = "tp",
+                                   interpret: bool = False,
+                                   compute_dtype=None) -> jax.Array:
+    """:func:`sharded_decode_attention` for the paged pool: the pool
+    shards over its kv-head axis exactly like the ring cache (block ids
+    are position-like, replicated), so each shard runs the paged kernel
+    on its own whole GQA groups and the wo psum completes the Megatron
+    row-parallel projection — block table and lengths replicate."""
+    from paddle_operator_tpu.parallel.mesh import (
+        compat_shard_map,
+        resolve_shard_map_mesh,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    use_mesh, sizes = resolve_shard_map_mesh(mesh)
+    tp = sizes.get(axis_name, 1)
+    b, hq, d = q.shape
+    hkv = k_pool.shape[2] if layer is not None else k_pool.shape[1]
+    if hq % tp or hkv % tp:
+        raise ValueError(
+            f"Hq={hq}/Hkv={hkv} not divisible by {axis_name}={tp} — "
+            "route this config to the einsum path")
+    dtype = compute_dtype if compute_dtype is not None else q.dtype
+
+    head_spec = P(None, axis_name, None)
+    pool_spec = (P(None, None, axis_name, None, None)
+                 if layer is not None else P(None, axis_name, None, None))
+    wo_spec = ({"q": P(axis_name, None), "s": P(None, None)}
+               if isinstance(wo, dict) else P(axis_name, None))
+    stacked = layer is not None
+
+    def body(q, kc, vc, tbl, lens, wo, *lay):
+        out = paged_decode_attention(q, kc, vc, tbl, lens,
+                                     layer=lay[0] if stacked else None,
+                                     interpret=interpret)   # [B, Hq/tp, D]
+        o = out.reshape(b, -1)
+        if isinstance(wo, dict):
+            o = (o @ wo["q"].astype(dtype)) * wo["s"][..., 0, :].astype(dtype)
+        else:
+            o = o @ wo.astype(dtype)
+        return jax.lax.psum(o, axis_name)                   # [B, E]
+
+    fn = compat_shard_map(
+        body, mesh=use_mesh,
+        in_specs=(head_spec, pool_spec, pool_spec, P(), P(), wo_spec)
+        + ((P(),) if stacked else ()),
+        out_specs=P(None, None),
+        axis_names=frozenset({axis_name}), check_vma=False)
+    args = (q, k_pool, v_pool, block_table.astype(jnp.int32),
+            lengths.astype(jnp.int32), wo)
+    if stacked:
+        args += (layer,)
+    return fn(*args)
+
+
 def sharded_decode_attention(mesh, q: jax.Array, k_cache: jax.Array,
                              v_cache: jax.Array, lengths: jax.Array,
                              wo, *, layer: Optional[jax.Array] = None,

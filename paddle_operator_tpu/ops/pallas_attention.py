@@ -16,6 +16,12 @@ kernel walks k-blocks to produce dk/dv, another walks q-blocks for dq.
 
 Causality is exploited at block granularity: fully-masked tiles are skipped
 with `pl.when` (half the work), the diagonal gets an elementwise mask.
+
+Serving-side siblings live in ops/decode_attention.py: the single-query
+filled-prefix kernel (contiguous ring cache) and its PAGED variant, whose
+index map walks a block table into a global KV pool (infer/paged.py) —
+same online-softmax discipline as here, with the DMA skip driven by the
+fill length / table instead of causality.
 """
 
 from __future__ import annotations

@@ -92,6 +92,12 @@ def serving_gauges(status_serving: dict, job: str) -> dict:
             float(status_serving.get("acceptRate", 0.0)),
         f"tpujob_serve_queue_depth{lbl}":
             float(status_serving.get("queueDepth", 0.0)),
+        # paged-KV serving (SERVE_PAGED=1): radix prefix-cache token
+        # hit rate and free pool blocks — both 0 on contiguous rings
+        f"tpujob_serve_prefix_hit_rate{lbl}":
+            float(status_serving.get("prefixHitRate", 0.0)),
+        f"tpujob_serve_kv_blocks_free{lbl}":
+            float(status_serving.get("kvBlocksFree", 0.0)),
     }
 
 

@@ -254,6 +254,102 @@ class TestContinuousServe:
             srv.shutdown()
             srv.generator.close()
 
+    def test_stream_disconnect_frees_lane_and_blocks(self):
+        """A client that vanishes mid-stream must not pin its decode
+        lane to the full token budget: the handler's cancel fires on
+        the failed socket write, the ring evicts at the next chunk
+        boundary, and (paged ring) the lane's pool blocks return to the
+        free list / prefix cache — the allocator invariant holds."""
+        import http.client
+        import time
+
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        srv = make_server("127.0.0.1", 0, params, cfg, continuous=True,
+                          slots=1, max_len=64, chunk_tokens=2,
+                          prefill_buckets=(16, 64), paged=True,
+                          block_size=8)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        host, port = srv.server_address
+        b = srv.generator.batcher
+        orig = b._step
+
+        def paced(*a):                      # keep the stream alive long
+            time.sleep(0.05)                # enough to die mid-flight
+            return orig(*a)
+
+        b._step = paced
+        try:
+            total0 = b.pool.blocks_free() + b.pool.blocks_cached()
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            conn.request(
+                "POST", "/v1/generate",
+                body=json.dumps({"tokens": [list(range(1, 17))],
+                                 "max_new_tokens": 40, "stream": True}),
+                headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            resp.read(8)                    # first tokens flowed
+            conn.sock.close()               # abrupt client disconnect
+            deadline = time.monotonic() + 60
+            while not (b.stats["evicted"] >= 1
+                       and b.pool.blocks_free() + b.pool.blocks_cached()
+                       >= total0):
+                assert time.monotonic() < deadline, (
+                    "disconnect did not free the lane/blocks")
+                time.sleep(0.05)
+            b.pool.check_invariant()
+            # the freed lane serves the next request to completion
+            code, out = _post(f"http://{host}:{port}",
+                              {"tokens": [[2, 7, 1]], "max_new_tokens": 4})
+            assert code == 200
+            ref = D.generate(params, cfg,
+                             jnp.asarray([[2, 7, 1]], jnp.int32),
+                             max_new_tokens=4, max_len=64)
+            assert out["tokens"][0] == np.asarray(ref[0]).tolist()
+        finally:
+            srv.shutdown()
+            srv.generator.close()
+
+    def test_paged_server_matches_contiguous_server(self):
+        """SERVE_PAGED parity at the HTTP layer: the same request
+        stream against a paged and a contiguous continuous server
+        yields byte-identical token rows (the greedy parity oracle)."""
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        servers = {}
+        for tag, extra in (("contig", {}),
+                           ("paged", {"paged": True, "block_size": 8})):
+            srv = make_server("127.0.0.1", 0, params, cfg,
+                              continuous=True, slots=2, max_len=64,
+                              chunk_tokens=4, prefill_buckets=(16, 64),
+                              **extra)
+            threading.Thread(target=srv.serve_forever,
+                             daemon=True).start()
+            servers[tag] = srv
+        try:
+            rng = np.random.default_rng(3)
+            shared = rng.integers(0, cfg.vocab_size, (16,)).tolist()
+            stream = [shared + rng.integers(0, cfg.vocab_size,
+                                            (4,)).tolist()
+                      for _ in range(3)] + [shared]
+            outs = {}
+            for tag, srv in servers.items():
+                base = f"http://127.0.0.1:{srv.server_address[1]}"
+                outs[tag] = [
+                    _post(base, {"tokens": [p], "max_new_tokens": 6})[1]
+                    ["tokens"][0] for p in stream]
+            assert outs["paged"] == outs["contig"]
+            pb = servers["paged"].generator.batcher
+            assert pb.pool.hit_rate() > 0      # followers hit the cache
+            pb.pool.check_invariant()
+        finally:
+            for srv in servers.values():
+                srv.shutdown()
+                srv.generator.close()
+
     def test_streaming_rejected_on_batch_server(self):
         model, cfg = make_model("tiny", dtype=jnp.float32)
         params = model.init(jax.random.PRNGKey(0),

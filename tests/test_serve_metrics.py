@@ -22,7 +22,7 @@ NS = "default"
 TMPL = {"spec": {"containers": [{"name": "m", "image": "jax:latest"}]}}
 
 SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
-           "tokensTotal": 9000}
+           "tokensTotal": 9000, "prefixHitRate": 0.31, "kvBlocksFree": 17}
 
 
 class TestGaugeNaming:
@@ -31,6 +31,8 @@ class TestGaugeNaming:
         assert g['tpujob_serve_tokens_per_sec{job="default/j"}'] == 123.4
         assert g['tpujob_serve_accept_rate{job="default/j"}'] == 0.72
         assert g['tpujob_serve_queue_depth{job="default/j"}'] == 3.0
+        assert g['tpujob_serve_prefix_hit_rate{job="default/j"}'] == 0.31
+        assert g['tpujob_serve_kv_blocks_free{job="default/j"}'] == 17.0
 
     def test_missing_keys_default_zero(self):
         g = serving_gauges({}, "ns/x")
@@ -139,9 +141,66 @@ class TestBatcherServingStatus:
         finally:
             b.close()
         assert set(st) == {"tokensPerSec", "acceptRate", "queueDepth",
-                           "tokensTotal"}
+                           "tokensTotal", "activeLanes", "lanePos",
+                           "prefixHitRate", "kvBlocksFree", "kvBlocksHwm"}
         assert st["tokensTotal"] == 4
         assert st["tokensPerSec"] > 0
         assert st["acceptRate"] == 0.0         # non-speculative ring
         g = serving_gauges(st, "ns/j")
         assert g['tpujob_serve_tokens_per_sec{job="ns/j"}'] > 0
+
+    def test_retired_lane_leaves_no_stale_pos(self):
+        """Regression (PR 4 satellite): slot retirement used to leave
+        the lane's fill position visible until the slot was reused —
+        a finished ring must report zero active lanes and zeroed
+        per-lane positions, not the dead request's."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+        from paddle_operator_tpu.models.llama import make_model
+
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        b = ContinuousBatcher(params, cfg, slots=2, max_len=32,
+                              chunk_tokens=2, prefill_buckets=(16, 32))
+        try:
+            b.submit([1, 2, 3, 4, 5], max_new_tokens=4).result(timeout=300)
+            st = b.serving_status()
+            assert st["activeLanes"] == 0
+            assert st["lanePos"] == [0, 0]     # not 5 + generated
+            assert st["queueDepth"] == 0
+        finally:
+            b.close()
+
+    def test_paged_ring_reports_prefix_and_block_gauges(self):
+        """SERVE_PAGED ring: the serving block carries the prefix-hit
+        rate and free-block gauges the manager exports."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+        from paddle_operator_tpu.models.llama import make_model
+
+        model, cfg = make_model("tiny", dtype=jnp.float32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        b = ContinuousBatcher(params, cfg, slots=2, max_len=32,
+                              chunk_tokens=2, prefill_buckets=(16, 32),
+                              paged=True, block_size=8)
+        try:
+            prompt = np.arange(1, 17, dtype=np.int32)   # two full blocks
+            b.submit(prompt, max_new_tokens=3).result(timeout=300)
+            b.submit(prompt, max_new_tokens=3).result(timeout=300)
+            st = b.serving_status()
+            assert st["prefixHitRate"] > 0      # second request hit
+            assert st["kvBlocksFree"] > 0       # lanes retired
+            assert st["kvBlocksHwm"] >= 2
+            g = serving_gauges(st, "ns/j")
+            assert g['tpujob_serve_prefix_hit_rate{job="ns/j"}'] > 0
+            assert g['tpujob_serve_kv_blocks_free{job="ns/j"}'] > 0
+            b.pool.check_invariant()
+        finally:
+            b.close()
