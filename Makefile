@@ -47,9 +47,18 @@ bench:
 
 # CPU dry-run gate: entry forward + the 8-virtual-device multichip run
 # (all training parallelism axes, plus the serving parity lines:
-# serve-decode, serve-ring, serve-spec, serve-paged, ft-drain)
+# serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
+# ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
+
+# Seeded chaos suite (infer/chaos.py schedules through the resilience
+# machinery): the deterministic fault tests plus the serve-chaos dryrun
+# gate standalone — the fast way to re-verify serving fault tolerance
+# without the full dryrun/tier1.
+chaos:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu $(PY) -c "import __graft_entry__ as g; g.chaos_gate()"
 
 docker-build:
 	docker build -t $(IMG) .
@@ -58,4 +67,4 @@ clean:
 	$(MAKE) -C native clean
 	rm -rf .pytest_cache
 
-.PHONY: all native test tier1 run gen-deploy install deploy helm bench dryrun docker-build clean
+.PHONY: all native test tier1 run gen-deploy install deploy helm bench dryrun chaos docker-build clean

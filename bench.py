@@ -758,6 +758,106 @@ def measure_recovery(rates=(0, 2, 6), *, steps_per_hour: int = 24,
     return out
 
 
+def measure_resilience(fault_rates=(0, 1, 5), *, slots: int = 2,
+                       requests: int = 8, prompt_len: int = 12,
+                       new_tokens: int = 24, max_len: int = 64,
+                       chunk: int = 4) -> list:
+    """Serving goodput under injected dispatch faults (infer/chaos.py
+    through infer/resilience.py): each rate injects that many
+    ``dispatch_fail`` events — one simulated minute compressed into the
+    run — spread evenly across the run's expected dispatch budget, and
+    measures delivered tokens/sec and TTFT p95 next to the 0-fault
+    baseline.  A fault fails the RESIDENT requests retriably (their
+    tokens count as lost) and the ring self-heals; the later requests'
+    goodput is what the ``chaos_goodput_ratio`` summary key reports
+    (faulted tok/s over fault-free tok/s — the Oobleck-style claim that
+    recovery preserves throughput instead of wedging the ring)."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+    from paddle_operator_tpu.infer.chaos import ChaosEvent, ChaosInjector
+    from paddle_operator_tpu.infer.resilience import RingResilience
+    from paddle_operator_tpu.models import llama as L
+
+    cfg = L.CONFIGS["tiny"]
+    params = L.Llama(cfg).init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+               for _ in range(requests)]
+    out = []
+    for rate in fault_rates:
+        b = ContinuousBatcher(
+            params, cfg, slots=slots, max_len=max_len,
+            chunk_tokens=chunk, prefill_buckets=(16, max_len),
+            resilience=RingResilience(watchdog=False,
+                                      max_restarts=rate + 2,
+                                      backoff_base_s=0.05))
+        try:
+            b.submit(prompts[0], max_new_tokens=chunk).result(timeout=600)
+            inj = ChaosInjector("", seed=rate).install(b)
+            # expected dispatch budget for the whole run; faults spread
+            # evenly across it (deterministic given the seed/schedule)
+            est = max(1, requests * -(-new_tokens // chunk) // slots)
+            base = inj.dispatches
+            for k in range(rate):
+                at = base + 1 + (k + 1) * est // (rate + 1)
+                inj.events[at] = [ChaosEvent("dispatch_fail", at)]
+            ttfts, delivered, failed = [], 0, 0
+            lock = threading.Lock()
+
+            from paddle_operator_tpu.infer.resilience import (
+                RetriableError,
+            )
+
+            def client(p):
+                # retries RetriableError like a real drain-aware client
+                # (client.post_generate's 503 discipline): goodput then
+                # measures RECOVERY overhead — lost in-flight work plus
+                # backoff — not just how many requests died
+                nonlocal delivered, failed
+                t0 = time.perf_counter()
+                for attempt in range(4):
+                    try:
+                        h = b.submit(p, max_new_tokens=new_tokens,
+                                     stream=True)
+                        next(h.stream(timeout=600))
+                        dt = (time.perf_counter() - t0) * 1000
+                        toks = h.result(timeout=600)
+                        with lock:
+                            ttfts.append(dt)
+                            delivered += len(toks) - len(p)
+                        return
+                    except RetriableError:
+                        continue
+                    except Exception:
+                        break
+                with lock:
+                    failed += 1
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(p,))
+                       for p in prompts]
+            [t.start() for t in threads]
+            [t.join() for t in threads]
+            span = time.perf_counter() - t0
+        finally:
+            b.close()
+        out.append({
+            "resilience_faults": rate,
+            "resilience_requests": requests,
+            "resilience_tok_per_sec": round(delivered / span, 1),
+            "resilience_ttft_p95_ms": round(_pctl(ttfts, 0.95) or 0.0, 1),
+            "resilience_failed_requests": failed,
+            "resilience_restarts": b.stats["watchdog_restarts"],
+        })
+    return out
+
+
 def measure_submit_latency() -> dict:
     """submit→rendezvous-ConfigMap over real HTTP (BASELINE.md metric
     'kubectl apply → first training step'; the training-side share is the
@@ -1123,6 +1223,21 @@ def main() -> int:
                 < latency["submit_to_configmap_ms"]:
             latency = retry
     emit("latency", latency)
+
+    # serving resilience sweep: delivered tok/s + TTFT p95 under 0/1/5
+    # injected dispatch faults per (compressed) minute; the goodput
+    # ratio is the headline — a self-healing ring must keep serving
+    # through faults instead of wedging (docs/serving.md resilience)
+    resil = guarded("resilience", lambda: measure_resilience())
+    if isinstance(resil, list):
+        for entry in resil:
+            emit("resilience_sweep", entry)
+        base_tps = resil[0].get("resilience_tok_per_sec") or 0
+        worst = resil[-1].get("resilience_tok_per_sec") or 0
+        if base_tps:
+            summary["chaos_goodput_ratio"] = round(worst / base_tps, 3)
+    else:
+        emit("resilience_sweep", resil)
 
     # recovery sweep: time-to-restore + goodput under injected
     # preemption drains (docs/fault-tolerance.md), alongside the serving

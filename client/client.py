@@ -8,16 +8,28 @@ Usage::
     python client/client.py get my-job
     python client/client.py list
     python client/client.py delete my-job
+    python client/client.py generate http://host:port '{"tokens": [[1,2]]}'
 
 Talks to the apiserver through the same stdlib KubeAPI the controller uses
 (in-cluster service account, or KUBE_HOST/KUBE_TOKEN env for dev).
+
+``generate`` talks to a serving pod (infer/serve.py) instead, with the
+retry discipline a drain-aware server expects: a 503 (SIGTERM drain,
+watchdog rebuild, queue backpressure) retries with exponential backoff +
+jitter, honoring the server's ``Retry-After`` hint, bounded by both a
+retry cap and the request deadline (``GEN_DEADLINE_S`` env / the
+``deadline_s`` payload key, also sent as ``X-Request-Deadline``).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import sys
+import time
+import urllib.error
+import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -29,6 +41,82 @@ from paddle_operator_tpu.controller.kube_api import KubeAPI  # noqa: E402
 def make_api() -> KubeAPI:
     return KubeAPI(host=os.environ.get("KUBE_HOST"),
                    token=os.environ.get("KUBE_TOKEN"))
+
+
+def post_generate(base_url, payload, *, deadline_s=None, max_retries=4,
+                  backoff_base_s=0.25, backoff_max_s=8.0, rng=None,
+                  sleep=time.sleep):
+    """POST ``payload`` to ``{base_url}/v1/generate`` with bounded
+    retry on 503/connection errors.
+
+    Retry policy (docs/serving.md resilience section):
+
+    - only 503 (and connection resets) retries — a 4xx is the caller's
+      bug and a 504 deadline partial is a RESULT, both returned as-is;
+    - the server's ``Retry-After`` hint, when present, replaces the
+      computed backoff for that attempt;
+    - backoff is exponential (base * 2^attempt, capped) with
+      multiplicative jitter in [0.5, 1.5) — a thousand clients shed by
+      one draining pod must not re-dogpile its replacement in sync;
+    - the request ``deadline_s`` caps everything: it is sent to the
+      server (``X-Request-Deadline``) AND no retry is attempted that
+      could not complete before the deadline.
+
+    ``rng``/``sleep`` are injectable for deterministic tests.  Returns
+    ``(status_code, response_dict)``."""
+    rng = rng if rng is not None else random.Random()
+    deadline = (time.monotonic() + deadline_s
+                if deadline_s is not None else None)
+    attempt = 0
+    while True:
+        headers = {"Content-Type": "application/json"}
+        timeout = 600.0
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError("request deadline exhausted before "
+                                   "a successful attempt")
+            headers["X-Request-Deadline"] = f"{remaining:.3f}"
+            # socket timeout PADDED past the advertised deadline: the
+            # server's 504 deadline-partial is by construction sent
+            # only AFTER the deadline passes (the lane retires at the
+            # next chunk boundary) — a timeout equal to the deadline
+            # would always fire first and drop the delivered partial
+            timeout = max(0.1, remaining) + 5.0
+        req = urllib.request.Request(
+            f"{base_url}/v1/generate", data=json.dumps(payload).encode(),
+            headers=headers, method="POST")
+        retry_after = None
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            if e.code == 504:          # deadline partial IS the result
+                return e.code, json.loads(body or b"{}")
+            if e.code != 503 or attempt >= max_retries:
+                raise
+            retry_after = e.headers.get("Retry-After")
+        except (urllib.error.URLError, ConnectionError, TimeoutError):
+            if attempt >= max_retries:
+                raise
+        delay = min(backoff_max_s, backoff_base_s * (2 ** attempt))
+        if retry_after is not None:
+            try:
+                delay = float(retry_after)
+            except ValueError:
+                # RFC 7231 also allows an HTTP-date Retry-After (some
+                # ingress proxies send one); keep the computed backoff
+                # rather than crashing the helper whose job is 503s
+                pass
+        delay *= 0.5 + rng.random()            # jitter in [0.5, 1.5)
+        if deadline is not None \
+                and time.monotonic() + delay >= deadline:
+            raise TimeoutError(
+                f"request deadline leaves no room for retry {attempt + 1}"
+                f" (would sleep {delay:.2f}s)")
+        sleep(delay)
+        attempt += 1
 
 
 def main(argv=None) -> int:
@@ -63,6 +151,21 @@ def main(argv=None) -> int:
     elif cmd == "delete":
         api.delete("TPUJob", ns, args[0])
         print(f"tpujob {args[0]} deleted")
+    elif cmd == "generate":
+        # args: <base_url> <json payload or @file>
+        base = args[0].rstrip("/")
+        raw = args[1] if len(args) > 1 else "{}"
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                raw = f.read()
+        payload = json.loads(raw)
+        deadline_env = os.environ.get("GEN_DEADLINE_S")
+        deadline_s = payload.get(
+            "deadline_s",
+            float(deadline_env) if deadline_env else None)
+        code, out = post_generate(base, payload, deadline_s=deadline_s)
+        print(json.dumps(out))
+        return 0 if code == 200 else 1
     else:
         print(__doc__)
         return 2

@@ -287,7 +287,11 @@ def _status_schema() -> Dict[str, Any]:
                 "x-kubernetes-preserve-unknown-fields": True,
             },
             # serving telemetry block (infer/batcher.py serving_status)
-            # — exported as tpujob_serve_* manager gauges
+            # — exported as tpujob_serve_* manager gauges.  Includes the
+            # fault-tolerance keys (infer/resilience.py): draining,
+            # deadlineExceeded, watchdogRestarts, quarantinedLanes —
+            # schemaless on purpose (preserve-unknown-fields) so the
+            # workload can grow telemetry without a CRD rev.
             "serving": {
                 "type": "object",
                 "x-kubernetes-preserve-unknown-fields": True,

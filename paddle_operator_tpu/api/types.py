@@ -408,7 +408,9 @@ class TPUJobStatus:
     goodput: Dict[str, Any] = field(default_factory=dict)
     # Workload-published serving telemetry (infer/batcher.py
     # ContinuousBatcher.serving_status): served tokens/sec, speculative
-    # acceptance rate, request-queue depth.  The manager exports it as
+    # acceptance rate, request-queue depth, plus the fault-tolerance
+    # block (infer/resilience.py) — draining, deadlineExceeded,
+    # watchdogRestarts, quarantinedLanes.  The manager exports it as
     # tpujob_serve_* gauges on /metrics.
     serving: Dict[str, Any] = field(default_factory=dict)
     # k8s-style status conditions; the reconciler maintains a "Goodput"

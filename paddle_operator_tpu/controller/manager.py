@@ -289,7 +289,10 @@ class Manager:
         (ft/goodput.py -> ``tpujob_goodput_*``/``tpujob_badput_seconds``)
         and ``status.serving`` (infer/batcher.py serving_status ->
         ``tpujob_serve_tokens_per_sec``/``tpujob_serve_accept_rate``/
-        ``tpujob_serve_queue_depth``).  Gauges of deleted jobs (and
+        ``tpujob_serve_queue_depth``, plus the fault-tolerance gauges
+        ``tpujob_serve_watchdog_restarts``/``..._deadline_exceeded``/
+        ``..._quarantined_lanes``/``..._draining`` from
+        infer/resilience.py).  Gauges of deleted jobs (and
         gauge names a job stopped publishing) are pruned, so /metrics
         never serves stale readings and the registry stays bounded."""
         from paddle_operator_tpu.ft.goodput import goodput_gauges

@@ -16,6 +16,14 @@ The exit-code contract (docs/fault-tolerance.md):
     0               clean completion
     EXIT_PREEMPTED  drain completed; checkpoint durable; restart me
     anything else   program failure; consumes the restart budget
+
+SERVING pods speak the same contract (infer/resilience.py
+ServingDrain): their drain is "stop admissions (503 + Retry-After),
+finish in-flight lanes within the budget, flush partials" instead of
+"finish the step, force a checkpoint" — but the exit code, and the
+reconciler's preempted-not-failed accounting, are identical.  A second
+SIGTERM during a serving drain means the grace period is nearly up:
+immediate best-effort exit, still EXIT_PREEMPTED.
 """
 
 from __future__ import annotations
@@ -115,18 +123,26 @@ class PreemptionWatcher:
         """Poll ``path``; its appearance (or pre-existence) triggers the
         drain with the file's first line as the reason."""
 
+        def read_line() -> str:
+            try:
+                with open(path) as f:
+                    return f.readline().strip()
+            except OSError:
+                return ""
+
         def poll() -> None:
             while not self._poll_stop.is_set():
                 if os.path.exists(path):
-                    reason = "notice-file"
-                    try:
-                        with open(path) as f:
-                            line = f.readline().strip()
-                        if line:
-                            reason = f"notice-file:{line}"
-                    except OSError:
-                        pass
-                    self.trigger(reason)
+                    line = read_line()
+                    if not line:
+                        # create->write is not atomic: the poller can
+                        # catch the file mid-write and read an empty
+                        # first line — give the writer one poll tick
+                        # before triggering with a bare reason
+                        self._poll_stop.wait(poll_interval)
+                        line = read_line()
+                    self.trigger(f"notice-file:{line}" if line
+                                 else "notice-file")
                     return
                 self._poll_stop.wait(poll_interval)
 

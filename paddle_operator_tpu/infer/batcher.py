@@ -46,6 +46,14 @@ import jax
 import jax.numpy as jnp
 
 from paddle_operator_tpu.infer import decode as D
+from paddle_operator_tpu.infer.resilience import (
+    DispatchWatchdog,
+    LaneQuarantined,
+    RestartBudget,
+    RetriableError,
+    RingResilience,
+    ShuttingDown,
+)
 from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
 
 
@@ -258,7 +266,8 @@ def _sample_tokens(logits, temp, keys, pos, top_k, top_p):
 
 def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                     top_k: Optional[int] = None,
-                    top_p: Optional[float] = None, mesh=None):
+                    top_p: Optional[float] = None, mesh=None,
+                    check_finite: bool = False):
     """The ONE resident compiled decode program.
 
     ``step(params, cache, tok [B], temp [B], keys [B,2], active [B])
@@ -272,11 +281,22 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     serving mesh the whole chunk remains ONE sharded dispatch — the
     shard_map kernel regions and GSPMD einsums compile into the same
     resident program, no eager per-device ops anywhere.
+
+    ``check_finite=True`` (infer/resilience.py nan_check): the step
+    additionally returns ``ok [B]`` — an isfinite fold of every tick's
+    logits per lane, so the host can quarantine a NaN-producing lane
+    (fail ONE request, never the ring) without shipping the logits
+    home.  Token outputs are unchanged; the fold rides the same scan.
     """
 
     def step(params, cache, tok, temp, keys, active):
         def tick(carry, _):
-            cache, tok = carry
+            # the isfinite fold rides the carry ONLY when requested —
+            # the default resident program is unchanged
+            if check_finite:
+                cache, tok, ok = carry
+            else:
+                cache, tok = carry
             logits, new_cache = _ring_forward(cfg, params, tok, cache,
                                               mesh=mesh)
             nxt = _sample_tokens(logits, temp, keys, cache["pos"],
@@ -288,8 +308,16 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
             # overwrites along with the rest of the lane
             new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
             nxt = jnp.where(active, nxt, tok)
+            if check_finite:
+                ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
+                return (new_cache, nxt, ok), nxt
             return (new_cache, nxt), nxt
 
+        if check_finite:
+            (cache, tok, ok), toks = jax.lax.scan(
+                tick, (cache, tok, jnp.ones(tok.shape, bool)), None,
+                length=chunk_tokens)
+            return cache, tok, toks, ok
         (cache, tok), toks = jax.lax.scan(
             tick, (cache, tok), None, length=chunk_tokens)
         return cache, tok, toks
@@ -430,10 +458,11 @@ class QueueFull(RuntimeError):
 class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
                  "done", "out", "error", "_stream", "_cancel",
-                 "dev_prompt", "bucket", "accepted", "drafted")
+                 "dev_prompt", "bucket", "accepted", "drafted",
+                 "deadline", "deadline_exceeded")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
-                 wants_stream=False):
+                 wants_stream=False, deadline=None):
         self.prompt = prompt
         self.max_new = max_new
         self.temperature = temperature
@@ -443,6 +472,12 @@ class _Request:
         self.out: Optional[List[int]] = None
         self.error: Optional[Exception] = None
         self._cancel = False
+        # absolute time.monotonic() deadline (or None): the ring retires
+        # the lane when it passes — the request RESOLVES with the tokens
+        # produced so far and this flag set (the 504-style partial), so
+        # a slow client can never pin a lane / its paged blocks
+        self.deadline: Optional[float] = deadline
+        self.deadline_exceeded = False
         # speculative-decoding telemetry (spec_k > 0 rings): drafts
         # offered / accepted for THIS request — serve.py surfaces the
         # rate per response
@@ -552,7 +587,8 @@ class ContinuousBatcher:
                  paged: bool = False,
                  block_size: int = 256,
                  num_blocks: Optional[int] = None,
-                 prefix_cache: bool = True) -> None:
+                 prefix_cache: bool = True,
+                 resilience: Optional[RingResilience] = None) -> None:
         # ``mesh`` (parallel/mesh.py make_serving_mesh): serve
         # tensor-parallel — params are laid out over tp once here, the
         # ring cache shards over the kv-head axis, and the resident
@@ -567,6 +603,30 @@ class ContinuousBatcher:
         self.slots = slots
         self.max_len = max_len or cfg.max_seq_len
         self.chunk = chunk_tokens
+        # fault tolerance (infer/resilience.py): with a RingResilience a
+        # ring-level dispatch fault fails the RESIDENT requests with a
+        # retriable 503 and rebuilds the ring from scratch (fresh
+        # cache/pool; queued work re-admitted) behind exponential
+        # backoff, until the restart budget flips ``healthy`` — without
+        # one the batcher keeps its legacy die-on-first-error behavior.
+        self.resilience = resilience
+        self._budget = (RestartBudget(resilience)
+                        if resilience is not None else None)
+        self._check_finite = bool(resilience and resilience.nan_check)
+        if self._check_finite and spec_k:
+            raise ValueError("nan_check is not supported on speculative "
+                             "rings (the spec round has no per-lane "
+                             "finite fold); disable one of them")
+        self.healthy = True
+        self._draining = False
+        self._rebuilding = False
+        # ring-level fault observed (by the loop thread or the watchdog
+        # monitor) and not yet healed; the loop rebuilds at the next top
+        self._fault: Optional[Exception] = None
+        self._watchdog: Optional[DispatchWatchdog] = None
+        if resilience is not None and resilience.watchdog:
+            self._watchdog = DispatchWatchdog(
+                resilience, self._on_stall, self._on_hard_stall)
         # max dispatched-but-unconsumed chunks; the oldest is consumed
         # once `depth` are in flight, so depth 2 = one chunk always
         # decoding while the host consumes the previous one (depth 1
@@ -595,9 +655,13 @@ class ContinuousBatcher:
             # prefix reuse needs one canonical prefill per prefix;
             # speculative admission prefills target AND draft, so the
             # cache is disabled there (paging itself still applies)
+            # kept for watchdog rebuilds: a self-heal reconstructs the
+            # pool (and its radix cache) from scratch with these
+            self._num_blocks = num_blocks
+            self._prefix_cache = prefix_cache and not spec_k
             self.pool = PG.PagedCacheManager(
                 slots, self.max_len, self.block_size, num_blocks,
-                prefix_cache=prefix_cache and not spec_k)
+                prefix_cache=self._prefix_cache)
             # prefill buckets scatter whole blocks: round each up to a
             # block multiple, capped at the lane view
             self.buckets = tuple(sorted(
@@ -652,13 +716,15 @@ class ContinuousBatcher:
             self.dcache = None
             if self.paged:
                 self._step = self._pg.make_paged_chunk_step(
-                    cfg, chunk_tokens, top_k, top_p, mesh=mesh)
+                    cfg, chunk_tokens, top_k, top_p, mesh=mesh,
+                    check_finite=self._check_finite)
                 self._inserts = {b: self._pg.make_paged_prefill_insert(
                     cfg, b, self.block_size, top_k, top_p, mesh=mesh)
                     for b in self.buckets}
             else:
                 self._step = make_chunk_step(cfg, chunk_tokens, top_k,
-                                             top_p, mesh=mesh)
+                                             top_p, mesh=mesh,
+                                             check_finite=self._check_finite)
                 self._inserts = {b: make_prefill_insert(cfg, b, top_k,
                                                         top_p, mesh=mesh)
                                  for b in self.buckets}
@@ -700,7 +766,13 @@ class ContinuousBatcher:
                       # gate — a full prefix hit admits with ZERO
                       # prefill forward passes over cached blocks
                       "prefill_calls": 0, "prefill_tokens": 0,
-                      "cow_copies": 0}
+                      "cow_copies": 0,
+                      # fault-tolerance accounting (infer/resilience.py):
+                      # deadline partials delivered, self-healing ring
+                      # rebuilds, and NaN-quarantined lanes — surfaced
+                      # through serving_status -> tpujob_serve_* gauges
+                      "deadline_exceeded": 0, "watchdog_restarts": 0,
+                      "quarantined_lanes": 0}
         # served-token telemetry for serving_status(): cumulative emitted
         # tokens since construction (the /metrics tokens-per-sec gauge)
         self._tokens_emitted = 0
@@ -715,9 +787,19 @@ class ContinuousBatcher:
                temperature: float = 0.0, seed: int = 0,
                eos_token: Optional[int] = None,
                stream: bool = False,
-               request_id: Optional[str] = None) -> _Request:
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> _Request:
         """Queue one generation request; returns a handle whose
         ``result()``/``stream()`` deliver the tokens.
+
+        ``deadline_s`` (serve.py: the ``X-Request-Deadline`` header):
+        relative budget in seconds for the WHOLE generation.  When it
+        expires the ring retires the lane at the next chunk boundary —
+        its paged blocks freed, the request resolving with the tokens
+        produced so far and ``handle.deadline_exceeded`` set (the
+        504-style partial) — so one slow/greedy client can never pin a
+        lane indefinitely.  Requests still queued at expiry resolve
+        prompt-only with the same flag.
 
         ``request_id`` (optional, e.g. serve.py's per-row id) is woven
         into every validation error so an operator reading a rejection
@@ -740,8 +822,12 @@ class ContinuousBatcher:
             raise ValueError(f"empty prompt{rid}")
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1{rid}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0{rid}")
+        if self._draining:
+            raise ShuttingDown("server draining; retry another replica")
         if self._stop.is_set() or not self._thread.is_alive():
-            raise RuntimeError("batcher closed")
+            raise ShuttingDown("batcher closed")
         if n > self.buckets[-1]:
             raise ValueError(
                 f"prompt length {n} exceeds the largest prefill "
@@ -784,6 +870,8 @@ class ContinuousBatcher:
             # the bound; this only waits for space to appear first.
             deadline = time.monotonic() + self._queue_timeout
             while self._pending.full():
+                if self._stop.is_set() or self._draining:
+                    raise ShuttingDown("batcher shutting down")
                 if time.monotonic() >= deadline:
                     self.stats["rejected_queue_full"] += 1
                     raise QueueFull(
@@ -791,28 +879,37 @@ class ContinuousBatcher:
                         f" waited {self._queue_timeout}s)")
                 time.sleep(0.005)
         req = _Request(prompt, max_new_tokens, temperature, seed,
-                       eos_token, wants_stream=stream)
+                       eos_token, wants_stream=stream,
+                       deadline=(time.monotonic() + deadline_s
+                                 if deadline_s is not None else None))
         # pad + ship the prompt to the device HERE, on the caller's
         # thread — see _Request.dev_prompt
         req.bucket = self._bucket_for(len(prompt))
         padded = np.zeros((1, req.bucket), np.int32)
         padded[0, :len(prompt)] = prompt
         req.dev_prompt = jnp.asarray(padded)
-        try:
-            # bounded queue: block briefly for a slot (smooths bursts),
-            # then reject — the caller's thread, not the decode ring,
-            # pays the wait
-            self._pending.put(req, timeout=(self._queue_timeout
-                                            if self.max_queue else None))
-        except queue.Full:
-            self.stats["rejected_queue_full"] += 1
-            raise QueueFull(
-                f"request queue full (max_queue={self.max_queue}, "
-                f"waited {self._queue_timeout}s)") from None
+        # bounded queue: poll briefly for a slot (smooths bursts) then
+        # reject — the caller's thread, not the decode ring, pays the
+        # wait.  Short put ticks so close()/drain() interrupt a BLOCKED
+        # submitter with ShuttingDown immediately instead of leaving it
+        # hanging out the full queue timeout against a dead ring.
+        deadline = time.monotonic() + self._queue_timeout
+        while True:
+            if self._stop.is_set() or self._draining:
+                raise ShuttingDown("batcher shutting down")
+            try:
+                self._pending.put(req, timeout=0.05)
+                break
+            except queue.Full:
+                if time.monotonic() >= deadline:
+                    self.stats["rejected_queue_full"] += 1
+                    raise QueueFull(
+                        f"request queue full (max_queue={self.max_queue},"
+                        f" waited {self._queue_timeout}s)") from None
         if self._stop.is_set() and not req.done.is_set():
             # loop died between the liveness check above and the put:
             # fail the request instead of letting result() hang
-            self._finish(req, RuntimeError("batcher closed"))
+            self._finish(req, ShuttingDown("batcher closed"))
             return req
         self._wake.set()
         return req
@@ -843,12 +940,160 @@ class ContinuousBatcher:
                              if self.pool is not None else 0),
             "kvBlocksHwm": (self.pool.stats["blocks_hwm"]
                             if self.pool is not None else 0),
+            # fault tolerance (infer/resilience.py): drain/rebuild
+            # visibility for /readyz and the CRD's status.serving block
+            "draining": self._draining,
+            "healthy": self.healthy,
+            "deadlineExceeded": self.stats["deadline_exceeded"],
+            "watchdogRestarts": self.stats["watchdog_restarts"],
+            "quarantinedLanes": self.stats["quarantined_lanes"],
         }
+
+    @property
+    def accepting(self) -> bool:
+        """Readiness (/readyz): the ring takes new admissions — not
+        draining, not mid-rebuild, loop alive, budget unspent."""
+        return (self.healthy and not self._draining
+                and not self._rebuilding and not self._stop.is_set()
+                and self._thread.is_alive())
+
+    def drain(self, budget_s: float = 30.0) -> None:
+        """SIGTERM drain (the serving half of docs/fault-tolerance.md):
+        stop admissions — queued and newly submitted requests fail with
+        :class:`ShuttingDown` (503 + Retry-After upstream) — let the
+        RESIDENT lanes finish within ``budget_s``, cancel stragglers at
+        the budget (their callers receive the tokens produced so far;
+        paged blocks verifiably return to the pool), then close."""
+        self._draining = True
+        self._wake.set()
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline and self._thread.is_alive():
+            if all(r is None for r in self.lane) and self._pending.empty():
+                break
+            time.sleep(0.02)
+        for req in list(self.lane):
+            if req is not None:
+                req.cancel()            # partial flush at chunk boundary
+        grace = time.monotonic() + max(5.0, budget_s)
+        while (any(r is not None for r in self.lane)
+               and self._thread.is_alive()
+               and time.monotonic() < grace):
+            time.sleep(0.02)
+        self.close()
+
+    def abort(self, error: Optional[Exception] = None) -> None:
+        """Second-SIGTERM semantics: immediate teardown.  Resident
+        requests RESOLVE with their partial tokens (best-effort flush —
+        an undrained kill would have lost them entirely); queued ones
+        fail with ShuttingDown."""
+        self._draining = True
+        self._stop.set()
+        self._wake.set()
+        for i, req in enumerate(self.lane):
+            if req is not None and not req.done.is_set():
+                req.out = req.prompt + self._lane_out[i]
+                self._finish(req)
+        self._shed_queue(error or ShuttingDown("server killed"))
 
     def close(self) -> None:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=30)
+        if self._watchdog is not None:
+            self._watchdog.close()
+        # late blocked submitters can land requests after the loop's own
+        # drain pass — sweep again so none hangs at result()
+        self._shed_queue(ShuttingDown("batcher closed"))
+
+    # -- fault handling ----------------------------------------------------
+
+    def _shed_queue(self, error: Exception) -> None:
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            self._finish(req, error)
+
+    def _on_stall(self, elapsed: float) -> None:
+        """Watchdog monitor callback: a dispatch/consume wait crossed
+        N x rolling-p95.  Fail the resident requests NOW — their
+        clients get retriable 503s while the ring thread is still stuck
+        inside the wedged dispatch — and flag the rebuild the loop runs
+        once it unwedges."""
+        err = RetriableError(
+            f"compiled dispatch stalled {elapsed:.1f}s (watchdog "
+            f"threshold {self._watchdog.threshold():.1f}s); ring "
+            "rebuilding — retry")
+        for req in list(self.lane):
+            if req is not None and not req.done.is_set():
+                self._finish(req, err)
+        self._fault = err
+
+    def _on_hard_stall(self, elapsed: float) -> None:
+        """The stall outlived hard_stall_factor x threshold: the host
+        thread is unrecoverably stuck inside the runtime.  Flip
+        /healthz so the orchestrator replaces the pod (crash-only)."""
+        self.healthy = False
+
+    def _heal(self, err: Exception) -> bool:
+        """Self-heal after a ring-level fault: fail whatever is still
+        resident with a retriable error, rebuild every piece of device
+        state from scratch (cache, paged pool + radix cache, lane
+        state), back off exponentially.  Returns False — and flips
+        ``healthy`` — when the restart budget is exhausted (the loop
+        then dies the legacy way and /healthz goes unhealthy)."""
+        wrapped = (err if isinstance(err, RetriableError)
+                   else RetriableError(
+                       f"ring dispatch failed ({err}); rebuilt — retry"))
+        # decide + account for the restart BEFORE unblocking any client:
+        # a caller released by the _finish below may immediately read
+        # stats/healthy, and must see the restart it was shed for
+        healing = self._budget is not None and not self._budget.exhausted
+        if healing:
+            self._rebuilding = True
+            self.stats["watchdog_restarts"] += 1
+        else:
+            self.healthy = False
+        for req in list(self.lane):
+            if req is not None and not req.done.is_set():
+                self._finish(req, wrapped)
+        self.lane = [None] * self.slots
+        self._lane_out = [[] for _ in range(self.slots)]
+        self._lane_left = [0] * self.slots
+        self._lane_pos = [0] * self.slots
+        self._lane_first = [None] * self.slots
+        if not healing:
+            return False
+        backoff = self._budget.spend()
+        if self.paged:
+            self.pool = self._pg.PagedCacheManager(
+                self.slots, self.max_len, self.block_size,
+                self._num_blocks, prefix_cache=self._prefix_cache)
+            self.cache = self._pg.init_paged_cache(
+                self.cfg, self.slots, self.pool.total, self.block_size,
+                mesh=self.mesh)
+        else:
+            self.cache = init_ring_cache(self.cfg, self.slots,
+                                         self.max_len, mesh=self.mesh)
+        if self.spec_k:
+            self.dcache = init_ring_cache(self.draft_cfg, self.slots,
+                                          self.max_len, mesh=self.mesh)
+        self.tok = jnp.zeros((self.slots,), jnp.int32)
+        self.temp = jnp.zeros((self.slots,), jnp.float32)
+        self.keys = jnp.zeros((self.slots, 2), jnp.uint32)
+        self._stop.wait(backoff)
+        self._rebuilding = False
+        return True
+
+    def _expire_deadlines(self) -> None:
+        now = time.monotonic()
+        for i, req in enumerate(self.lane):
+            if (req is not None and req.deadline is not None
+                    and now >= req.deadline and not req.done.is_set()):
+                req.deadline_exceeded = True
+                self.stats["deadline_exceeded"] += 1
+                self._evict(i)        # resolves with the partial tokens
 
     # -- loop --------------------------------------------------------------
 
@@ -997,7 +1242,11 @@ class ContinuousBatcher:
 
     @staticmethod
     def _finish(req: _Request, error: Optional[Exception] = None) -> None:
-        if error is not None and req.error is None:
+        # a request that already RESOLVED keeps its outcome: attaching a
+        # late error (e.g. the loop's shutdown sweep racing abort()'s
+        # partial flush) would turn a delivered partial into a raise
+        if error is not None and req.error is None \
+                and not req.done.is_set():
             req.error = error
         # done BEFORE the stream sentinel: a stream() consumer that sees
         # the close must find result() already resolvable
@@ -1021,34 +1270,62 @@ class ContinuousBatcher:
             # this lane into the trash block
             self.pool.retire(slot)
         self.stats["evicted"] += 1
-        if req is not None:
+        if req is not None and not req.done.is_set():
             # error-path evictions can race ahead of the first consume
             self._materialize_first(slot, req)
             req.out = req.prompt + self._lane_out[slot]
             self._finish(req)
+        else:
+            # already resolved (watchdog stall / quarantine failed it
+            # from another thread): just release the lane state
+            self._lane_first[slot] = None
 
     def _loop(self) -> None:
         try:
             self._loop_body()
-        except Exception as e:       # device/compile failure: fail loudly
+        except Exception as e:       # unrecoverable failure: fail loudly
+            # flip dead-state BEFORE unblocking any client: a caller
+            # released by the _finish below may immediately submit
+            # again, and must be refused rather than queued into a void
+            self.healthy = False
+            self._stop.set()
             for req in self.lane:
                 if req is not None:
                     self._finish(req, e)
             self.lane = [None] * self.slots
-            self._stop.set()
         # drain: fail whatever is still queued or resident
         for i, req in enumerate(self.lane):
             if req is not None:
-                self._finish(req, RuntimeError("batcher closed"))
+                self._finish(req, ShuttingDown("batcher closed"))
                 self.lane[i] = None
-        while True:
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                break
-            self._finish(req, RuntimeError("batcher closed"))
+        self._shed_queue(ShuttingDown("batcher closed"))
 
-    def _consume(self, chunk_reqs, toks, counts=None) -> None:
+    def _scrub_lane_blocks(self, slot: int) -> None:
+        """Zero lane ``slot``'s PRIVATE pool blocks before they return
+        to the free list: a NaN row in a re-mapped block would poison
+        the next lane through the masked-tail contraction (softmax
+        underflows masked columns to exactly 0, but 0 * NaN = NaN) —
+        the same invariant the contiguous ring keeps by zeroing the
+        whole lane at splice, block-granular.
+
+        PUBLISHED (radix-cached) blocks are skipped: they hold shared
+        prefix KV other admissions still read, and this lane cannot
+        have poisoned them — every block the lane writes is private by
+        construction (admit CoWs any hit block at/after the first
+        written position).  One fused scatter over all victim blocks
+        per pool (not one eager update per block): each ``.at[].set``
+        materializes a full pool copy, and this runs on the ring
+        thread behind the in-flight chunk."""
+        row = self.pool.table[slot]
+        blks = [int(row[j]) for j in range(self.pool.mapped_count[slot])
+                if self.pool.ref[int(row[j])] == 1
+                and int(row[j]) not in self.pool.by_block]
+        if blks:
+            idx = jnp.asarray(blks)
+            self.cache["k"] = self.cache["k"].at[:, idx].set(0)
+            self.cache["v"] = self.cache["v"].at[:, idx].set(0)
+
+    def _consume(self, chunk_reqs, toks, counts=None, ok=None) -> None:
         """Apply one finished chunk's tokens ([chunk, slots] on host).
         ``chunk_reqs`` pins each lane to the REQUEST the chunk was
         dispatched for: under pipelining a lane may have been evicted
@@ -1061,9 +1338,26 @@ class ContinuousBatcher:
         token); None means every row is valid (plain chunk mode).  The
         budget/eos walk below is shared, so an eos landing mid-
         speculated-block truncates exactly like one landing mid-chunk —
-        no tokens after eos ever reach the result or the stream."""
+        no tokens after eos ever reach the result or the stream.
+
+        ``ok`` (nan_check mode): per-lane isfinite verdict for this
+        chunk — a False lane is QUARANTINED: its request fails
+        (:class:`LaneQuarantined`), its blocks are scrubbed + freed,
+        and no token of the poisoned chunk reaches any consumer.  The
+        other lanes are attention-independent, so their streams stay
+        bit-identical to a fault-free run."""
         for i, req in chunk_reqs:
-            if req is None or self.lane[i] is not req:
+            if req is None or self.lane[i] is not req \
+                    or req.done.is_set():
+                continue
+            if ok is not None and not bool(ok[i]):
+                self.stats["quarantined_lanes"] += 1
+                if self.pool is not None:
+                    self._scrub_lane_blocks(i)
+                self._finish(req, LaneQuarantined(
+                    f"lane {i} produced non-finite logits; request "
+                    "failed, lane quarantined (ring unaffected)"))
+                self._evict(i)
                 continue
             self._materialize_first(i, req)
             n = toks.shape[0] if counts is None else int(counts[i])
@@ -1088,6 +1382,26 @@ class ContinuousBatcher:
             if self._lane_left[i] <= 0:
                 self._evict(i)
 
+    def _consume_oldest(self, pending: List[tuple]) -> None:
+        """Pop + apply the oldest in-flight chunk.  The blocking
+        device->host completion wait sits under the watchdog: a wedged
+        dispatch surfaces HERE on real chips (dispatches are async), and
+        the monitor fails the waiting clients while this thread is still
+        stuck."""
+        chunk_reqs, toks_dev, counts_dev, ok_dev = pending.pop(0)
+        wd = self._watchdog
+        if wd is not None:
+            wd.begin()
+        try:
+            toks = np.asarray(toks_dev)
+            counts = None if counts_dev is None else np.asarray(counts_dev)
+            ok = None if ok_dev is None else np.asarray(ok_dev)
+        finally:
+            if wd is not None:
+                wd.end()
+        if self._fault is None:     # stall-failed chunks must not apply
+            self._consume(chunk_reqs, toks, counts, ok)
+
     def _loop_body(self) -> None:
         # Up to ``pipeline_depth`` chunks in flight at all times (when
         # lanes are active): the host consumes chunk N's tokens — per-
@@ -1097,8 +1411,25 @@ class ContinuousBatcher:
         # with compute; depth 1 was still RTT-bound on relayed chips
         # whose round-trip exceeds a chunk's device time (measured by
         # bench.py measure_ring_throughput), hence depth 2 by default.
-        pending: List[tuple] = []   # [(chunk_reqs, device toks, counts)]
+        pending: List[tuple] = []   # [(chunk_reqs, toks, counts, ok)]
         while not self._stop.is_set():
+            # ring-level fault (dispatch raised, or the watchdog
+            # declared a stall): drop the in-flight chunks and self-heal
+            # — rebuild everything device-side, re-admit queued work —
+            # or die (legacy / budget exhausted) via the raise, which
+            # the _loop wrapper turns into fail-everything + unhealthy
+            if self._fault is not None:
+                err, self._fault = self._fault, None
+                pending.clear()
+                if not self._heal(err):
+                    raise err
+                continue
+            if self._draining:
+                # drain: no new admissions; whatever is queued sheds
+                # with ShuttingDown (clients retry another replica)
+                self._shed_queue(ShuttingDown(
+                    "server draining; retry another replica"))
+            self._expire_deadlines()
             # cancelled lanes leave at the chunk boundary: the request
             # resolves with whatever tokens it has, the lane frees for
             # the next admission (serve.py calls cancel() when a stream
@@ -1107,12 +1438,21 @@ class ContinuousBatcher:
                 if r is not None and r._cancel:
                     self._evict(i)
             # admit into free lanes
-            while any(r is None for r in self.lane):
+            while not self._draining and any(r is None for r in self.lane):
                 try:
                     req = self._pending.get_nowait()
                 except queue.Empty:
                     break
                 if req._cancel:                 # cancelled while queued
+                    req.out = list(req.prompt)
+                    self._finish(req)
+                    continue
+                if (req.deadline is not None
+                        and time.monotonic() >= req.deadline):
+                    # expired while queued: prompt-only 504 partial —
+                    # resolved, never silently dropped
+                    req.deadline_exceeded = True
+                    self.stats["deadline_exceeded"] += 1
                     req.out = list(req.prompt)
                     self._finish(req)
                     continue
@@ -1133,10 +1473,10 @@ class ContinuousBatcher:
                           if r is not None]
             if not active_idx:
                 if pending:
-                    chunk_reqs, toks_dev, counts_dev = pending.pop(0)
-                    self._consume(chunk_reqs, np.asarray(toks_dev),
-                                  None if counts_dev is None
-                                  else np.asarray(counts_dev))
+                    try:
+                        self._consume_oldest(pending)
+                    except Exception as e:
+                        self._fault = e
                     continue            # eviction may have freed lanes
                 self._wake.wait(timeout=0.1)
                 self._wake.clear()
@@ -1159,7 +1499,7 @@ class ContinuousBatcher:
                 advance = (self.spec_k + 1) if self.spec_k else self.chunk
                 for i in list(active_idx):
                     inflight = sum(
-                        1 for chunk_reqs, _, _ in pending
+                        1 for chunk_reqs, _, _, _ in pending
                         for j, r in chunk_reqs
                         if j == i and r is self.lane[i])
                     try:
@@ -1176,42 +1516,66 @@ class ContinuousBatcher:
                 tbl = self.pool.device_table()
             active = jnp.asarray(
                 [r is not None for r in self.lane], bool)
-            # async dispatch: returns device futures immediately
-            if self.spec_k:
-                spec_args = (self.params, self.draft_params, self.cache,
-                             self.dcache)
-                if self.paged:
-                    spec_args += (tbl,)
-                (self.cache, self.dcache, self.tok, toks_dev,
-                 counts_dev) = self._spec_step(
-                    *spec_args, self.tok, self.temp, self.keys, active)
-            elif self.paged:
-                self.cache, self.tok, toks_dev = self._step(
-                    self.params, self.cache, tbl, self.tok, self.temp,
-                    self.keys, active)
-                counts_dev = None
-            else:
-                self.cache, self.tok, toks_dev = self._step(
-                    self.params, self.cache, self.tok, self.temp,
-                    self.keys, active)
-                counts_dev = None
+            # async dispatch: returns device futures immediately.  The
+            # watchdog brackets it anyway — a chaos-injected host-side
+            # hang (and a synchronous-dispatch backend) wedges HERE —
+            # and any raise becomes a ring fault handled at the loop top
+            # (fail resident requests retriably, rebuild, back off).
+            wd = self._watchdog
+            if wd is not None:
+                wd.begin()
+            try:
+                ok_dev = None
+                if self.spec_k:
+                    spec_args = (self.params, self.draft_params,
+                                 self.cache, self.dcache)
+                    if self.paged:
+                        spec_args += (tbl,)
+                    (self.cache, self.dcache, self.tok, toks_dev,
+                     counts_dev) = self._spec_step(
+                        *spec_args, self.tok, self.temp, self.keys,
+                        active)
+                elif self.paged:
+                    out = self._step(
+                        self.params, self.cache, tbl, self.tok,
+                        self.temp, self.keys, active)
+                    counts_dev = None
+                    if self._check_finite:
+                        self.cache, self.tok, toks_dev, ok_dev = out
+                    else:
+                        self.cache, self.tok, toks_dev = out
+                else:
+                    out = self._step(
+                        self.params, self.cache, self.tok, self.temp,
+                        self.keys, active)
+                    counts_dev = None
+                    if self._check_finite:
+                        self.cache, self.tok, toks_dev, ok_dev = out
+                    else:
+                        self.cache, self.tok, toks_dev = out
+            except Exception as e:
+                self._fault = e
+                continue
+            finally:
+                if wd is not None:
+                    wd.end()
             self.stats["chunks"] += 1
             # kick the device->host copy NOW, before the consume wait:
             # by consume time the tokens are already on the wire and
             # np.asarray is a cheap completion wait instead of a full
             # round-trip on the ring's critical path
-            for dev in (toks_dev, counts_dev):
+            for dev in (toks_dev, counts_dev, ok_dev):
                 try:
                     dev.copy_to_host_async()
                 except AttributeError:  # None / interpret-mode ndarray
                     pass
             pending.append(([(i, self.lane[i]) for i in active_idx],
-                            toks_dev, counts_dev))
+                            toks_dev, counts_dev, ok_dev))
             if len(pending) >= self.pipeline_depth:
-                chunk_reqs, toks_dev, counts_dev = pending.pop(0)
-                self._consume(chunk_reqs, np.asarray(toks_dev),
-                              None if counts_dev is None
-                              else np.asarray(counts_dev))
+                try:
+                    self._consume_oldest(pending)
+                except Exception as e:
+                    self._fault = e
 
 
 def _default_buckets(max_len: int) -> Tuple[int, ...]:

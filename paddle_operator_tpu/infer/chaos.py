@@ -1,0 +1,188 @@
+"""Deterministic chaos harness for the serving ring.
+
+Fault-tolerance code that is only exercised by real outages is dead
+code with a pager attached.  This module injects the failures
+infer/resilience.py exists to absorb — at DETERMINISTIC points, so the
+chaos suite (tests/test_resilience.py, the dryrun ``serve-chaos`` gate,
+``make chaos``, bench.py ``measure_resilience``) reproduces bit-for-bit
+run over run:
+
+- faults fire at **dispatch indices**, not wall-clock times: the ring's
+  dispatch counter is the injector's clock, so a schedule means the
+  same thing on a fast TPU and a slow CI box;
+- the only randomness (picking a victim lane when the schedule names
+  none) comes from a **seeded** ``random.Random``.
+
+Schedule syntax (also the ``TPUJOB_CHAOS`` env var)::
+
+    kind@index[:arg][,kind@index[:arg]...]
+
+    dispatch_fail@5          raise from the compiled dispatch #5
+    dispatch_hang@9:2.5      sleep 2.5s inside dispatch #9 (stall)
+    nan_lane@12:1            poison lane 1's KV with NaN before #12
+    client_drop@7            cancel a resident request before #7
+    pool_oom@3:2             next 2 pool allocations raise NoFreeBlocks
+
+The injector wraps the batcher's resident step fn(s) in place
+(:meth:`ChaosInjector.install`), so admission, consume bookkeeping, and
+the self-healing machinery all run their REAL code — only the device
+dispatch lies.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+CHAOS_ENV = "TPUJOB_CHAOS"
+CHAOS_SEED_ENV = "TPUJOB_CHAOS_SEED"
+
+KINDS = ("dispatch_fail", "dispatch_hang", "nan_lane", "client_drop",
+         "pool_oom")
+
+
+@dataclass
+class ChaosEvent:
+    kind: str
+    at: int                        # dispatch index the event fires before
+    arg: Optional[float] = None    # hang seconds / lane / alloc count
+
+
+def parse_schedule(spec: str) -> List[ChaosEvent]:
+    """``"dispatch_fail@5,nan_lane@12:1"`` -> events.  Raises ValueError
+    on unknown kinds or malformed entries — a typo'd chaos schedule
+    silently injecting nothing would fake a green gate."""
+    events: List[ChaosEvent] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "@" not in part:
+            raise ValueError(f"chaos entry {part!r}: expected kind@index")
+        kind, rest = part.split("@", 1)
+        if kind not in KINDS:
+            raise ValueError(f"chaos kind {kind!r} not in {KINDS}")
+        arg: Optional[float] = None
+        if ":" in rest:
+            rest, argstr = rest.split(":", 1)
+            arg = float(argstr)
+        events.append(ChaosEvent(kind, int(rest), arg))
+    return events
+
+
+class ChaosInjector:
+    """Wraps a ContinuousBatcher's resident dispatch with a seeded
+    fault schedule.  ``fired`` records (kind, dispatch_index) in firing
+    order — the determinism assertion tests pin."""
+
+    def __init__(self, schedule, seed: int = 0) -> None:
+        if isinstance(schedule, str):
+            schedule = parse_schedule(schedule)
+        self.events: Dict[int, List[ChaosEvent]] = {}
+        for ev in schedule:
+            self.events.setdefault(ev.at, []).append(ev)
+        self.rng = random.Random(seed)
+        self.dispatches = 0
+        self.fired: List[tuple] = []
+        self.batcher: Any = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def install(self, batcher) -> "ChaosInjector":
+        """Replace the batcher's compiled step attribute(s) with the
+        faulting wrapper.  Call BEFORE submitting work; the wrapper
+        survives ring rebuilds (self-healing re-uses the same compiled
+        program objects)."""
+        self.batcher = batcher
+        if getattr(batcher, "spec_k", 0):
+            batcher._spec_step = self._wrap(batcher._spec_step)
+        else:
+            batcher._step = self._wrap(batcher._step)
+        return self
+
+    def _wrap(self, real):
+        def step(*args):
+            idx = self.dispatches
+            self.dispatches += 1
+            for ev in self.events.get(idx, ()):
+                self._apply(ev, idx, args)
+            return real(*args)
+
+        return step
+
+    # -- faults ------------------------------------------------------------
+
+    def _apply(self, ev: ChaosEvent, idx: int, args) -> None:
+        self.fired.append((ev.kind, idx))
+        if ev.kind == "dispatch_fail":
+            raise RuntimeError(
+                f"chaos: injected dispatch failure @ dispatch {idx}")
+        if ev.kind == "dispatch_hang":
+            time.sleep(ev.arg if ev.arg is not None else 1.0)
+            return
+        if ev.kind == "pool_oom":
+            pool = getattr(self.batcher, "pool", None)
+            if pool is not None:
+                pool.chaos_fail_allocs += int(ev.arg or 1)
+            return
+        if ev.kind == "client_drop":
+            slot = self._victim(ev)
+            if slot is not None:
+                req = self.batcher.lane[slot]
+                if req is not None:
+                    req.cancel()
+            return
+        if ev.kind == "nan_lane":
+            slot = self._victim(ev)
+            if slot is not None:
+                self._poison(slot)
+
+    def _victim(self, ev: ChaosEvent) -> Optional[int]:
+        """The schedule's lane, or a seeded pick among resident lanes
+        (None when the ring is idle — the event is recorded but a fault
+        with no victim is a no-op)."""
+        if ev.arg is not None:
+            return int(ev.arg)
+        active = [i for i, r in enumerate(self.batcher.lane)
+                  if r is not None]
+        if not active:
+            return None
+        return self.rng.choice(active)
+
+    def _poison(self, slot: int) -> None:
+        """Write NaN into lane ``slot``'s K cache so its next logits go
+        non-finite.  Lanes are attention-independent, so ONLY this
+        lane's stream is poisoned — the quarantine path must fail one
+        request and leave every other stream bit-identical.  Runs on
+        the ring thread (inside the wrapped dispatch), so mutating
+        ``batcher.cache`` is ordered with the real dispatches."""
+        import numpy as np
+
+        b = self.batcher
+        if getattr(b, "paged", False):
+            # poison one PRIVATE (refcount-1, uncached) mapped block —
+            # a shared prefix block would poison other lanes' streams
+            pool = b.pool
+            row = pool.table[slot]
+            for j in range(pool.mapped_count[slot]):
+                blk = int(row[j])
+                if pool.ref[blk] == 1 and blk not in pool.by_block:
+                    b.cache["k"] = b.cache["k"].at[:, blk].set(np.nan)
+                    return
+            return
+        b.cache["k"] = b.cache["k"].at[:, slot].set(np.nan)
+
+
+def maybe_install_from_env(batcher, env=None) -> Optional[ChaosInjector]:
+    """serve.py hook: ``TPUJOB_CHAOS`` set -> install the injector on
+    the live server's ring (smoke-testing a deployment's resilience
+    end-to-end); unset -> no-op."""
+    env = os.environ if env is None else env
+    spec = env.get(CHAOS_ENV, "")
+    if not spec:
+        return None
+    seed = int(env.get(CHAOS_SEED_ENV, "0"))
+    return ChaosInjector(spec, seed=seed).install(batcher)

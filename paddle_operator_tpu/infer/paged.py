@@ -129,6 +129,11 @@ class PagedCacheManager:
         self.by_block: Dict[int, Any] = {}              # block -> chain key
         self.children: Dict[Any, set] = {}              # parent key -> keys
         self._tick = 0
+        # chaos hook (infer/chaos.py pool_oom): the next N allocations
+        # raise NoFreeBlocks regardless of free-list state, so the
+        # starvation/eviction paths are exercisable deterministically
+        # without actually draining the pool
+        self.chaos_fail_allocs = 0
         self.stats = {
             "prefix_lookup_tokens": 0, "prefix_hit_tokens": 0,
             "prefix_lookups": 0, "prefix_full_hits": 0,
@@ -146,6 +151,9 @@ class PagedCacheManager:
                    if self.ref[e.block] == 0)
 
     def _alloc_one(self) -> int:
+        if self.chaos_fail_allocs > 0:
+            self.chaos_fail_allocs -= 1
+            raise NoFreeBlocks("chaos: injected pool OOM")
         if not self.free:
             self._evict_lru()
         blk = self.free.pop()
@@ -580,7 +588,8 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
 def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                           top_k: Optional[int] = None,
-                          top_p: Optional[float] = None, mesh=None):
+                          top_p: Optional[float] = None, mesh=None,
+                          check_finite: bool = False):
     """The resident compiled decode program of the PAGED ring — the
     exact contract of batcher.make_chunk_step plus the block table:
 
@@ -590,20 +599,35 @@ def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     Retired/inactive lanes additionally get their position ZEROED (the
     serving-status staleness fix) — their writes route to the trash
     block through the zeroed table row, so nothing they do can touch a
-    re-allocated block."""
+    re-allocated block.
+
+    ``check_finite=True``: a fourth ``ok [B]`` output — the per-lane
+    isfinite fold of every tick's logits (batcher NaN-lane quarantine;
+    see make_chunk_step)."""
     from paddle_operator_tpu.infer.batcher import _sample_tokens
 
     def step(params, cache, table, tok, temp, keys, active):
         def tick(carry, _):
-            cache, tok = carry
+            if check_finite:
+                cache, tok, ok = carry
+            else:
+                cache, tok = carry
             logits, new_cache = paged_ring_forward(cfg, params, tok, cache,
                                                    table, mesh=mesh)
             nxt = _sample_tokens(logits, temp, keys, cache["pos"],
                                  top_k, top_p)
             new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
             nxt = jnp.where(active, nxt, tok)
+            if check_finite:
+                ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
+                return (new_cache, nxt, ok), nxt
             return (new_cache, nxt), nxt
 
+        if check_finite:
+            (cache, tok, ok), toks = jax.lax.scan(
+                tick, (cache, tok, jnp.ones(tok.shape, bool)), None,
+                length=chunk_tokens)
+            return cache, tok, toks, ok
         (cache, tok), toks = jax.lax.scan(
             tick, (cache, tok), None, length=chunk_tokens)
         return cache, tok, toks

@@ -1,0 +1,453 @@
+"""Serving-path fault tolerance: deadlines, drain, dispatch watchdog.
+
+The operator's whole pitch (PAPER.md) is lifecycle robustness — drain,
+restart accounting, status conditions — and PR 2 built exactly that for
+TRAINING (ft/preemption.py: SIGTERM -> finish the step -> durable
+checkpoint -> ``EXIT_PREEMPTED``).  The serving ring had none of it: a
+stuck compiled dispatch hung every lane forever, SIGTERM killed
+in-flight requests silently, and one slow client could pin a lane (and
+its paged KV blocks) to its full token budget.  This module is the
+serving half of the same contract, in the crash-only spirit of Candea &
+Fox: traffic degrades by shedding INDIVIDUAL requests (a deadline
+partial, a retriable 503) instead of losing the ring, and when the ring
+itself is sick it is rebuilt from scratch — never patched in place.
+
+Pieces (all host-side; nothing here imports jax):
+
+- **Typed failure surface** — :class:`ShuttingDown` /
+  :class:`RetriableError` (503 + ``Retry-After``: the request was fine,
+  the server was not), :class:`DeadlineExceeded` (504: the budget ran
+  out; partial tokens are still delivered), :class:`LaneQuarantined`
+  (the lane's numerics went non-finite; one request fails, the ring
+  survives).
+- :class:`RingResilience` — the knobs (watchdog thresholds, restart
+  budget, backoff, NaN check), env-constructable for serve.py.
+- :class:`DispatchWatchdog` — a monitor thread that times every
+  blocking device interaction against N x rolling-p95 and fires a
+  stall callback when one wedges, so waiting clients get fast 503s
+  even while the host thread is still stuck inside XLA.
+- :class:`RestartBudget` — exponential backoff with a hard cap; when
+  the cap is spent the ring stops self-healing and flips ``/healthz``
+  unhealthy so the orchestrator replaces the pod (crash-only again).
+- :class:`ServingDrain` — SIGTERM -> stop admissions (503 +
+  ``Retry-After``) -> finish in-flight lanes within a drain budget ->
+  flush partials -> exit ``EXIT_PREEMPTED`` so the reconciler's
+  preempted-not-failed accounting (controller/builders.py
+  is_pod_preempted) covers serving pods exactly like trainers.  A
+  second SIGTERM means the platform is out of patience: immediate
+  best-effort flush and exit.
+
+The deterministic fault injector that exercises every one of these
+paths lives in infer/chaos.py; tests/test_resilience.py and the dryrun
+``serve-chaos`` gate pin the behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+# Serving pods exit with the SAME code trainers drain to — the
+# reconciler already counts it as capacity loss, not program failure.
+from paddle_operator_tpu.ft.preemption import EXIT_PREEMPTED  # noqa: F401
+
+
+# ---------------------------------------------------------------------------
+# Failure surface
+# ---------------------------------------------------------------------------
+
+
+class ShuttingDown(RuntimeError):
+    """The server is draining (SIGTERM) or closed: the request was
+    never started and is safe to retry elsewhere.  serve.py maps it to
+    503 + ``Retry-After``."""
+
+
+class RetriableError(RuntimeError):
+    """The ring failed underneath this request (dispatch fault, stall,
+    self-healing rebuild) — nothing was wrong with the request; retry
+    it.  serve.py maps it to 503 + ``Retry-After``."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before generation finished.  The
+    request still RESOLVES (with the tokens produced so far — the
+    504-style partial); this type only appears when a caller asks why
+    the stream stopped short."""
+
+
+class LaneQuarantined(RetriableError):
+    """This lane's logits went non-finite (NaN/inf) — its request is
+    failed and the lane retired (blocks scrubbed + freed) WITHOUT
+    touching the other lanes.  Retriable: re-admission re-prefills from
+    clean state, and transient numerics (a cosmic-rayed HBM row, a bad
+    chip) often do not reproduce."""
+
+
+# ---------------------------------------------------------------------------
+# Config
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RingResilience:
+    """Fault-tolerance knobs for one ContinuousBatcher.
+
+    Passing an instance turns self-healing ON: ring-level dispatch
+    failures fail the in-flight requests retriably and rebuild the ring
+    (fresh cache/pool, queued work re-admitted) behind exponential
+    backoff, up to ``max_restarts``; exhausting the budget flips the
+    batcher unhealthy (``/healthz``) instead of looping forever.
+    Without one the batcher keeps its legacy die-on-error behavior.
+    """
+
+    # stall threshold: max(stall_floor_s, stall_factor * rolling-p95 of
+    # recent dispatch/consume waits).  The floor must comfortably clear
+    # a first-dispatch XLA compile (tens of seconds on CPU).
+    watchdog: bool = True
+    stall_factor: float = 8.0
+    stall_floor_s: float = 60.0
+    # a stall that ALSO exceeds hard_stall_factor x the threshold is a
+    # wedged device: the process cannot recover itself (the host thread
+    # is stuck inside XLA), so healthz flips and the pod gets replaced
+    hard_stall_factor: float = 4.0
+    poll_s: float = 0.05
+    # self-healing budget: restarts are cheap but not free (every
+    # resident request fails retriably), and a ring that needs them
+    # continuously is broken hardware — stop and let k8s replace the
+    # pod.  The budget REFILLS after restart_window_s without another
+    # restart (crash-loop-backoff style): it bounds restart DENSITY,
+    # not lifetime count — a long-lived pod healing one transient fault
+    # a week must not die on the max_restarts-th week.
+    max_restarts: int = 3
+    restart_window_s: float = 300.0
+    backoff_base_s: float = 0.25
+    backoff_max_s: float = 10.0
+    # per-dispatch isfinite fold over the chunk's logits: quarantines a
+    # NaN-producing lane (fail ONE request, never the ring).  Off by
+    # default — it adds a [slots] bool output to the resident program.
+    nan_check: bool = False
+
+    @classmethod
+    def from_env(cls, env=None) -> "RingResilience":
+        """serve.py construction: SERVE_WATCHDOG_FACTOR/FLOOR,
+        SERVE_MAX_RESTARTS, SERVE_NAN_CHECK (docs/serving.md)."""
+        env = os.environ if env is None else env
+        return cls(
+            watchdog=env.get("SERVE_WATCHDOG", "1") == "1",
+            stall_factor=float(env.get("SERVE_WATCHDOG_FACTOR", "8")),
+            stall_floor_s=float(env.get("SERVE_WATCHDOG_FLOOR_S", "60")),
+            max_restarts=int(env.get("SERVE_MAX_RESTARTS", "3")),
+            restart_window_s=float(env.get("SERVE_RESTART_WINDOW_S",
+                                           "300")),
+            nan_check=env.get("SERVE_NAN_CHECK", "0") == "1",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Rolling quantile + watchdog
+# ---------------------------------------------------------------------------
+
+
+class RollingQuantile:
+    """Nearest-rank quantile over the last ``window`` samples — the
+    rolling p95 the stall threshold scales from.  Tiny windows and rare
+    updates: a sorted copy per query is cheaper than a tree."""
+
+    def __init__(self, q: float = 0.95, window: int = 64) -> None:
+        self.q = q
+        self.window = window
+        self._xs: List[float] = []
+        self._lock = threading.Lock()
+
+    def add(self, x: float) -> None:
+        with self._lock:
+            self._xs.append(float(x))
+            if len(self._xs) > self.window:
+                del self._xs[0]
+
+    def value(self) -> Optional[float]:
+        with self._lock:
+            if not self._xs:
+                return None
+            xs = sorted(self._xs)
+        return xs[min(len(xs) - 1, int(round(self.q * (len(xs) - 1))))]
+
+
+class DispatchWatchdog:
+    """Times every blocking device interaction of one ring against
+    ``max(floor, factor * rolling-p95)``.
+
+    The ring thread brackets each region (``begin()``/``end()`` or the
+    ``watch()`` context manager); a daemon monitor thread polls the
+    in-flight region and fires ``on_stall(elapsed)`` ONCE when it
+    crosses the threshold — while the ring thread is still stuck, which
+    is the point: clients get their retriable 503s immediately instead
+    of after the wedge resolves (if it ever does).  A region that also
+    crosses ``hard_stall_factor x threshold`` fires ``on_hard_stall``:
+    the host thread is unrecoverably stuck inside the runtime and only
+    a pod replacement clears it.
+    """
+
+    def __init__(self, cfg: RingResilience,
+                 on_stall: Callable[[float], None],
+                 on_hard_stall: Optional[Callable[[float], None]] = None
+                 ) -> None:
+        self.cfg = cfg
+        self._on_stall = on_stall
+        self._on_hard = on_hard_stall
+        self._p95 = RollingQuantile(0.95)
+        self._lock = threading.Lock()
+        self._start: Optional[float] = None
+        self._gen = 0                 # region id, so a stall fires once
+        self._stalled_gen = -1
+        self._hard_gen = -1
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._monitor, daemon=True,
+                                        name="dispatch-watchdog")
+        self._thread.start()
+
+    # -- ring-thread side --------------------------------------------------
+
+    def begin(self) -> None:
+        with self._lock:
+            self._gen += 1
+            self._start = time.monotonic()
+
+    def end(self) -> None:
+        with self._lock:
+            if self._start is None:
+                return
+            dur = time.monotonic() - self._start
+            # a region already DECLARED stalled must not feed the p95:
+            # one 100s wedge would drag the threshold to factor x 100s
+            # and blind the watchdog to every later stall
+            if self._gen != self._stalled_gen:
+                self._p95.add(dur)
+            self._start = None
+
+    class _Watch:
+        def __init__(self, wd):
+            self._wd = wd
+
+        def __enter__(self):
+            self._wd.begin()
+
+        def __exit__(self, *exc):
+            self._wd.end()
+            return False
+
+    def watch(self) -> "DispatchWatchdog._Watch":
+        return self._Watch(self)
+
+    # -- monitor side ------------------------------------------------------
+
+    def threshold(self) -> float:
+        p95 = self._p95.value()
+        if p95 is None:
+            return self.cfg.stall_floor_s
+        return max(self.cfg.stall_floor_s, self.cfg.stall_factor * p95)
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.cfg.poll_s):
+            with self._lock:
+                start, gen = self._start, self._gen
+                stalled, hard = self._stalled_gen, self._hard_gen
+            if start is None:
+                continue
+            elapsed = time.monotonic() - start
+            thr = self.threshold()
+            if elapsed > thr and gen != stalled:
+                with self._lock:
+                    self._stalled_gen = gen
+                try:
+                    self._on_stall(elapsed)
+                except Exception:
+                    pass
+            if (self._on_hard is not None
+                    and elapsed > thr * self.cfg.hard_stall_factor
+                    and gen != hard):
+                with self._lock:
+                    self._hard_gen = gen
+                try:
+                    self._on_hard(elapsed)
+                except Exception:
+                    pass
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class RestartBudget:
+    """Exponential backoff with a restart-density cap.
+
+    ``spend()`` returns the backoff seconds to sleep before the rebuild
+    (0.25s, 0.5s, 1s, ... capped) — callers check :attr:`exhausted`
+    FIRST; an exhausted budget means the ring stops self-healing and
+    the pod's /healthz flips so the orchestrator replaces it.  A quiet
+    ``restart_window_s`` since the last restart refills the budget (and
+    resets the backoff ladder): the cap is on restarts-per-window, not
+    per-lifetime, so transient faults weeks apart never kill a healthy
+    long-lived pod.  ``clock`` is injectable for tests."""
+
+    def __init__(self, cfg: RingResilience, clock=time.monotonic) -> None:
+        self.cfg = cfg
+        self.used = 0
+        self._clock = clock
+        self._last: Optional[float] = None
+
+    def _refill(self) -> None:
+        if (self._last is not None and self.used
+                and self._clock() - self._last
+                >= self.cfg.restart_window_s):
+            self.used = 0
+
+    @property
+    def exhausted(self) -> bool:
+        self._refill()
+        return self.used >= self.cfg.max_restarts
+
+    def spend(self) -> float:
+        self._refill()
+        backoff = min(self.cfg.backoff_max_s,
+                      self.cfg.backoff_base_s * (2 ** self.used))
+        self.used += 1
+        self._last = self._clock()
+        return backoff
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain for the server
+# ---------------------------------------------------------------------------
+
+
+class ServerState:
+    """Shared readiness flags between the HTTP handler threads and the
+    drain/ring machinery (plain attrs; writes are single-word stores
+    under the GIL)."""
+
+    def __init__(self) -> None:
+        self.draining = False
+        # seconds the 503 Retry-After advertises while draining — long
+        # enough for the replacement pod to come up behind the Service
+        self.retry_after_s = 5
+
+
+class ServingDrain:
+    """The serving half of the ft/preemption.py drain contract.
+
+    First SIGTERM (via a :class:`~paddle_operator_tpu.ft.preemption.
+    PreemptionWatcher` this object chains onto): stop admissions (every
+    new request gets 503 + ``Retry-After``), let resident lanes finish
+    within ``budget_s``, cancel stragglers at the budget (their callers
+    receive the tokens produced so far — partials are flushed, not
+    dropped), shut the HTTP server down, exit ``EXIT_PREEMPTED`` so the
+    reconciler restarts the pod without burning ``maxRestarts``.
+
+    Second SIGTERM: the platform's grace period is nearly up — cancel
+    everything best-effort and exit ``EXIT_PREEMPTED`` NOW (partials
+    flush at the next chunk boundary if one lands, and are lost
+    otherwise; an undrained kill would have lost them anyway).
+
+    ``exit_fn`` is injectable for tests (production: ``os._exit`` —
+    serve_forever holds the main thread, a SystemExit from a drain
+    thread would be swallowed)."""
+
+    def __init__(self, server, state: ServerState, *,
+                 batcher=None, budget_s: float = 30.0,
+                 handler_grace_s: float = 2.0,
+                 exit_fn: Optional[Callable[[int], None]] = None) -> None:
+        self.server = server
+        self.state = state
+        self.batcher = batcher
+        self.budget_s = budget_s
+        self.handler_grace_s = handler_grace_s
+        self._exit = exit_fn or (lambda code: os._exit(code))
+        self._signals = 0
+        self._prev = None
+        self._started = threading.Event()
+        self.done = threading.Event()     # drain ran to completion
+
+    # -- wiring ------------------------------------------------------------
+
+    def install(self, watcher, sig: int = signal.SIGTERM) -> None:
+        """Chain onto an installed PreemptionWatcher: its on_drain
+        callback starts the drain (so notice-file triggers work too),
+        and our own handler in FRONT of it counts repeat signals for
+        the immediate-exit escalation.  Must run on the main thread
+        (CPython signal rule), before ``serve_forever``."""
+        watcher.on_drain(lambda reason: self.start_async(reason))
+        self._prev = signal.signal(sig, self._handler)
+
+    def _handler(self, signum, frame) -> None:
+        self._signals += 1
+        if self._signals >= 2:
+            self.hard_exit()
+            return
+        prev = self._prev
+        if callable(prev):
+            prev(signum, frame)       # the watcher's handler -> trigger
+
+    # -- the sequence ------------------------------------------------------
+
+    def start_async(self, reason: str = "signal") -> None:
+        """Run the drain on its own thread — the signal handler (or the
+        watcher's trigger) must return immediately."""
+        if self._started.is_set():
+            return
+        threading.Thread(target=self.run, args=(reason,), daemon=True,
+                         name="serving-drain").start()
+
+    def run(self, reason: str = "manual") -> None:
+        """The drain sequence, callable directly from tests."""
+        if self._started.is_set():
+            return
+        self._started.set()
+        self.state.draining = True
+        try:
+            if self.batcher is not None:
+                self.batcher.drain(self.budget_s)
+            try:
+                self.server.shutdown()
+            except Exception:
+                pass
+            # the batcher just RESOLVED the last requests, but their
+            # HTTP handler threads may still be writing the partial
+            # responses — shutdown() only stops the accept loop.  Give
+            # them a bounded beat before the exit below kills the
+            # process mid-write, or "partials flushed" would be a lie
+            # exactly at the finish line.
+            threads = getattr(self.server, "_threads", None)
+            deadline = time.monotonic() + self.handler_grace_s
+            if threads is None:
+                time.sleep(min(0.2, self.handler_grace_s))
+            else:
+                while (any(t.is_alive() for t in list(threads))
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+        finally:
+            self.done.set()
+            # inside the finally ON PURPOSE: if the drain itself raised,
+            # dying WITHOUT the exit would leave a pod serving only 503s
+            # until the kubelet SIGKILLs it — exit 137, a budget-burning
+            # "program failure" instead of the preemption this was
+            self._exit(EXIT_PREEMPTED)
+
+    def hard_exit(self) -> None:
+        """Second-signal semantics: immediate exit, partials flushed
+        best-effort (cancel marks every lane; whatever the ring already
+        emitted has been delivered to result()/stream() consumers)."""
+        self.state.draining = True
+        if self.batcher is not None:
+            try:
+                self.batcher.abort(ShuttingDown(
+                    "server killed (second SIGTERM)"))
+            except Exception:
+                pass
+        self.done.set()
+        self._exit(EXIT_PREEMPTED)

@@ -115,7 +115,7 @@ class ContinuousGenerator:
                  top_p: Optional[float] = None,
                  eos_token: Optional[int] = None,
                  seed: int = 0) -> list:
-        rows, _ = self.generate_rows(
+        rows, _, _ = self.generate_rows(
             tokens, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, eos_token=eos_token, seed=seed)
         return rows
@@ -125,12 +125,15 @@ class ContinuousGenerator:
                       top_k: Optional[int] = None,
                       top_p: Optional[float] = None,
                       eos_token: Optional[int] = None, seed: int = 0,
-                      request_id: Optional[str] = None):
+                      request_id: Optional[str] = None,
+                      deadline_s: Optional[float] = None):
         """Rows + per-row speculative accept rates (None entries when
-        the ring is not speculative) — the handler surfaces the rates
-        per response when SERVE_SPEC_K is on.  ``request_id`` (the
-        client's, or the handler's fallback) is threaded into
-        ``submit`` per row so capacity rejections name the offender."""
+        the ring is not speculative) + per-row deadline-exceeded flags
+        (a flagged row carries the PARTIAL tokens produced before its
+        ``deadline_s`` budget ran out — the handler's 504-style
+        response).  ``request_id`` (the client's, or the handler's
+        fallback) is threaded into ``submit`` per row so capacity
+        rejections name the offender."""
         if (top_k, top_p) != (self.batcher._top_k, self.batcher._top_p) \
                 and (top_k is not None or top_p is not None):
             raise ValueError(
@@ -143,7 +146,7 @@ class ContinuousGenerator:
                 reqs.append(self.batcher.submit(
                     row, max_new_tokens=max_new_tokens,
                     temperature=temperature, seed=seed + i,
-                    eos_token=eos_token,
+                    eos_token=eos_token, deadline_s=deadline_s,
                     request_id=(f"{request_id}/row{i}"
                                 if request_id is not None else None)))
             # ragged rows: sequences stop at eos, no rectangular array
@@ -156,7 +159,8 @@ class ContinuousGenerator:
             for r in reqs:
                 r.cancel()
             raise
-        return rows, [r.accept_rate for r in reqs]
+        return (rows, [r.accept_rate for r in reqs],
+                [r.deadline_exceeded for r in reqs])
 
     def close(self) -> None:
         self.batcher.close()
@@ -164,6 +168,7 @@ class ContinuousGenerator:
 
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator  # injected
+    state = None          # injected resilience.ServerState
     # chunked transfer (the streaming path) requires HTTP/1.1; plain
     # responses carry Content-Length so keep-alive stays correct, and
     # the socket timeout reaps idle/half-dead keep-alive connections
@@ -174,17 +179,45 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
-    def _send(self, code: int, obj) -> None:
+    def _send(self, code: int, obj, headers=None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
+    def _batcher(self):
+        return getattr(self.generator, "batcher", None)
+
     def do_GET(self):
+        # liveness vs readiness split (docs/serving.md resilience):
+        # /healthz answers "should this pod be REPLACED" — 200 while
+        # the process is up and the ring has not permanently died
+        # (watchdog restart budget exhausted / wedged dispatch);
+        # /readyz answers "should this pod take TRAFFIC" — also false
+        # while merely draining or mid-self-heal, states /healthz must
+        # NOT report (a restart would turn a 30s drain into lost work).
         if self.path == "/healthz":
-            self._send(200, {"ok": True})
+            b = self._batcher()
+            if b is not None and not b.healthy:
+                self._send(503, {"ok": False, "reason": "ring dead"})
+            else:
+                self._send(200, {"ok": True})
+        elif self.path == "/readyz":
+            b = self._batcher()
+            draining = bool(self.state and self.state.draining)
+            ready = not draining and (b is None or b.accepting)
+            if ready:
+                self._send(200, {"ready": True})
+            else:
+                self._send(503, {
+                    "ready": False,
+                    "reason": ("draining" if draining else "ring"),
+                }, headers={"Retry-After":
+                            self.state.retry_after_s if self.state else 5})
         else:
             self._send(404, {})
 
@@ -213,7 +246,8 @@ class _Handler(BaseHTTPRequestHandler):
             tokens[0], max_new_tokens=int(req.get("max_new_tokens", 32)),
             temperature=float(req.get("temperature", 0.0)),
             seed=int(req.get("seed", 0)), eos_token=req.get("eos_token"),
-            stream=True, request_id=req.get("request_id"))
+            stream=True, request_id=req.get("request_id"),
+            deadline_s=req.get("deadline_s"))
 
         def emit(obj) -> None:
             body = json.dumps(obj).encode() + b"\n"
@@ -235,6 +269,8 @@ class _Handler(BaseHTTPRequestHandler):
             done_ev = {"done": True, "tokens": handle.result(timeout=5)}
             if handle.accept_rate is not None:   # speculative ring
                 done_ev["accept_rate"] = handle.accept_rate
+            if handle.deadline_exceeded:         # 504-style partial
+                done_ev["deadline_exceeded"] = True
             emit(done_ev)
             self.wfile.write(b"0\r\n\r\n")
         except OSError:
@@ -254,6 +290,11 @@ class _Handler(BaseHTTPRequestHandler):
             handle.cancel()
 
     def do_POST(self):
+        from paddle_operator_tpu.infer.resilience import (
+            RetriableError,
+            ShuttingDown,
+        )
+
         # drain the body before ANY response: under HTTP/1.1 keep-alive
         # an unread body would be parsed as the next request's start line
         n = int(self.headers.get("Content-Length", 0))
@@ -261,9 +302,28 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/v1/generate":
             self._send(404, {})
             return
+        retry_hdr = {"Retry-After":
+                     self.state.retry_after_s if self.state else 5}
+        if self.state is not None and self.state.draining:
+            # SIGTERM drain: admissions stop FIRST — clients get an
+            # explicit retry signal while resident lanes finish
+            self._send(503, {"error": "server draining"},
+                       headers=retry_hdr)
+            return
         try:
             req = json.loads(body)
+            # per-request deadline: the X-Request-Deadline header
+            # (seconds, the load-balancer convention) or the body's
+            # deadline_s — whichever is set; an expired request resolves
+            # with the tokens produced so far and a 504-style marker
+            # instead of pinning its lane
+            deadline_s = req.get("deadline_s")
+            hdr = self.headers.get("X-Request-Deadline")
+            if deadline_s is None and hdr is not None:
+                deadline_s = float(hdr)
             if req.get("stream"):
+                if deadline_s is not None:
+                    req["deadline_s"] = float(deadline_s)
                 return self._stream_generate(req)
             tokens = np.asarray(req["tokens"], np.int32)
             if tokens.ndim != 2:
@@ -279,17 +339,32 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(gen, ContinuousGenerator):
                 # request_id (client-supplied) flows into submit so
                 # validation errors in multi-request logs name their row
-                rows, rates = gen.generate_rows(
-                    tokens, request_id=req.get("request_id"), **opts)
+                rows, rates, expired = gen.generate_rows(
+                    tokens, request_id=req.get("request_id"),
+                    deadline_s=(float(deadline_s)
+                                if deadline_s is not None else None),
+                    **opts)
+                resp = {"tokens": rows}
                 if getattr(gen.batcher, "spec_k", 0) > 0:
                     # speculative ring: acceptance rides every response
-                    self._send(200, {"tokens": rows, "accept_rate": rates})
-                else:
-                    self._send(200, {"tokens": rows})
+                    resp["accept_rate"] = rates
+                if any(expired):
+                    # deadline partials: 504 when EVERY row ran out
+                    # (the whole request missed its budget), 200 with
+                    # per-row flags on a mixed batch — either way the
+                    # partial tokens are delivered, never dropped
+                    resp["deadline_exceeded"] = expired
+                    self._send(504 if all(expired) else 200, resp)
+                    return
+                self._send(200, resp)
                 return
             out = gen(tokens, **opts)
             out = out if isinstance(out, list) else out.tolist()
             self._send(200, {"tokens": out})
+        except (ShuttingDown, RetriableError) as e:
+            # the request was fine, the server was not: an explicit
+            # retry signal (drain shed, watchdog rebuild in progress)
+            self._send(503, {"error": str(e)}, headers=retry_hdr)
         except (ValueError, KeyError, TypeError,
                 json.JSONDecodeError) as e:
             self._send(400, {"error": str(e)})
@@ -311,11 +386,18 @@ def make_server(host: str, port: int, params: Any, cfg: LlamaConfig,
     unchanged.  The returned server carries ``.generator`` — call its
     ``close()`` when tearing a continuous server down to stop the ring
     thread."""
+    from paddle_operator_tpu.infer.resilience import ServerState
+
     gen = (ContinuousGenerator(params, cfg, mesh=mesh, **ring_kw)
            if continuous else Generator(params, cfg, mesh=mesh))
-    handler = type("Handler", (_Handler,), {"generator": gen})
+    state = ServerState()
+    handler = type("Handler", (_Handler,),
+                   {"generator": gen, "state": state})
     srv = ThreadingHTTPServer((host, port), handler)
     srv.generator = gen
+    # readiness/drain flags shared with the handler threads; a
+    # resilience.ServingDrain flips state.draining on SIGTERM
+    srv.state = state
     return srv
 
 
@@ -361,10 +443,16 @@ def main() -> int:
     ring_kw = {}
     spec_k = int(os.environ.get("SERVE_SPEC_K", "0"))
     if continuous:
+        from paddle_operator_tpu.infer.resilience import RingResilience
+
         ring_kw = {"slots": int(os.environ.get("SERVE_SLOTS", "8")),
                    "chunk_tokens": int(os.environ.get("SERVE_CHUNK", "8")),
                    "max_queue": int(os.environ.get("SERVE_MAX_QUEUE",
-                                                   "0"))}
+                                                   "0")),
+                   # self-healing on by default for deployed rings:
+                   # dispatch faults shed the resident requests (503)
+                   # and rebuild instead of wedging every lane forever
+                   "resilience": RingResilience.from_env()}
         if os.environ.get("SERVE_MAX_LEN"):
             ring_kw["max_len"] = int(os.environ["SERVE_MAX_LEN"])
         # SERVE_PAGED=1: block-pool KV cache + radix prefix reuse
@@ -435,6 +523,26 @@ def main() -> int:
           flush=True)
     srv = make_server("0.0.0.0", env.port, params, cfg,
                       continuous=continuous, mesh=mesh, **ring_kw)
+    # SIGTERM drain (docs/fault-tolerance.md, serving pods): the SAME
+    # PreemptionWatcher contract the trainer uses — stop admissions
+    # (503 + Retry-After), finish in-flight lanes within the drain
+    # budget, flush partials, exit EXIT_PREEMPTED so the reconciler
+    # counts the restart as preempted, not failed.  A second SIGTERM
+    # exits immediately (partials flushed best-effort).
+    from paddle_operator_tpu.ft.preemption import PreemptionWatcher
+    from paddle_operator_tpu.infer.chaos import maybe_install_from_env
+    from paddle_operator_tpu.infer.resilience import ServingDrain
+
+    batcher = srv.generator.batcher if continuous else None
+    if batcher is not None:
+        # TPUJOB_CHAOS: deterministic fault injection on the live ring
+        # (smoke-testing a deployment's resilience end-to-end)
+        maybe_install_from_env(batcher)
+    watcher = PreemptionWatcher.install()
+    drain = ServingDrain(
+        srv, srv.state, batcher=batcher,
+        budget_s=float(os.environ.get("SERVE_DRAIN_BUDGET_S", "30")))
+    drain.install(watcher)
     srv.serve_forever()
     return 0
 

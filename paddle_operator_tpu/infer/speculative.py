@@ -44,6 +44,14 @@ Capacity: a round starting at position p writes verify rows p..p+K, so
 callers must leave ``spec_k - 1`` positions of headroom past
 prompt+max_new_tokens (speculative_generate grows its allocation;
 ContinuousBatcher.submit enforces it against max_len).
+
+Fault tolerance (infer/resilience.py): the spec round is just another
+resident dispatch to the batcher's host loop, so request deadlines,
+the dispatch watchdog, and ring self-healing all apply unchanged — a
+heal rebuilds BOTH caches (target + draft) and re-admits queued work.
+The one exception is ``nan_check``: the per-lane isfinite fold is a
+chunk-step output the spec round does not produce, so the batcher
+rejects the combination up front rather than silently not checking.
 """
 
 from __future__ import annotations

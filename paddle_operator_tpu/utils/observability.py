@@ -98,6 +98,17 @@ def serving_gauges(status_serving: dict, job: str) -> dict:
             float(status_serving.get("prefixHitRate", 0.0)),
         f"tpujob_serve_kv_blocks_free{lbl}":
             float(status_serving.get("kvBlocksFree", 0.0)),
+        # serving fault tolerance (infer/resilience.py): deadline
+        # partials served, self-healing ring rebuilds, NaN-quarantined
+        # lanes, and the drain flag (1 while the pod sheds admissions)
+        f"tpujob_serve_deadline_exceeded{lbl}":
+            float(status_serving.get("deadlineExceeded", 0.0)),
+        f"tpujob_serve_watchdog_restarts{lbl}":
+            float(status_serving.get("watchdogRestarts", 0.0)),
+        f"tpujob_serve_quarantined_lanes{lbl}":
+            float(status_serving.get("quarantinedLanes", 0.0)),
+        f"tpujob_serve_draining{lbl}":
+            1.0 if status_serving.get("draining") else 0.0,
     }
 
 

@@ -167,6 +167,12 @@ def launch(mode: str, *args: str, timeout: float = 900) -> dict:
         env["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
     env["JAX_PLATFORMS"] = "cpu"
+    # NOTE: do NOT point the worker at the suite's persistent compile
+    # cache (JAX_COMPILATION_CACHE_DIR): enabling it here makes this
+    # jax build's subset-mesh compile path heap-corrupt INSIDE the
+    # worker (malloc_consolidate abort in the drain rig) — the exact
+    # failure mode the subprocess isolation exists to dodge.  The
+    # ~30s of from-scratch recompilation per launch is the price.
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
         [sys.executable, "-m", "tests.ft_worker", mode, *args],

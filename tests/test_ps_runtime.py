@@ -369,6 +369,11 @@ class TestDurability:
         client.push("t", np.arange(4), np.ones((4, 2), np.float32))
         client.snapshot()
         srv.shutdown()
+        # close the LISTENING socket too: shutdown() only stops the
+        # accept loop, leaving the kernel backlog accepting connects —
+        # the pull below then hangs its full 30s HTTP timeout instead
+        # of getting the connection-refused a torn-down pod produces
+        srv.server_close()
         # replacement pod: same shard, different port (new IP analogue)
         srv2 = make_server("127.0.0.1", 0, 0, 1,
                            checkpoint_path=str(tmp_path))

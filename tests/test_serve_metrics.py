@@ -142,7 +142,10 @@ class TestBatcherServingStatus:
             b.close()
         assert set(st) == {"tokensPerSec", "acceptRate", "queueDepth",
                            "tokensTotal", "activeLanes", "lanePos",
-                           "prefixHitRate", "kvBlocksFree", "kvBlocksHwm"}
+                           "prefixHitRate", "kvBlocksFree", "kvBlocksHwm",
+                           # fault-tolerance block (infer/resilience.py)
+                           "draining", "healthy", "deadlineExceeded",
+                           "watchdogRestarts", "quarantinedLanes"}
         assert st["tokensTotal"] == 4
         assert st["tokensPerSec"] > 0
         assert st["acceptRate"] == 0.0         # non-speculative ring
