@@ -286,12 +286,15 @@ def _status_schema() -> Dict[str, Any]:
                 "type": "object",
                 "x-kubernetes-preserve-unknown-fields": True,
             },
-            # serving telemetry block (infer/batcher.py serving_status)
-            # — exported as tpujob_serve_* manager gauges.  Includes the
-            # fault-tolerance keys (infer/resilience.py): draining,
-            # deadlineExceeded, watchdogRestarts, quarantinedLanes —
-            # schemaless on purpose (preserve-unknown-fields) so the
-            # workload can grow telemetry without a CRD rev.
+            # serving telemetry block (infer/scheduler.py
+            # serving_status) — exported as tpujob_serve_* manager
+            # gauges.  Includes the fault-tolerance keys
+            # (infer/resilience.py): draining, deadlineExceeded,
+            # watchdogRestarts, quarantinedLanes — and the prefill-path
+            # keys (ISSUE 6): prefillMode, prefillQueueDepth,
+            # chunkedPrefillTokenShare — schemaless on purpose
+            # (preserve-unknown-fields) so the workload can grow
+            # telemetry without a CRD rev.
             "serving": {
                 "type": "object",
                 "x-kubernetes-preserve-unknown-fields": True,

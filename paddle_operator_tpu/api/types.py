@@ -406,12 +406,14 @@ class TPUJobStatus:
     # badput breakdown.  The manager exports it as tpujob_goodput_*
     # gauges on /metrics.
     goodput: Dict[str, Any] = field(default_factory=dict)
-    # Workload-published serving telemetry (infer/batcher.py
+    # Workload-published serving telemetry (infer/scheduler.py
     # ContinuousBatcher.serving_status): served tokens/sec, speculative
-    # acceptance rate, request-queue depth, plus the fault-tolerance
-    # block (infer/resilience.py) — draining, deadlineExceeded,
-    # watchdogRestarts, quarantinedLanes.  The manager exports it as
-    # tpujob_serve_* gauges on /metrics.
+    # acceptance rate, request-queue depth, the prefill-path block
+    # (ISSUE 6 scheduler/executor split) — prefillMode (inline|chunked|
+    # disagg), prefillQueueDepth, chunkedPrefillTokenShare — plus the
+    # fault-tolerance block (infer/resilience.py) — draining,
+    # deadlineExceeded, watchdogRestarts, quarantinedLanes.  The
+    # manager exports it as tpujob_serve_* gauges on /metrics.
     serving: Dict[str, Any] = field(default_factory=dict)
     # k8s-style status conditions; the reconciler maintains a "Goodput"
     # condition from the published block.

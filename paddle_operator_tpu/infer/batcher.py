@@ -1,1590 +1,56 @@
-"""Continuous-batching decode ring (VERDICT r3 item 5).
+"""Continuous-batching decode ring — compatibility facade.
 
-The reference generation server (infer/serve.py Generator) jits whole
-batches and serves them synchronously, so staggered requests serialize
-behind each other.  This module is the serving scheduler that fixes
-that, TPU-style:
+ISSUE 6 split this module's ~1.6k lines into:
 
-- **One resident compiled step.** A fixed ring of ``slots`` decode
-  lanes shares a single KV cache ``[L, slots, H_kv, max_len, D]`` and
-  ONE jitted multi-token decode step (a ``lax.scan`` over
-  ``chunk_tokens`` ticks).  No per-request compiles in the decode loop,
-  ever — shapes are static regardless of arrival pattern.
-- **Per-slot positions.** Unlike ``infer/decode.py`` (one scalar fill
-  position for the whole batch), every lane carries its own ``pos`` so
-  sequences of different lengths decode side by side.  The per-lane
-  cache write is a vmapped ``dynamic_update_slice``; the causal mask
-  compares cache columns against each lane's own position.  Math is
-  pinned to ``decode.generate`` by tests/test_batcher.py.
-- **Admission at chunk boundaries.** A request joins by prefilling its
-  prompt into a free lane (prompt-length-bucketed compiles: pads fill
-  cache rows PAST the real tokens, which the causal mask hides and
-  later decode writes overwrite — exact semantics, bounded compile
-  set), then rides the shared chunk step until eos / budget, then the
-  lane frees for the next request.  Chunking amortizes the host↔device
-  round-trip over ``chunk_tokens`` tokens (the same RTT honesty issue
-  bench.py measures around).
-- Sampling: greedy or per-lane temperature (a [slots] array feeding one
-  compiled program); optional top-k/top-p are server-global statics.
+- ``infer/scheduler.py`` — the host scheduler (:class:`ContinuousBatcher`:
+  admission, queues, deadlines, request lifecycle, resilience hooks,
+  and the ``prefill_mode=inline|chunked|disagg`` admission paths);
+- ``infer/executor.py`` — the device executor (compiled chunk/insert
+  programs, ring/paged cache state, the chunked-prefill slice programs,
+  and the disaggregated :class:`~paddle_operator_tpu.infer.executor.
+  PrefillExecutor`).
 
-Reference scope note: the reference operator ships no serving path at
-all (model execution lives in user containers); this is framework
-surface beyond parity, built because SURVEY §5 makes long-context
-serving a first-class obligation.
+Every public (and test-pinned private) name keeps importing from here,
+so existing callers — serve.py, bench.py, the dryrun gates, the test
+suite, the chaos injector — see one unchanged surface.  New code should
+import from the split modules directly.
 """
 
-from __future__ import annotations
-
-import queue
-import threading
-import time
-from typing import Any, Dict, List, Optional, Tuple
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from paddle_operator_tpu.infer import decode as D
-from paddle_operator_tpu.infer.resilience import (
-    DispatchWatchdog,
-    LaneQuarantined,
-    RestartBudget,
-    RetriableError,
-    RingResilience,
-    ShuttingDown,
+from paddle_operator_tpu.infer.executor import (  # noqa: F401
+    PrefillExecutor,
+    RingExecutor,
+    _default_buckets,
+    _layer_step,
+    _qkv_ring,
+    _ring_forward,
+    _sample_tokens,
+    _splice_lane,
+    _write_lane,
+    _write_lane_stacked,
+    init_ring_cache,
+    make_attach_lane,
+    make_chunk_step,
+    make_chunked_final_insert,
+    make_disagg_prefill,
+    make_prefill_chunk,
+    make_prefill_insert,
+    make_spec_attach,
+    make_spec_chunked_final_insert,
+    make_spec_prefill_insert,
 )
-from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
-
-
-# ---------------------------------------------------------------------------
-# Device side: per-lane-position forward step
-# ---------------------------------------------------------------------------
-
-
-def init_ring_cache(cfg: LlamaConfig, slots: int,
-                    max_len: int, mesh=None) -> Dict[str, jax.Array]:
-    """KV ring: like decode.init_cache (same head-major layout,
-    block-aligned allocation, same kv-head tp sharding under a serving
-    mesh) but with a per-lane fill position vector instead of one
-    scalar."""
-    if max_len > cfg.max_seq_len:
-        raise ValueError(f"max_len {max_len} exceeds the RoPE table "
-                         f"(cfg.max_seq_len={cfg.max_seq_len})")
-    alloc = D.cache_alloc_len(max_len)
-    shape = (cfg.n_layers, slots, cfg.n_kv_heads, alloc, cfg.head_dim)
-    return {
-        "k": D.alloc_kv_buffer(cfg, shape, mesh),
-        "v": D.alloc_kv_buffer(cfg, shape, mesh),
-        "pos": jnp.zeros((slots,), jnp.int32),
-    }
-
-
-def _write_lane(cache_l: jax.Array, kv: jax.Array,
-                pos: jax.Array) -> jax.Array:
-    """[B, H, S, D] cache layer <- [B, H, 1, D] new row at per-lane pos."""
-    return jax.vmap(
-        lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (0, p, 0))
-    )(cache_l, kv, pos)
-
-
-def _qkv_ring(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
-              cos: jax.Array, sin: jax.Array, pos: jax.Array):
-    """Pre-attention half for ONE new token per lane at per-lane
-    positions ``pos`` [B]: RMSNorm -> projections -> RoPE at each
-    lane's own position (the table slice is a plain gather cos[pos])."""
-    b = x.shape[0]
-    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, 1, hq, d)
-    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
-    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
-    cos_b = cos[pos][:, None, None, :]          # [B, 1, 1, d/2]
-    sin_b = sin[pos][:, None, None, :]
-
-    def rot(t):
-        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
-        return jnp.concatenate(
-            [t1 * cos_b - t2 * sin_b, t2 * cos_b + t1 * sin_b],
-            axis=-1).astype(t.dtype)
-
-    return rot(q), rot(k), v
-
-
-def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
-                cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
-                v_cache: jax.Array, pos: jax.Array
-                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decoder layer for ONE new token per lane ([B, 1, D] at lane
-    positions ``pos`` [B]) with the XLA einsum attention.  Same math as
-    decode._layer (which this is pinned against) with the scalar
-    position generalized to a vector.  The pallas path keeps the caches
-    stacked and does not go through here (see _ring_forward)."""
-    b = x.shape[0]
-    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
-    k_cache = _write_lane(k_cache, k.transpose(0, 2, 1, 3), pos)
-    v_cache = _write_lane(v_cache, v.transpose(0, 2, 1, 3), pos)
-
-    n_rep = hq // hkv
-    max_len = k_cache.shape[2]
-    qg = q.reshape(b, 1, hkv, n_rep, d)
-    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
-                        preferred_element_type=jnp.float32) / jnp.sqrt(
-        jnp.float32(d))
-    # lane b may attend cache cols [0, pos_b] (its own new row incl.)
-    mask = jnp.arange(max_len)[None, :] <= pos[:, None]      # [B, S]
-    scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
-                     v_cache, preferred_element_type=jnp.float32)
-    out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
-    x = x + D._mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
-
-    n = D._rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    if cfg.n_experts > 0:
-        ffn = D._moe_ffn(cfg, lp["moe"], n)
-    else:
-        gate = D._mm(n, lp["mlp"]["w1"]["kernel"], cfg.dtype)
-        up = D._mm(n, lp["mlp"]["w3"]["kernel"], cfg.dtype)
-        ffn = D._mm(jax.nn.silu(gate) * up, lp["mlp"]["w2"]["kernel"],
-                    cfg.dtype)
-    return x + ffn, k_cache, v_cache
-
-
-def _write_lane_stacked(stack: jax.Array, kv: jax.Array, li: jax.Array,
-                        pos: jax.Array) -> jax.Array:
-    """[L, B, H, S, D] stacked cache <- [B, H, 1, D] new rows at layer
-    ``li`` and per-lane positions ``pos``.
-
-    One dynamic_update_slice PER LANE (a static unroll over the slot
-    count), not a vmapped/batched update: vmapping over ragged lane
-    positions lowers to a scatter, and a scatter into the scan-carried
-    stack makes XLA materialize a copy of the whole ring cache per
-    layer per tick — measured 30x slower than raw decode.  Chained
-    single-row dus ops update the carry in place."""
-    b = kv.shape[0]
-    for lane in range(b):
-        stack = jax.lax.dynamic_update_slice(
-            stack, kv[lane][None, None], (li, lane, 0, pos[lane], 0))
-    return stack
-
-
-def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
-                  tok: jax.Array, cache: Dict[str, jax.Array],
-                  mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """tok [B] at per-lane cache['pos'] -> (logits [B, V], advanced
-    cache).  Counterpart of decode._forward for vector positions; like
-    it, the pallas path carries the caches STACKED through the layer
-    scan so the kernel reads them copy-free (decode.py _forward has the
-    why), and under a serving mesh the kernel + output projection run
-    TP-sharded in one manual region per layer (the ragged per-lane
-    ``pos`` vector is exactly the ``lengths`` operand the kernel's
-    index map already takes — replicated across shards)."""
-    pos = cache["pos"]
-    x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tok[:, None]]
-    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
-                                cfg.rope_theta)
-
-    attn_impl = cfg.resolved_decode_attn()
-    use_sharded = D._use_sharded_kernel(cfg, mesh, attn_impl)
-    if D.mesh_tp(mesh) > 1 and not use_sharded:
-        attn_impl = "xla"   # whole GQA groups don't split: GSPMD einsum
-    if use_sharded:
-        from paddle_operator_tpu.ops.decode_attention import (
-            sharded_decode_attention,
-        )
-
-        def body(carry, layer_in):
-            x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
-            kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
-            vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
-            proj = sharded_decode_attention(
-                mesh, q[:, 0], kc, vc, pos + 1,
-                lp["attn"]["wo"]["kernel"], layer=li,
-                interpret=(attn_impl == "pallas-interpret"),
-                compute_dtype=cfg.dtype)
-            x = x + proj[:, None].astype(cfg.dtype)
-            return (D._ffn_residual(cfg, lp, x), kc, vc), ()
-
-        (x, k_new, v_new), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["layers"], jnp.arange(cfg.n_layers)))
-    elif attn_impl != "xla":
-        from paddle_operator_tpu.ops.decode_attention import decode_attention
-
-        b = x.shape[0]
-        hq, d = cfg.n_heads, cfg.head_dim
-
-        def body(carry, layer_in):
-            x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
-            kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
-            vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
-            out = decode_attention(
-                q[:, 0], kc, vc, pos + 1, layer=li,
-                interpret=(attn_impl == "pallas-interpret"))
-            out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
-            return (D._finish_layer(cfg, lp, x, out), kc, vc), ()
-
-        (x, k_new, v_new), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["layers"], jnp.arange(cfg.n_layers)))
-    else:
-        def body(x, layer_in):
-            lp, k_c, v_c = layer_in
-            y, k_c, v_c = _layer_step(cfg, lp, x, cos, sin, k_c, v_c, pos)
-            return y, (k_c, v_c)
-
-        x, (k_new, v_new) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
-    x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    logits = D._mm(x, params["lm_head"]["kernel"],
-                   cfg.dtype).astype(jnp.float32)
-    return logits[:, 0], {"k": k_new, "v": v_new, "pos": pos + 1}
-
-
-def _sample_tokens(logits, temp, keys, pos, top_k, top_p):
-    """THE per-lane sampling rule — shared by the chunk step and the
-    admission insert so token 1 and tokens 2..N can never be drawn
-    under different rules.  logits [B, V], temp [B], keys [B, 2],
-    pos [B] -> [B] int32: greedy at temp 0, else per-lane
-    fold_in(position) (deterministic given (seed, pos), independent
-    across lanes and steps) feeding temperature + top-k/top-p
-    filtered categorical sampling."""
-    greedy = logits.argmax(-1).astype(jnp.int32)
-    filt = D._filter_logits(
-        logits / jnp.maximum(temp, 1e-6)[:, None], top_k, top_p)
-    sub = jax.vmap(jax.random.fold_in)(keys, pos)
-    drawn = jax.vmap(
-        lambda k, l: jax.random.categorical(k, l))(sub, filt)
-    return jnp.where(temp > 0, drawn.astype(jnp.int32), greedy)
-
-
-def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
-                    top_k: Optional[int] = None,
-                    top_p: Optional[float] = None, mesh=None,
-                    check_finite: bool = False):
-    """The ONE resident compiled decode program.
-
-    ``step(params, cache, tok [B], temp [B], keys [B,2], active [B])
-    -> (cache', tok', toks [chunk, B])``
-
-    Runs ``chunk_tokens`` ticks for every lane.  Inactive lanes compute
-    (their FLOPs are the price of static shapes — standard slot-server
-    trade) but neither advance their position nor write meaningful
-    state; their emitted tokens are ignored host-side.  The cache is
-    donated: the ring buffer must never be copied per chunk.  Under a
-    serving mesh the whole chunk remains ONE sharded dispatch — the
-    shard_map kernel regions and GSPMD einsums compile into the same
-    resident program, no eager per-device ops anywhere.
-
-    ``check_finite=True`` (infer/resilience.py nan_check): the step
-    additionally returns ``ok [B]`` — an isfinite fold of every tick's
-    logits per lane, so the host can quarantine a NaN-producing lane
-    (fail ONE request, never the ring) without shipping the logits
-    home.  Token outputs are unchanged; the fold rides the same scan.
-    """
-
-    def step(params, cache, tok, temp, keys, active):
-        def tick(carry, _):
-            # the isfinite fold rides the carry ONLY when requested —
-            # the default resident program is unchanged
-            if check_finite:
-                cache, tok, ok = carry
-            else:
-                cache, tok = carry
-            logits, new_cache = _ring_forward(cfg, params, tok, cache,
-                                              mesh=mesh)
-            nxt = _sample_tokens(logits, temp, keys, cache["pos"],
-                                 top_k, top_p)
-            # retired/free lanes: position ZEROED (a stale fill
-            # position must never outlive its request — the
-            # serving_status staleness fix); their (ignored) writes
-            # land at row 0, which the next admission's splice
-            # overwrites along with the rest of the lane
-            new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
-            nxt = jnp.where(active, nxt, tok)
-            if check_finite:
-                ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
-                return (new_cache, nxt, ok), nxt
-            return (new_cache, nxt), nxt
-
-        if check_finite:
-            (cache, tok, ok), toks = jax.lax.scan(
-                tick, (cache, tok, jnp.ones(tok.shape, bool)), None,
-                length=chunk_tokens)
-            return cache, tok, toks, ok
-        (cache, tok), toks = jax.lax.scan(
-            tick, (cache, tok), None, length=chunk_tokens)
-        return cache, tok, toks
-
-    return jax.jit(step, donate_argnums=(1,))
-
-
-def _splice_lane(ring: Dict[str, jax.Array], lane: Dict[str, jax.Array],
-                 slot, prompt_len) -> Dict[str, jax.Array]:
-    """Zero ring lane ``slot`` and splice a freshly prefilled
-    batch-of-one lane cache into it, setting the lane's fill position
-    to ``prompt_len`` — the device half of admission, shared by the
-    plain and speculative inserts so their splice semantics cannot
-    drift."""
-    k = jnp.zeros_like(ring["k"][:, 0])
-    k = jax.lax.dynamic_update_slice(k, lane["k"][:, 0], (0, 0, 0, 0))
-    v = jnp.zeros_like(ring["v"][:, 0])
-    v = jax.lax.dynamic_update_slice(v, lane["v"][:, 0], (0, 0, 0, 0))
-    new_k = jax.lax.dynamic_update_slice(
-        ring["k"], k[:, None], (0, slot, 0, 0, 0))
-    new_v = jax.lax.dynamic_update_slice(
-        ring["v"], v[:, None], (0, slot, 0, 0, 0))
-    return {"k": new_k, "v": new_v,
-            "pos": ring["pos"].at[slot].set(prompt_len)}
-
-
-def make_prefill_insert(cfg: LlamaConfig, bucket: int,
-                        top_k: Optional[int] = None,
-                        top_p: Optional[float] = None, mesh=None):
-    """Per-prompt-bucket compiled admission: prefill a [1, bucket]
-    (right-padded) prompt, splice its KV into ring lane ``slot``, sample
-    the first token, and update EVERY piece of lane state — tok, temp,
-    keys — in the same compiled program.
-
-    One dispatch on purpose: on relayed chips, EAGER ops (``.at[].set``,
-    ``argmax``) block until all in-flight device work drains (measured
-    ~500 ms behind a decoding chunk), so an admission built from eager
-    lane updates stalled the whole ring for ~half a second per request.
-    Everything device-side about admission lives inside this jit; the
-    host's only jobs are bookkeeping lists.
-
-    Exactness with padding: pad rows fill cache positions PAST the real
-    prompt; the causal mask keeps real rows from attending them, the
-    first token samples from ``prompt_len - 1`` (the last REAL
-    position), the lane position is set to ``prompt_len`` so decode
-    overwrites the pad rows before they ever become attendable.
-
-    ``insert(params, cache, tok, temp, keys, prompt [1,bucket],
-    prompt_len, slot, temp_val, seed)
-    -> (cache', tok', temp', keys', first_token)``
-    """
-
-    def insert(params, cache, tok, temp, keys, prompt, prompt_len, slot,
-               temp_val, seed):
-        lane = D.init_cache(cfg, 1, bucket)
-        logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
-        logits = logits[0, prompt_len - 1]                  # last real row
-        new_cache = _splice_lane(cache, lane, slot, prompt_len)
-        # first token through the SHARED sampling rule (_sample_tokens),
-        # batch-of-one shaped
-        key = jax.random.PRNGKey(seed)
-        first = _sample_tokens(
-            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
-            key[None], jnp.reshape(prompt_len - 1, (1,)),
-            top_k, top_p)[0]
-        return (new_cache,
-                tok.at[slot].set(first),
-                temp.at[slot].set(temp_val),
-                keys.at[slot].set(key),
-                first)
-
-    return jax.jit(insert, donate_argnums=(1, 2, 3, 4))
-
-
-def make_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
-                             bucket: int, top_k: Optional[int] = None,
-                             top_p: Optional[float] = None, mesh=None):
-    """Admission for the SPECULATIVE ring: one compiled dispatch that
-    prefills the prompt into BOTH the target and the draft lane (the
-    draft's logits are discarded — it only needs the KV context to
-    propose from) and samples the first token from the target, with the
-    same exactness-with-padding story as :func:`make_prefill_insert`.
-
-    ``insert(params, dparams, cache, dcache, tok, temp, keys,
-    prompt [1,bucket], prompt_len, slot, temp_val, seed)
-    -> (cache', dcache', tok', temp', keys', first_token)``
-    """
-
-    def insert(params, dparams, cache, dcache, tok, temp, keys, prompt,
-               prompt_len, slot, temp_val, seed):
-        lane = D.init_cache(cfg, 1, bucket)
-        logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
-        logits = logits[0, prompt_len - 1]
-        new_cache = _splice_lane(cache, lane, slot, prompt_len)
-        dlane = D.init_cache(dcfg, 1, bucket)
-        _, dlane = D._forward(dcfg, dparams, prompt, dlane,
-                              last_only=True, mesh=mesh)
-        new_dcache = _splice_lane(dcache, dlane, slot, prompt_len)
-        key = jax.random.PRNGKey(seed)
-        first = _sample_tokens(
-            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
-            key[None], jnp.reshape(prompt_len - 1, (1,)),
-            top_k, top_p)[0]
-        return (new_cache, new_dcache,
-                tok.at[slot].set(first),
-                temp.at[slot].set(temp_val),
-                keys.at[slot].set(key),
-                first)
-
-    return jax.jit(insert, donate_argnums=(2, 3, 4, 5, 6))
-
-
-# ---------------------------------------------------------------------------
-# Host side: the scheduler
-# ---------------------------------------------------------------------------
-
-
-def _fold_seed(seed: int) -> int:
-    """Fold an out-of-int32-range seed to [0, 2**31) via the splitmix64
-    finalizer (a bijection on 64-bit ints before the final fold) —
-    distinct wide seeds stay distinct with overwhelming probability,
-    unlike the ``& 0x7FFFFFFF`` mask that mapped s and s + 2**31 to the
-    same sampling stream."""
-    x = seed & 0xFFFFFFFFFFFFFFFF
-    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
-    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
-    x ^= x >> 31
-    return x & 0x7FFFFFFF
-
-
-class QueueFull(RuntimeError):
-    """submit() backpressure signal: the bounded request queue stayed
-    full past the put timeout.  A RuntimeError subclass so serve.py's
-    generic 503 mapping already handles it (retry/fail-over, not a
-    client error) while callers that care can catch it specifically."""
-
-
-class _Request:
-    __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
-                 "done", "out", "error", "_stream", "_cancel",
-                 "dev_prompt", "bucket", "accepted", "drafted",
-                 "deadline", "deadline_exceeded")
-
-    def __init__(self, prompt, max_new, temperature, seed, eos,
-                 wants_stream=False, deadline=None):
-        self.prompt = prompt
-        self.max_new = max_new
-        self.temperature = temperature
-        self.seed = seed
-        self.eos = eos
-        self.done = threading.Event()
-        self.out: Optional[List[int]] = None
-        self.error: Optional[Exception] = None
-        self._cancel = False
-        # absolute time.monotonic() deadline (or None): the ring retires
-        # the lane when it passes — the request RESOLVES with the tokens
-        # produced so far and this flag set (the 504-style partial), so
-        # a slow client can never pin a lane / its paged blocks
-        self.deadline: Optional[float] = deadline
-        self.deadline_exceeded = False
-        # speculative-decoding telemetry (spec_k > 0 rings): drafts
-        # offered / accepted for THIS request — serve.py surfaces the
-        # rate per response
-        self.accepted = 0
-        self.drafted = 0
-        # padded prompt, transferred to device on the SUBMIT thread
-        # (batcher.submit): on relayed chips a host->device copy costs a
-        # full round-trip, and paying it on the decode-ring thread
-        # stalls every lane; caller threads pay it concurrently instead
-        self.dev_prompt: Optional[jax.Array] = None
-        self.bucket: int = 0
-        # token streaming is opt-in (submit(stream=True)): the dominant
-        # result()-only path must not pay per-token queue puts inside
-        # the decode-ring thread that gates every lane's throughput
-        self._stream: Optional["queue.Queue"] = (
-            queue.Queue() if wants_stream else None)
-
-    def result(self, timeout: Optional[float] = None) -> List[int]:
-        if not self.done.wait(timeout):
-            raise TimeoutError("generation did not finish in time")
-        if self.error is not None:
-            raise self.error
-        return self.out
-
-    @property
-    def accept_rate(self) -> Optional[float]:
-        """Speculative acceptance rate for this request (accepted
-        drafts / offered drafts), or None when the ring is not
-        speculative (or no round has consumed yet)."""
-        if not self.drafted:
-            return None
-        return round(self.accepted / self.drafted, 4)
-
-    def cancel(self) -> None:
-        """Stop decoding this request: the ring evicts its lane at the
-        next chunk boundary (or drops it from the queue if not yet
-        admitted) and ``result()`` returns the tokens produced so far.
-        A disconnect-abandoned long stream must not keep occupying a
-        decode lane to its full token budget."""
-        self._cancel = True
-
-    def stream(self, timeout: Optional[float] = None):
-        """Yield generated tokens as the ring emits them (one int at a
-        time, arriving in chunk-sized bursts).  Raises the request's
-        error at the point of failure; `timeout` bounds the wait for
-        EACH burst, not the whole generation."""
-        if self._stream is None:
-            raise RuntimeError("request was not submitted with "
-                               "stream=True")
-        while True:
-            try:
-                item = self._stream.get(timeout=timeout)
-            except queue.Empty:
-                raise TimeoutError("no tokens within timeout") from None
-            if item is None:
-                if self.error is not None:
-                    raise self.error
-                return
-            yield item
-
-
-class ContinuousBatcher:
-    """Slot scheduler over the resident chunk step.
-
-    ``submit()`` is thread-safe and returns a handle whose ``result()``
-    blocks until the sequence finishes; the decode loop runs on a
-    background thread, admitting queued requests into free lanes at
-    chunk boundaries (bucketed prefill) and evicting lanes on eos /
-    budget.  ``stats`` counts admissions, evictions, decoded chunks and
-    the high-water mark of concurrently active lanes — the numbers the
-    slot-reuse tests pin.
-
-    ``paged=True`` (infer/paged.py) swaps the per-lane contiguous KV
-    region for a global block pool + per-lane block tables with a radix
-    prefix cache: blocks allocate on demand as a lane's ``pos`` crosses
-    block boundaries, free when the lane retires, and admissions that
-    hit a cached prefix map those blocks read-only (CoW before the
-    first divergent write) and prefill only the suffix.  Greedy token
-    streams are BIT-IDENTICAL to the contiguous ring — ``paged=False``
-    is both the fallback and the parity oracle.  ``block_size`` sets
-    pool-block granularity (keep it at ops/decode_attention.py
-    DEFAULT_BLOCK_K on TPU so the paged kernel's key block IS the pool
-    block), ``num_blocks`` the pool size (default: contiguous-HBM
-    parity, slots * blocks-per-lane), ``prefix_cache=False`` disables
-    radix reuse (it is also off in speculative mode, where admission
-    must prefill the draft lane anyway).
-    """
-
-    # a prefix hit with a LONGER divergent suffix admits through the
-    # cold scatter prefill instead: the suffix insert's per-row pool
-    # writes unroll O(rows) (paged._write_rows_paged), and past this
-    # many rows the block-granular cold path compiles and runs faster
-    # than what the cached prefix saves
-    SUFFIX_PREFILL_MAX_ROWS = 256
-
-    def __init__(self, params: Any, cfg: LlamaConfig, *, slots: int = 8,
-                 max_len: Optional[int] = None, chunk_tokens: int = 8,
-                 prefill_buckets: Tuple[int, ...] = (),
-                 top_k: Optional[int] = None,
-                 top_p: Optional[float] = None,
-                 pipeline_depth: int = 2, mesh=None,
-                 draft_params: Any = None,
-                 draft_cfg: Optional[LlamaConfig] = None,
-                 spec_k: int = 0,
-                 max_queue: int = 0,
-                 queue_timeout: float = 5.0,
-                 paged: bool = False,
-                 block_size: int = 256,
-                 num_blocks: Optional[int] = None,
-                 prefix_cache: bool = True,
-                 resilience: Optional[RingResilience] = None) -> None:
-        # ``mesh`` (parallel/mesh.py make_serving_mesh): serve
-        # tensor-parallel — params are laid out over tp once here, the
-        # ring cache shards over the kv-head axis, and the resident
-        # chunk/insert programs compile sharded (shard_map pallas
-        # kernel + GSPMD einsums).  Token streams are identical to the
-        # single-device ring (tests/test_batcher.py pins it).
-        self.mesh = mesh
-        if mesh is not None and D.mesh_tp(mesh) > 1:
-            params = D.shard_params_for_serving(params, cfg, mesh)
-        self.params = params
-        self.cfg = cfg
-        self.slots = slots
-        self.max_len = max_len or cfg.max_seq_len
-        self.chunk = chunk_tokens
-        # fault tolerance (infer/resilience.py): with a RingResilience a
-        # ring-level dispatch fault fails the RESIDENT requests with a
-        # retriable 503 and rebuilds the ring from scratch (fresh
-        # cache/pool; queued work re-admitted) behind exponential
-        # backoff, until the restart budget flips ``healthy`` — without
-        # one the batcher keeps its legacy die-on-first-error behavior.
-        self.resilience = resilience
-        self._budget = (RestartBudget(resilience)
-                        if resilience is not None else None)
-        self._check_finite = bool(resilience and resilience.nan_check)
-        if self._check_finite and spec_k:
-            raise ValueError("nan_check is not supported on speculative "
-                             "rings (the spec round has no per-lane "
-                             "finite fold); disable one of them")
-        self.healthy = True
-        self._draining = False
-        self._rebuilding = False
-        # ring-level fault observed (by the loop thread or the watchdog
-        # monitor) and not yet healed; the loop rebuilds at the next top
-        self._fault: Optional[Exception] = None
-        self._watchdog: Optional[DispatchWatchdog] = None
-        if resilience is not None and resilience.watchdog:
-            self._watchdog = DispatchWatchdog(
-                resilience, self._on_stall, self._on_hard_stall)
-        # max dispatched-but-unconsumed chunks; the oldest is consumed
-        # once `depth` are in flight, so depth 2 = one chunk always
-        # decoding while the host consumes the previous one (depth 1
-        # disables the overlap entirely).  Deeper than 2 delays the
-        # eviction bookkeeping by depth-1 chunks, so freed lanes sit
-        # idle before re-admission — lane turnover costs more than the
-        # extra hidden round-trip saves (measured).
-        self.pipeline_depth = max(1, pipeline_depth)
-        self.buckets = tuple(sorted(prefill_buckets)) or _default_buckets(
-            self.max_len)
-        self._top_k, self._top_p = top_k, top_p
-        # paged mode (infer/paged.py): the per-lane contiguous KV region
-        # becomes a global block pool + per-lane block tables — blocks
-        # allocate on demand as each lane's pos crosses a block boundary
-        # and free when the lane retires, and completed-prefill blocks
-        # feed a radix prefix cache so shared prompts prefill ONCE.  The
-        # contiguous ring stays the paged path's parity oracle
-        # (SERVE_PAGED=0); greedy token streams are bit-identical.
-        self.paged = bool(paged)
-        self.pool: Optional[Any] = None
-        if self.paged:
-            from paddle_operator_tpu.infer import paged as PG
-
-            self._pg = PG
-            self.block_size = int(block_size)
-            # prefix reuse needs one canonical prefill per prefix;
-            # speculative admission prefills target AND draft, so the
-            # cache is disabled there (paging itself still applies)
-            # kept for watchdog rebuilds: a self-heal reconstructs the
-            # pool (and its radix cache) from scratch with these
-            self._num_blocks = num_blocks
-            self._prefix_cache = prefix_cache and not spec_k
-            self.pool = PG.PagedCacheManager(
-                slots, self.max_len, self.block_size, num_blocks,
-                prefix_cache=self._prefix_cache)
-            # prefill buckets scatter whole blocks: round each up to a
-            # block multiple, capped at the lane view
-            self.buckets = tuple(sorted(
-                {min(-(-b // self.block_size) * self.block_size,
-                     self.pool.view_len) for b in self.buckets}))
-            self._copy_block = PG.make_block_copier()
-            self._suffix_inserts: Dict[int, Any] = {}
-        # speculative mode (spec_k > 0): the resident step becomes ONE
-        # draft-propose + chunked-verify round (infer/speculative.py) —
-        # per round every active lane advances by its OWN accept length
-        # (1..spec_k+1 tokens), landing in the per-lane pos vector, so
-        # divergent accepts cost no extra compiles.  A second ring cache
-        # holds the draft's KV, admitted/rewound in lockstep.
-        self.spec_k = int(spec_k)
-        self.draft_cfg = draft_cfg
-        if self.spec_k > 0:
-            from paddle_operator_tpu.infer.speculative import (
-                check_draft_compat,
-                make_spec_round_fn,
-            )
-
-            if draft_params is None or draft_cfg is None:
-                raise ValueError("spec_k > 0 requires draft_params and "
-                                 "draft_cfg (see LlamaConfig.draft())")
-            check_draft_compat(cfg, draft_cfg)
-            if self.max_len > draft_cfg.max_seq_len:
-                raise ValueError(
-                    f"draft max_seq_len ({draft_cfg.max_seq_len}) < ring "
-                    f"max_len ({self.max_len}); derive the draft with "
-                    "cfg.draft() to inherit the target's RoPE table")
-            if mesh is not None and D.mesh_tp(mesh) > 1:
-                draft_params = D.shard_params_for_serving(
-                    draft_params, draft_cfg, mesh)
-            self.draft_params = draft_params
-            self._spec_step = make_spec_round_fn(
-                cfg, draft_cfg, self.spec_k, top_k, top_p, mesh=mesh,
-                paged=self.paged)
-            if self.paged:
-                # target prefill scatters into the pool; the DRAFT lane
-                # stays a contiguous splice (speculative.py docstring)
-                self._inserts = {b: self._pg.make_paged_spec_prefill_insert(
-                    cfg, draft_cfg, b, self.block_size, top_k, top_p,
-                    mesh=mesh) for b in self.buckets}
-            else:
-                self._inserts = {b: make_spec_prefill_insert(
-                    cfg, draft_cfg, b, top_k, top_p, mesh=mesh)
-                    for b in self.buckets}
-            self.dcache = init_ring_cache(draft_cfg, slots, self.max_len,
-                                          mesh=mesh)
-        else:
-            self.draft_params = None
-            self.dcache = None
-            if self.paged:
-                self._step = self._pg.make_paged_chunk_step(
-                    cfg, chunk_tokens, top_k, top_p, mesh=mesh,
-                    check_finite=self._check_finite)
-                self._inserts = {b: self._pg.make_paged_prefill_insert(
-                    cfg, b, self.block_size, top_k, top_p, mesh=mesh)
-                    for b in self.buckets}
-            else:
-                self._step = make_chunk_step(cfg, chunk_tokens, top_k,
-                                             top_p, mesh=mesh,
-                                             check_finite=self._check_finite)
-                self._inserts = {b: make_prefill_insert(cfg, b, top_k,
-                                                        top_p, mesh=mesh)
-                                 for b in self.buckets}
-
-        if self.paged:
-            self.cache = self._pg.init_paged_cache(
-                cfg, slots, self.pool.total, self.block_size, mesh=mesh)
-        else:
-            self.cache = init_ring_cache(cfg, slots, self.max_len,
-                                         mesh=mesh)
-        self.tok = jnp.zeros((slots,), jnp.int32)
-        self.temp = jnp.zeros((slots,), jnp.float32)
-        self.keys = jnp.zeros((slots, 2), jnp.uint32)
-        self.lane: List[Optional[_Request]] = [None] * slots
-        self._lane_out: List[List[int]] = [[] for _ in range(slots)]
-        self._lane_left = [0] * slots
-        # host mirror of each lane's device fill position — set by
-        # admission, advanced at consume, ZEROED on eviction so
-        # serving_status never reports a retired lane's stale pos (and,
-        # paged, so on-demand block mapping tracks the true frontier)
-        self._lane_pos = [0] * slots
-        # per-lane device future of the admission-sampled first token,
-        # materialized at the next chunk consume (async admission)
-        self._lane_first: List[Optional[jax.Array]] = [None] * slots
-
-        # bounded admission queue (max_queue > 0): submit() blocks up to
-        # queue_timeout for a slot, then REJECTS (QueueFull) — saturation
-        # degrades into backpressure instead of unbounded request RAM
-        self.max_queue = int(max_queue)
-        self._queue_timeout = queue_timeout
-        self._pending: "queue.Queue[_Request]" = queue.Queue(
-            maxsize=self.max_queue)
-        self._wake = threading.Event()
-        self._stop = threading.Event()
-        self.stats = {"admitted": 0, "evicted": 0, "chunks": 0,
-                      "max_active": 0, "rejected_queue_full": 0,
-                      "spec_accepted": 0, "spec_drafted": 0,
-                      # prefill accounting: the prefix-cache acceptance
-                      # gate — a full prefix hit admits with ZERO
-                      # prefill forward passes over cached blocks
-                      "prefill_calls": 0, "prefill_tokens": 0,
-                      "cow_copies": 0,
-                      # fault-tolerance accounting (infer/resilience.py):
-                      # deadline partials delivered, self-healing ring
-                      # rebuilds, and NaN-quarantined lanes — surfaced
-                      # through serving_status -> tpujob_serve_* gauges
-                      "deadline_exceeded": 0, "watchdog_restarts": 0,
-                      "quarantined_lanes": 0}
-        # served-token telemetry for serving_status(): cumulative emitted
-        # tokens since construction (the /metrics tokens-per-sec gauge)
-        self._tokens_emitted = 0
-        self._t_start = time.monotonic()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="decode-ring")
-        self._thread.start()
-
-    # -- public ------------------------------------------------------------
-
-    def submit(self, prompt, *, max_new_tokens: int,
-               temperature: float = 0.0, seed: int = 0,
-               eos_token: Optional[int] = None,
-               stream: bool = False,
-               request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> _Request:
-        """Queue one generation request; returns a handle whose
-        ``result()``/``stream()`` deliver the tokens.
-
-        ``deadline_s`` (serve.py: the ``X-Request-Deadline`` header):
-        relative budget in seconds for the WHOLE generation.  When it
-        expires the ring retires the lane at the next chunk boundary —
-        its paged blocks freed, the request resolving with the tokens
-        produced so far and ``handle.deadline_exceeded`` set (the
-        504-style partial) — so one slow/greedy client can never pin a
-        lane indefinitely.  Requests still queued at expiry resolve
-        prompt-only with the same flag.
-
-        ``request_id`` (optional, e.g. serve.py's per-row id) is woven
-        into every validation error so an operator reading a rejection
-        in a multi-request log knows WHICH request overflowed —
-        validation runs (and raises) BEFORE the host-side tokenize copy
-        and device transfer below, so a rejected request costs no
-        bandwidth.
-
-        ``seed``: sampling seed with an effective range of [0, 2**31) —
-        it rides into the compiled insert as an int32 traced argument.
-        In-range seeds are used as-is (streams are stable across
-        versions for the common case); anything outside (negative or
-        >= 2**31 — clients send arbitrary 64-bit ints, serve.py even
-        derives seed+i per row) is folded through a splitmix64 hash
-        rather than truncated, so distinct wide seeds keep distinct
-        streams (masking would collide s with s + 2**31)."""
-        rid = f" [request {request_id}]" if request_id is not None else ""
-        n = len(prompt)
-        if not n:
-            raise ValueError(f"empty prompt{rid}")
-        if max_new_tokens < 1:
-            raise ValueError(f"max_new_tokens must be >= 1{rid}")
-        if deadline_s is not None and deadline_s <= 0:
-            raise ValueError(f"deadline_s must be > 0{rid}")
-        if self._draining:
-            raise ShuttingDown("server draining; retry another replica")
-        if self._stop.is_set() or not self._thread.is_alive():
-            raise ShuttingDown("batcher closed")
-        if n > self.buckets[-1]:
-            raise ValueError(
-                f"prompt length {n} exceeds the largest prefill "
-                f"bucket ({self.buckets[-1]}){rid}")
-        if self.spec_k:
-            # a verify round starting at the last in-budget position
-            # (prompt + max_new - 2) writes rows through pos + spec_k,
-            # so spec_k - 1 positions of headroom must exist past
-            # prompt + max_new (infer/speculative.py has the derivation)
-            if n + max_new_tokens + self.spec_k - 1 > self.max_len:
-                raise ValueError(
-                    f"prompt ({n}) + max_new_tokens "
-                    f"({max_new_tokens}) + speculative headroom "
-                    f"({self.spec_k - 1}) exceeds max_len "
-                    f"({self.max_len}){rid}")
-        else:
-            # the FIRST token is sampled from the prefill logits, so only
-            # max_new-1 tokens ride chunk steps; the worst-case cache
-            # position is prompt + ceil((max_new-1)/chunk)*chunk
-            # (validating with ceil(max_new/chunk) rejected requests up
-            # to chunk-1 tokens INSIDE capacity)
-            budget = -(-(max_new_tokens - 1) // self.chunk) * self.chunk
-            if n + budget > self.max_len:
-                raise ValueError(
-                    f"prompt ({n}) + chunk-rounded budget "
-                    f"({budget}) exceeds max_len ({self.max_len}){rid}")
-        # validation passed: NOW pay the tokenize copy
-        prompt = list(map(int, prompt))
-        # int32-range seeds pass through untouched; wide/negative seeds
-        # hash-fold (see docstring)
-        seed = int(seed)
-        if not 0 <= seed < 0x80000000:
-            seed = _fold_seed(seed)
-        if self.max_queue and self._pending.full():
-            # shed BEFORE the host->device prompt transfer below: the
-            # rejection path is the overload path, and a full round-trip
-            # device copy per shed request (relayed chips) would spend
-            # exactly the bandwidth backpressure exists to protect.
-            # Non-authoritative (racy) — the timed put below enforces
-            # the bound; this only waits for space to appear first.
-            deadline = time.monotonic() + self._queue_timeout
-            while self._pending.full():
-                if self._stop.is_set() or self._draining:
-                    raise ShuttingDown("batcher shutting down")
-                if time.monotonic() >= deadline:
-                    self.stats["rejected_queue_full"] += 1
-                    raise QueueFull(
-                        f"request queue full (max_queue={self.max_queue},"
-                        f" waited {self._queue_timeout}s)")
-                time.sleep(0.005)
-        req = _Request(prompt, max_new_tokens, temperature, seed,
-                       eos_token, wants_stream=stream,
-                       deadline=(time.monotonic() + deadline_s
-                                 if deadline_s is not None else None))
-        # pad + ship the prompt to the device HERE, on the caller's
-        # thread — see _Request.dev_prompt
-        req.bucket = self._bucket_for(len(prompt))
-        padded = np.zeros((1, req.bucket), np.int32)
-        padded[0, :len(prompt)] = prompt
-        req.dev_prompt = jnp.asarray(padded)
-        # bounded queue: poll briefly for a slot (smooths bursts) then
-        # reject — the caller's thread, not the decode ring, pays the
-        # wait.  Short put ticks so close()/drain() interrupt a BLOCKED
-        # submitter with ShuttingDown immediately instead of leaving it
-        # hanging out the full queue timeout against a dead ring.
-        deadline = time.monotonic() + self._queue_timeout
-        while True:
-            if self._stop.is_set() or self._draining:
-                raise ShuttingDown("batcher shutting down")
-            try:
-                self._pending.put(req, timeout=0.05)
-                break
-            except queue.Full:
-                if time.monotonic() >= deadline:
-                    self.stats["rejected_queue_full"] += 1
-                    raise QueueFull(
-                        f"request queue full (max_queue={self.max_queue},"
-                        f" waited {self._queue_timeout}s)") from None
-        if self._stop.is_set() and not req.done.is_set():
-            # loop died between the liveness check above and the put:
-            # fail the request instead of letting result() hang
-            self._finish(req, ShuttingDown("batcher closed"))
-            return req
-        self._wake.set()
-        return req
-
-    def serving_status(self) -> Dict[str, Any]:
-        """The ``TPUJob.status.serving`` block (camelCase, like
-        GoodputTracker.to_status): cumulative served-token throughput,
-        speculative acceptance rate, and current queue depth — what the
-        manager exports as ``tpujob_serve_*`` gauges on /metrics
-        (utils/observability.py serving_gauges)."""
-        elapsed = max(1e-9, time.monotonic() - self._t_start)
-        drafted = self.stats["spec_drafted"]
-        # per-lane visibility EXCLUDES retired lanes: _evict zeroes the
-        # host pos mirror (and the compiled step zeroes the device pos),
-        # so a freed lane can never leak its last request's fill
-        # position or tokens into the telemetry (test_serve_metrics)
-        return {
-            "tokensPerSec": round(self._tokens_emitted / elapsed, 2),
-            "acceptRate": (round(self.stats["spec_accepted"] / drafted, 4)
-                           if drafted else 0.0),
-            "queueDepth": self._pending.qsize(),
-            "tokensTotal": self._tokens_emitted,
-            "activeLanes": sum(r is not None for r in self.lane),
-            "lanePos": [int(p) for p in self._lane_pos],
-            "prefixHitRate": (self.pool.hit_rate() if self.pool is not None
-                              else 0.0),
-            "kvBlocksFree": (self.pool.blocks_free()
-                             if self.pool is not None else 0),
-            "kvBlocksHwm": (self.pool.stats["blocks_hwm"]
-                            if self.pool is not None else 0),
-            # fault tolerance (infer/resilience.py): drain/rebuild
-            # visibility for /readyz and the CRD's status.serving block
-            "draining": self._draining,
-            "healthy": self.healthy,
-            "deadlineExceeded": self.stats["deadline_exceeded"],
-            "watchdogRestarts": self.stats["watchdog_restarts"],
-            "quarantinedLanes": self.stats["quarantined_lanes"],
-        }
-
-    @property
-    def accepting(self) -> bool:
-        """Readiness (/readyz): the ring takes new admissions — not
-        draining, not mid-rebuild, loop alive, budget unspent."""
-        return (self.healthy and not self._draining
-                and not self._rebuilding and not self._stop.is_set()
-                and self._thread.is_alive())
-
-    def drain(self, budget_s: float = 30.0) -> None:
-        """SIGTERM drain (the serving half of docs/fault-tolerance.md):
-        stop admissions — queued and newly submitted requests fail with
-        :class:`ShuttingDown` (503 + Retry-After upstream) — let the
-        RESIDENT lanes finish within ``budget_s``, cancel stragglers at
-        the budget (their callers receive the tokens produced so far;
-        paged blocks verifiably return to the pool), then close."""
-        self._draining = True
-        self._wake.set()
-        deadline = time.monotonic() + budget_s
-        while time.monotonic() < deadline and self._thread.is_alive():
-            if all(r is None for r in self.lane) and self._pending.empty():
-                break
-            time.sleep(0.02)
-        for req in list(self.lane):
-            if req is not None:
-                req.cancel()            # partial flush at chunk boundary
-        grace = time.monotonic() + max(5.0, budget_s)
-        while (any(r is not None for r in self.lane)
-               and self._thread.is_alive()
-               and time.monotonic() < grace):
-            time.sleep(0.02)
-        self.close()
-
-    def abort(self, error: Optional[Exception] = None) -> None:
-        """Second-SIGTERM semantics: immediate teardown.  Resident
-        requests RESOLVE with their partial tokens (best-effort flush —
-        an undrained kill would have lost them entirely); queued ones
-        fail with ShuttingDown."""
-        self._draining = True
-        self._stop.set()
-        self._wake.set()
-        for i, req in enumerate(self.lane):
-            if req is not None and not req.done.is_set():
-                req.out = req.prompt + self._lane_out[i]
-                self._finish(req)
-        self._shed_queue(error or ShuttingDown("server killed"))
-
-    def close(self) -> None:
-        self._stop.set()
-        self._wake.set()
-        self._thread.join(timeout=30)
-        if self._watchdog is not None:
-            self._watchdog.close()
-        # late blocked submitters can land requests after the loop's own
-        # drain pass — sweep again so none hangs at result()
-        self._shed_queue(ShuttingDown("batcher closed"))
-
-    # -- fault handling ----------------------------------------------------
-
-    def _shed_queue(self, error: Exception) -> None:
-        while True:
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                return
-            self._finish(req, error)
-
-    def _on_stall(self, elapsed: float) -> None:
-        """Watchdog monitor callback: a dispatch/consume wait crossed
-        N x rolling-p95.  Fail the resident requests NOW — their
-        clients get retriable 503s while the ring thread is still stuck
-        inside the wedged dispatch — and flag the rebuild the loop runs
-        once it unwedges."""
-        err = RetriableError(
-            f"compiled dispatch stalled {elapsed:.1f}s (watchdog "
-            f"threshold {self._watchdog.threshold():.1f}s); ring "
-            "rebuilding — retry")
-        for req in list(self.lane):
-            if req is not None and not req.done.is_set():
-                self._finish(req, err)
-        self._fault = err
-
-    def _on_hard_stall(self, elapsed: float) -> None:
-        """The stall outlived hard_stall_factor x threshold: the host
-        thread is unrecoverably stuck inside the runtime.  Flip
-        /healthz so the orchestrator replaces the pod (crash-only)."""
-        self.healthy = False
-
-    def _heal(self, err: Exception) -> bool:
-        """Self-heal after a ring-level fault: fail whatever is still
-        resident with a retriable error, rebuild every piece of device
-        state from scratch (cache, paged pool + radix cache, lane
-        state), back off exponentially.  Returns False — and flips
-        ``healthy`` — when the restart budget is exhausted (the loop
-        then dies the legacy way and /healthz goes unhealthy)."""
-        wrapped = (err if isinstance(err, RetriableError)
-                   else RetriableError(
-                       f"ring dispatch failed ({err}); rebuilt — retry"))
-        # decide + account for the restart BEFORE unblocking any client:
-        # a caller released by the _finish below may immediately read
-        # stats/healthy, and must see the restart it was shed for
-        healing = self._budget is not None and not self._budget.exhausted
-        if healing:
-            self._rebuilding = True
-            self.stats["watchdog_restarts"] += 1
-        else:
-            self.healthy = False
-        for req in list(self.lane):
-            if req is not None and not req.done.is_set():
-                self._finish(req, wrapped)
-        self.lane = [None] * self.slots
-        self._lane_out = [[] for _ in range(self.slots)]
-        self._lane_left = [0] * self.slots
-        self._lane_pos = [0] * self.slots
-        self._lane_first = [None] * self.slots
-        if not healing:
-            return False
-        backoff = self._budget.spend()
-        if self.paged:
-            self.pool = self._pg.PagedCacheManager(
-                self.slots, self.max_len, self.block_size,
-                self._num_blocks, prefix_cache=self._prefix_cache)
-            self.cache = self._pg.init_paged_cache(
-                self.cfg, self.slots, self.pool.total, self.block_size,
-                mesh=self.mesh)
-        else:
-            self.cache = init_ring_cache(self.cfg, self.slots,
-                                         self.max_len, mesh=self.mesh)
-        if self.spec_k:
-            self.dcache = init_ring_cache(self.draft_cfg, self.slots,
-                                          self.max_len, mesh=self.mesh)
-        self.tok = jnp.zeros((self.slots,), jnp.int32)
-        self.temp = jnp.zeros((self.slots,), jnp.float32)
-        self.keys = jnp.zeros((self.slots, 2), jnp.uint32)
-        self._stop.wait(backoff)
-        self._rebuilding = False
-        return True
-
-    def _expire_deadlines(self) -> None:
-        now = time.monotonic()
-        for i, req in enumerate(self.lane):
-            if (req is not None and req.deadline is not None
-                    and now >= req.deadline and not req.done.is_set()):
-                req.deadline_exceeded = True
-                self.stats["deadline_exceeded"] += 1
-                self._evict(i)        # resolves with the partial tokens
-
-    # -- loop --------------------------------------------------------------
-
-    def _bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        raise ValueError(f"no bucket fits prompt length {n}")
-
-    def _suffix_bucket(self, n: int) -> int:
-        """Compile bucket for a prefix-hit SUFFIX forward — sized
-        independently of the prompt buckets (whose smallest entry can
-        be prompt-sized: a 1-token suffix must not pay a 2048-row
-        forward).  Power-of-two ladder up to one block, then block
-        multiples; the compile set stays bounded by
-        log2(block_size) + SUFFIX_PREFILL_MAX_ROWS / block_size."""
-        cap = self.pool.view_len
-        b = 8
-        while b < min(n, self.block_size):
-            b *= 2
-        if b < n:
-            b = -(-n // self.block_size) * self.block_size
-        return min(b, cap)
-
-    def _admit(self, slot: int, req: _Request) -> None:
-        """Admission is ONE compiled dispatch and nothing else on the
-        device path (make_prefill_insert does the splice, first-token
-        sample and all lane-state updates in a single jit): eager ops
-        here would block behind whatever chunk is decoding — measured
-        ~500 ms EACH on relayed chips — and admissions were dominating
-        served throughput.  The first token stays a device future,
-        materialized at the next chunk consume
-        (:meth:`_materialize_first`)."""
-        n = len(req.prompt)
-        if self.paged:
-            first = self._admit_paged(slot, req)
-        elif self.spec_k:
-            (self.cache, self.dcache, self.tok, self.temp, self.keys,
-             first) = self._inserts[req.bucket](
-                self.params, self.draft_params, self.cache, self.dcache,
-                self.tok, self.temp, self.keys, req.dev_prompt,
-                n, slot, float(req.temperature), req.seed)
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_tokens"] += n
-        else:
-            self.cache, self.tok, self.temp, self.keys, first = \
-                self._inserts[req.bucket](
-                    self.params, self.cache, self.tok, self.temp,
-                    self.keys, req.dev_prompt, n, slot,
-                    float(req.temperature), req.seed)
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_tokens"] += n
-        try:                            # ship the first token host-ward
-            first.copy_to_host_async()  # early: TTFT then needs no
-        except AttributeError:          # extra round-trip at consume
-            pass
-        self.lane[slot] = req
-        self._lane_out[slot] = []
-        self._lane_first[slot] = first
-        self._lane_left[slot] = req.max_new
-        self._lane_pos[slot] = n
-        self.stats["admitted"] += 1
-        if req.max_new == 1:
-            # degenerate budget: sync now and free the lane immediately
-            # rather than riding a whole wasted chunk
-            self._materialize_first(slot, req)
-            self._evict(slot)
-
-    def _admit_paged(self, slot: int, req: _Request):
-        """Paged admission: map blocks (radix hits read-only, CoW'd
-        where the suffix will write, fresh for the rest), then ONE
-        compiled insert — the full-prompt scatter insert cold, the
-        suffix-only insert on a prefix hit.  A full prefix hit runs a
-        ONE-token forward (the first sampled token needs the last
-        prompt position's logits — logits are not cached, KV is) and
-        zero forwards over cached blocks; the prefill-call counters are
-        the tests' acceptance gate for that claim."""
-        n = len(req.prompt)
-        # max_suffix: beyond it a prefix hit is not worth taking — the
-        # suffix insert's per-row pool writes (paged._write_rows_paged)
-        # unroll O(rows), so a long divergent suffix admits faster
-        # through the cold block-granular scatter prefill; the
-        # allocator then maps fresh blocks instead of the cached ones
-        # (never written over) when spec mode is off
-        hit_len, cow = self.pool.admit(          # NoFreeBlocks -> req fails
-            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
-        for src, dst in cow:
-            self.cache["k"], self.cache["v"] = self._copy_block(
-                self.cache["k"], self.cache["v"], src, dst)
-        self.stats["cow_copies"] = self.pool.stats["cow_copies"]
-        tbl_row = jnp.asarray(self.pool.table[slot])
-        if self.spec_k:
-            (self.cache, self.dcache, self.tok, self.temp, self.keys,
-             first) = self._inserts[req.bucket](
-                self.params, self.draft_params, self.cache, self.dcache,
-                tbl_row, self.tok, self.temp, self.keys, req.dev_prompt,
-                n, slot, float(req.temperature), req.seed)
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_tokens"] += n
-        elif hit_len:
-            suffix = req.prompt[hit_len:]
-            sb = self._suffix_bucket(len(suffix))
-            ins = self._suffix_inserts.get(sb)
-            if ins is None:
-                ins = self._pg.make_paged_suffix_insert(
-                    self.cfg, sb, self.block_size, self._top_k,
-                    self._top_p, mesh=self.mesh)
-                self._suffix_inserts[sb] = ins
-            padded = np.zeros((1, sb), np.int32)
-            padded[0, :len(suffix)] = suffix
-            self.cache, self.tok, self.temp, self.keys, first = ins(
-                self.params, self.cache, tbl_row, self.tok, self.temp,
-                self.keys, jnp.asarray(padded), len(suffix), hit_len,
-                slot, float(req.temperature), req.seed)
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_tokens"] += len(suffix)
-        else:
-            self.cache, self.tok, self.temp, self.keys, first = \
-                self._inserts[req.bucket](
-                    self.params, self.cache, tbl_row, self.tok,
-                    self.temp, self.keys, req.dev_prompt, n, slot,
-                    float(req.temperature), req.seed)
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_tokens"] += n
-        # register this lane's full prompt blocks for future admissions
-        # (content is valid for any later dispatch — same device stream)
-        self.pool.publish(slot, req.prompt)
-        return first
-
-    def _materialize_first(self, i: int, req: _Request) -> None:
-        """Bring the admission-sampled first token to the host (the only
-        per-request sync, folded into a chunk consume) and run it through
-        the same budget/eos/stream bookkeeping as chunk tokens."""
-        fd = self._lane_first[i]
-        if fd is None:
-            return
-        self._lane_first[i] = None
-        t = int(fd)
-        self._lane_out[i].append(t)
-        self._tokens_emitted += 1
-        if req._stream is not None:
-            req._stream.put(t)
-        self._lane_left[i] -= 1
-        if req.eos is not None and t == req.eos:
-            self._lane_left[i] = 0
-
-    @staticmethod
-    def _finish(req: _Request, error: Optional[Exception] = None) -> None:
-        # a request that already RESOLVED keeps its outcome: attaching a
-        # late error (e.g. the loop's shutdown sweep racing abort()'s
-        # partial flush) would turn a delivered partial into a raise
-        if error is not None and req.error is None \
-                and not req.done.is_set():
-            req.error = error
-        # done BEFORE the stream sentinel: a stream() consumer that sees
-        # the close must find result() already resolvable
-        req.done.set()
-        if req._stream is not None:
-            req._stream.put(None)
-
-    def _evict(self, slot: int) -> None:
-        # host bookkeeping ONLY — no device ops (an eager .at[].set here
-        # blocks behind the in-flight chunk on relayed chips).  The
-        # lane's stale temp/keys are harmless: inactive lanes' tokens
-        # are ignored, and the next admission overwrites all lane state
-        # inside its compiled insert.
-        req = self.lane[slot]
-        self.lane[slot] = None
-        self._lane_pos[slot] = 0        # retired lanes report no pos
-        if self.pool is not None:
-            # return the lane's blocks: published prompt blocks become
-            # reclaimable cache, private ones rejoin the free list; the
-            # zeroed table row routes any in-flight pipelined write for
-            # this lane into the trash block
-            self.pool.retire(slot)
-        self.stats["evicted"] += 1
-        if req is not None and not req.done.is_set():
-            # error-path evictions can race ahead of the first consume
-            self._materialize_first(slot, req)
-            req.out = req.prompt + self._lane_out[slot]
-            self._finish(req)
-        else:
-            # already resolved (watchdog stall / quarantine failed it
-            # from another thread): just release the lane state
-            self._lane_first[slot] = None
-
-    def _loop(self) -> None:
-        try:
-            self._loop_body()
-        except Exception as e:       # unrecoverable failure: fail loudly
-            # flip dead-state BEFORE unblocking any client: a caller
-            # released by the _finish below may immediately submit
-            # again, and must be refused rather than queued into a void
-            self.healthy = False
-            self._stop.set()
-            for req in self.lane:
-                if req is not None:
-                    self._finish(req, e)
-            self.lane = [None] * self.slots
-        # drain: fail whatever is still queued or resident
-        for i, req in enumerate(self.lane):
-            if req is not None:
-                self._finish(req, ShuttingDown("batcher closed"))
-                self.lane[i] = None
-        self._shed_queue(ShuttingDown("batcher closed"))
-
-    def _scrub_lane_blocks(self, slot: int) -> None:
-        """Zero lane ``slot``'s PRIVATE pool blocks before they return
-        to the free list: a NaN row in a re-mapped block would poison
-        the next lane through the masked-tail contraction (softmax
-        underflows masked columns to exactly 0, but 0 * NaN = NaN) —
-        the same invariant the contiguous ring keeps by zeroing the
-        whole lane at splice, block-granular.
-
-        PUBLISHED (radix-cached) blocks are skipped: they hold shared
-        prefix KV other admissions still read, and this lane cannot
-        have poisoned them — every block the lane writes is private by
-        construction (admit CoWs any hit block at/after the first
-        written position).  One fused scatter over all victim blocks
-        per pool (not one eager update per block): each ``.at[].set``
-        materializes a full pool copy, and this runs on the ring
-        thread behind the in-flight chunk."""
-        row = self.pool.table[slot]
-        blks = [int(row[j]) for j in range(self.pool.mapped_count[slot])
-                if self.pool.ref[int(row[j])] == 1
-                and int(row[j]) not in self.pool.by_block]
-        if blks:
-            idx = jnp.asarray(blks)
-            self.cache["k"] = self.cache["k"].at[:, idx].set(0)
-            self.cache["v"] = self.cache["v"].at[:, idx].set(0)
-
-    def _consume(self, chunk_reqs, toks, counts=None, ok=None) -> None:
-        """Apply one finished chunk's tokens ([chunk, slots] on host).
-        ``chunk_reqs`` pins each lane to the REQUEST the chunk was
-        dispatched for: under pipelining a lane may have been evicted
-        (and even re-admitted) since dispatch — such in-flight tokens
-        belong to the old request and are dropped.
-
-        ``counts`` (speculative mode): per-lane count of VALID rows in
-        ``toks`` — the variable accept-length advance.  Lane i takes
-        ``toks[:counts[i], i]`` (its accepted drafts + the correction
-        token); None means every row is valid (plain chunk mode).  The
-        budget/eos walk below is shared, so an eos landing mid-
-        speculated-block truncates exactly like one landing mid-chunk —
-        no tokens after eos ever reach the result or the stream.
-
-        ``ok`` (nan_check mode): per-lane isfinite verdict for this
-        chunk — a False lane is QUARANTINED: its request fails
-        (:class:`LaneQuarantined`), its blocks are scrubbed + freed,
-        and no token of the poisoned chunk reaches any consumer.  The
-        other lanes are attention-independent, so their streams stay
-        bit-identical to a fault-free run."""
-        for i, req in chunk_reqs:
-            if req is None or self.lane[i] is not req \
-                    or req.done.is_set():
-                continue
-            if ok is not None and not bool(ok[i]):
-                self.stats["quarantined_lanes"] += 1
-                if self.pool is not None:
-                    self._scrub_lane_blocks(i)
-                self._finish(req, LaneQuarantined(
-                    f"lane {i} produced non-finite logits; request "
-                    "failed, lane quarantined (ring unaffected)"))
-                self._evict(i)
-                continue
-            self._materialize_first(i, req)
-            n = toks.shape[0] if counts is None else int(counts[i])
-            # the host fill-position mirror advances exactly like the
-            # device pos (chunk ticks, or the spec round's commit count)
-            self._lane_pos[i] += n
-            if counts is not None:
-                self.stats["spec_drafted"] += self.spec_k
-                self.stats["spec_accepted"] += max(0, n - 1)
-                req.drafted += self.spec_k
-                req.accepted += max(0, n - 1)
-            for t in toks[:n, i]:
-                if self._lane_left[i] <= 0:
-                    break
-                self._lane_out[i].append(int(t))
-                self._tokens_emitted += 1
-                if req._stream is not None:
-                    req._stream.put(int(t))
-                self._lane_left[i] -= 1
-                if req.eos is not None and int(t) == req.eos:
-                    self._lane_left[i] = 0
-            if self._lane_left[i] <= 0:
-                self._evict(i)
-
-    def _consume_oldest(self, pending: List[tuple]) -> None:
-        """Pop + apply the oldest in-flight chunk.  The blocking
-        device->host completion wait sits under the watchdog: a wedged
-        dispatch surfaces HERE on real chips (dispatches are async), and
-        the monitor fails the waiting clients while this thread is still
-        stuck."""
-        chunk_reqs, toks_dev, counts_dev, ok_dev = pending.pop(0)
-        wd = self._watchdog
-        if wd is not None:
-            wd.begin()
-        try:
-            toks = np.asarray(toks_dev)
-            counts = None if counts_dev is None else np.asarray(counts_dev)
-            ok = None if ok_dev is None else np.asarray(ok_dev)
-        finally:
-            if wd is not None:
-                wd.end()
-        if self._fault is None:     # stall-failed chunks must not apply
-            self._consume(chunk_reqs, toks, counts, ok)
-
-    def _loop_body(self) -> None:
-        # Up to ``pipeline_depth`` chunks in flight at all times (when
-        # lanes are active): the host consumes chunk N's tokens — per-
-        # token queue pushes, evict bookkeeping, and crucially the
-        # device->host transfer latency — WHILE the device decodes
-        # chunks N+1..N+depth.  Without this the ring serializes RTT
-        # with compute; depth 1 was still RTT-bound on relayed chips
-        # whose round-trip exceeds a chunk's device time (measured by
-        # bench.py measure_ring_throughput), hence depth 2 by default.
-        pending: List[tuple] = []   # [(chunk_reqs, toks, counts, ok)]
-        while not self._stop.is_set():
-            # ring-level fault (dispatch raised, or the watchdog
-            # declared a stall): drop the in-flight chunks and self-heal
-            # — rebuild everything device-side, re-admit queued work —
-            # or die (legacy / budget exhausted) via the raise, which
-            # the _loop wrapper turns into fail-everything + unhealthy
-            if self._fault is not None:
-                err, self._fault = self._fault, None
-                pending.clear()
-                if not self._heal(err):
-                    raise err
-                continue
-            if self._draining:
-                # drain: no new admissions; whatever is queued sheds
-                # with ShuttingDown (clients retry another replica)
-                self._shed_queue(ShuttingDown(
-                    "server draining; retry another replica"))
-            self._expire_deadlines()
-            # cancelled lanes leave at the chunk boundary: the request
-            # resolves with whatever tokens it has, the lane frees for
-            # the next admission (serve.py calls cancel() when a stream
-            # consumer disconnects mid-generation)
-            for i, r in enumerate(self.lane):
-                if r is not None and r._cancel:
-                    self._evict(i)
-            # admit into free lanes
-            while not self._draining and any(r is None for r in self.lane):
-                try:
-                    req = self._pending.get_nowait()
-                except queue.Empty:
-                    break
-                if req._cancel:                 # cancelled while queued
-                    req.out = list(req.prompt)
-                    self._finish(req)
-                    continue
-                if (req.deadline is not None
-                        and time.monotonic() >= req.deadline):
-                    # expired while queued: prompt-only 504 partial —
-                    # resolved, never silently dropped
-                    req.deadline_exceeded = True
-                    self.stats["deadline_exceeded"] += 1
-                    req.out = list(req.prompt)
-                    self._finish(req)
-                    continue
-                slot = self.lane.index(None)
-                try:
-                    self._admit(slot, req)
-                except Exception as e:          # bad request: fail it only
-                    self._finish(req, e)
-                    self.lane[slot] = None
-                    self._lane_pos[slot] = 0
-                    if self.pool is not None:
-                        # admission may have mapped blocks before the
-                        # dispatch failed — unmap them (no-op when the
-                        # allocator itself rejected)
-                        self.pool.retire(slot)
-
-            active_idx = [i for i, r in enumerate(self.lane)
-                          if r is not None]
-            if not active_idx:
-                if pending:
-                    try:
-                        self._consume_oldest(pending)
-                    except Exception as e:
-                        self._fault = e
-                    continue            # eviction may have freed lanes
-                self._wake.wait(timeout=0.1)
-                self._wake.clear()
-                continue
-            self.stats["max_active"] = max(self.stats["max_active"],
-                                           len(active_idx))
-
-            tbl = None
-            if self.paged:
-                # on-demand block mapping: grow each active lane's table
-                # to cover this dispatch PLUS every chunk already in
-                # flight for it (the host pos mirror lags dispatched-
-                # but-unconsumed work; spec rounds advance a
-                # data-dependent 1..K+1, so the bound is the worst case).
-                # An UNDERSIZED pool (num_blocks oversubscription) can
-                # run dry mid-generation: only the lane that cannot
-                # grow fails — evicting it (its request resolves with
-                # the error) frees its blocks for the rest of the ring,
-                # which must keep serving.
-                advance = (self.spec_k + 1) if self.spec_k else self.chunk
-                for i in list(active_idx):
-                    inflight = sum(
-                        1 for chunk_reqs, _, _, _ in pending
-                        for j, r in chunk_reqs
-                        if j == i and r is self.lane[i])
-                    try:
-                        self.pool.ensure(
-                            i, self._lane_pos[i] + (inflight + 1) * advance)
-                    except self._pg.NoFreeBlocks as e:
-                        r = self.lane[i]
-                        if r is not None and r.error is None:
-                            r.error = e
-                        self._evict(i)
-                        active_idx.remove(i)
-                if not active_idx:
-                    continue        # every lane starved: retry the loop
-                tbl = self.pool.device_table()
-            active = jnp.asarray(
-                [r is not None for r in self.lane], bool)
-            # async dispatch: returns device futures immediately.  The
-            # watchdog brackets it anyway — a chaos-injected host-side
-            # hang (and a synchronous-dispatch backend) wedges HERE —
-            # and any raise becomes a ring fault handled at the loop top
-            # (fail resident requests retriably, rebuild, back off).
-            wd = self._watchdog
-            if wd is not None:
-                wd.begin()
-            try:
-                ok_dev = None
-                if self.spec_k:
-                    spec_args = (self.params, self.draft_params,
-                                 self.cache, self.dcache)
-                    if self.paged:
-                        spec_args += (tbl,)
-                    (self.cache, self.dcache, self.tok, toks_dev,
-                     counts_dev) = self._spec_step(
-                        *spec_args, self.tok, self.temp, self.keys,
-                        active)
-                elif self.paged:
-                    out = self._step(
-                        self.params, self.cache, tbl, self.tok,
-                        self.temp, self.keys, active)
-                    counts_dev = None
-                    if self._check_finite:
-                        self.cache, self.tok, toks_dev, ok_dev = out
-                    else:
-                        self.cache, self.tok, toks_dev = out
-                else:
-                    out = self._step(
-                        self.params, self.cache, self.tok, self.temp,
-                        self.keys, active)
-                    counts_dev = None
-                    if self._check_finite:
-                        self.cache, self.tok, toks_dev, ok_dev = out
-                    else:
-                        self.cache, self.tok, toks_dev = out
-            except Exception as e:
-                self._fault = e
-                continue
-            finally:
-                if wd is not None:
-                    wd.end()
-            self.stats["chunks"] += 1
-            # kick the device->host copy NOW, before the consume wait:
-            # by consume time the tokens are already on the wire and
-            # np.asarray is a cheap completion wait instead of a full
-            # round-trip on the ring's critical path
-            for dev in (toks_dev, counts_dev, ok_dev):
-                try:
-                    dev.copy_to_host_async()
-                except AttributeError:  # None / interpret-mode ndarray
-                    pass
-            pending.append(([(i, self.lane[i]) for i in active_idx],
-                            toks_dev, counts_dev, ok_dev))
-            if len(pending) >= self.pipeline_depth:
-                try:
-                    self._consume_oldest(pending)
-                except Exception as e:
-                    self._fault = e
-
-
-def _default_buckets(max_len: int) -> Tuple[int, ...]:
-    """2-3 prefill compile buckets, always ending at max_len so every
-    admissible prompt has a bucket."""
-    out: List[int] = []
-    b = 64
-    while b < max_len and len(out) < 2:
-        out.append(b)
-        b *= 8
-    out.append(max_len)
-    return tuple(out)
+from paddle_operator_tpu.infer.scheduler import (  # noqa: F401
+    PREFILL_MODES,
+    ContinuousBatcher,
+    QueueFull,
+    _fold_seed,
+    _Request,
+)
+
+__all__ = [
+    "ContinuousBatcher", "QueueFull", "PrefillExecutor", "RingExecutor",
+    "PREFILL_MODES", "init_ring_cache", "make_chunk_step",
+    "make_prefill_insert", "make_spec_prefill_insert",
+    "make_prefill_chunk", "make_chunked_final_insert",
+    "make_spec_chunked_final_insert", "make_attach_lane",
+    "make_spec_attach", "make_disagg_prefill",
+]

@@ -1,0 +1,1195 @@
+"""Device half of the serving ring: compiled programs + ring state.
+
+ISSUE 6 split the ~1.6k-line ``infer/batcher.py`` into a **scheduler**
+(infer/scheduler.py — admission, queues, deadlines, request lifecycle,
+resilience hooks; pure host code) and this **executor** (compiled
+dispatch, ring/paged caches, prefill and decode step functions; every
+``jax`` touch of the serving hot path).  The split is what lets prefill
+and decode executors differ: :class:`RingExecutor` owns the decode
+ring's resident programs and device state, while
+:class:`PrefillExecutor` is a SEPARATE prefill engine (its own thread,
+its own block pool) that fills paged KV blocks and hands completed
+block tables to the decode ring — the in-process half of DistServe-
+style disaggregation (Zhong et al., 2024).
+
+Three prefill paths feed the ring (scheduler knob ``prefill_mode``,
+serve.py env ``SERVE_PREFILL``):
+
+- **inline** (the original): admission is ONE compiled prefill-insert
+  dispatch on the ring thread — a cold 2k prompt stalls every resident
+  decode lane for the full prefill.
+- **chunked** (Sarathi-Serve, Agrawal et al., 2024): prefill runs in
+  decode-sized token slices (``prefill_chunk``) interleaved into ring
+  iterations — intermediate slices only append KV (no lm head), the
+  final slice reuses the paged SUFFIX-insert (or the contiguous
+  equivalent) to sample the first token, so resident lanes never wait
+  more than one slice.
+- **disagg**: cold prompts prefill on :class:`PrefillExecutor`'s own
+  thread into its own pool; the decode ring's only work is a
+  device-to-device block copy + a tiny attach dispatch at handoff.
+  Prefix HITS still admit through the radix suffix-insert on the ring
+  thread (only uncached suffix tokens are ever prefilled anywhere).
+
+All three are greedy-bit-identical to the inline ring: every prefill
+path runs the same compiled op sequences (``decode._forward`` /
+``speculative._multi_forward(_paged)``) and samples the first token
+through the shared ``_sample_tokens`` rule — pinned by
+tests/test_prefill_modes.py and the dryrun ``serve-disagg`` line.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.infer import decode as D
+from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
+
+
+# ---------------------------------------------------------------------------
+# Per-lane-position forward step (moved verbatim from infer/batcher.py)
+# ---------------------------------------------------------------------------
+
+
+def init_ring_cache(cfg: LlamaConfig, slots: int,
+                    max_len: int, mesh=None) -> Dict[str, jax.Array]:
+    """KV ring: like decode.init_cache (same head-major layout,
+    block-aligned allocation, same kv-head tp sharding under a serving
+    mesh) but with a per-lane fill position vector instead of one
+    scalar."""
+    if max_len > cfg.max_seq_len:
+        raise ValueError(f"max_len {max_len} exceeds the RoPE table "
+                         f"(cfg.max_seq_len={cfg.max_seq_len})")
+    alloc = D.cache_alloc_len(max_len)
+    shape = (cfg.n_layers, slots, cfg.n_kv_heads, alloc, cfg.head_dim)
+    return {
+        "k": D.alloc_kv_buffer(cfg, shape, mesh),
+        "v": D.alloc_kv_buffer(cfg, shape, mesh),
+        "pos": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def _write_lane(cache_l: jax.Array, kv: jax.Array,
+                pos: jax.Array) -> jax.Array:
+    """[B, H, S, D] cache layer <- [B, H, 1, D] new row at per-lane pos."""
+    return jax.vmap(
+        lambda c, x, p: jax.lax.dynamic_update_slice(c, x, (0, p, 0))
+    )(cache_l, kv, pos)
+
+
+def _qkv_ring(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+              cos: jax.Array, sin: jax.Array, pos: jax.Array):
+    """Pre-attention half for ONE new token per lane at per-lane
+    positions ``pos`` [B]: RMSNorm -> projections -> RoPE at each
+    lane's own position (the table slice is a plain gather cos[pos])."""
+    b = x.shape[0]
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, 1, hq, d)
+    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
+    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
+    cos_b = cos[pos][:, None, None, :]          # [B, 1, 1, d/2]
+    sin_b = sin[pos][:, None, None, :]
+
+    def rot(t):
+        t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [t1 * cos_b - t2 * sin_b, t2 * cos_b + t1 * sin_b],
+            axis=-1).astype(t.dtype)
+
+    return rot(q), rot(k), v
+
+
+def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+                cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
+                v_cache: jax.Array, pos: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer for ONE new token per lane ([B, 1, D] at lane
+    positions ``pos`` [B]) with the XLA einsum attention.  Same math as
+    decode._layer (which this is pinned against) with the scalar
+    position generalized to a vector.  The pallas path keeps the caches
+    stacked and does not go through here (see _ring_forward)."""
+    b = x.shape[0]
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+    k_cache = _write_lane(k_cache, k.transpose(0, 2, 1, 3), pos)
+    v_cache = _write_lane(v_cache, v.transpose(0, 2, 1, 3), pos)
+
+    n_rep = hq // hkv
+    max_len = k_cache.shape[2]
+    qg = q.reshape(b, 1, hkv, n_rep, d)
+    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_cache,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    # lane b may attend cache cols [0, pos_b] (its own new row incl.)
+    mask = jnp.arange(max_len)[None, :] <= pos[:, None]      # [B, S]
+    scores = jnp.where(mask[:, None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+    x = x + D._mm(out, lp["attn"]["wo"]["kernel"], cfg.dtype)
+
+    n = D._rms(x, lp["mlp_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    if cfg.n_experts > 0:
+        ffn = D._moe_ffn(cfg, lp["moe"], n)
+    else:
+        gate = D._mm(n, lp["mlp"]["w1"]["kernel"], cfg.dtype)
+        up = D._mm(n, lp["mlp"]["w3"]["kernel"], cfg.dtype)
+        ffn = D._mm(jax.nn.silu(gate) * up, lp["mlp"]["w2"]["kernel"],
+                    cfg.dtype)
+    return x + ffn, k_cache, v_cache
+
+
+def _write_lane_stacked(stack: jax.Array, kv: jax.Array, li: jax.Array,
+                        pos: jax.Array) -> jax.Array:
+    """[L, B, H, S, D] stacked cache <- [B, H, 1, D] new rows at layer
+    ``li`` and per-lane positions ``pos``.
+
+    One dynamic_update_slice PER LANE (a static unroll over the slot
+    count), not a vmapped/batched update: vmapping over ragged lane
+    positions lowers to a scatter, and a scatter into the scan-carried
+    stack makes XLA materialize a copy of the whole ring cache per
+    layer per tick — measured 30x slower than raw decode.  Chained
+    single-row dus ops update the carry in place."""
+    b = kv.shape[0]
+    for lane in range(b):
+        stack = jax.lax.dynamic_update_slice(
+            stack, kv[lane][None, None], (li, lane, 0, pos[lane], 0))
+    return stack
+
+
+def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
+                  tok: jax.Array, cache: Dict[str, jax.Array],
+                  mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """tok [B] at per-lane cache['pos'] -> (logits [B, V], advanced
+    cache).  Counterpart of decode._forward for vector positions; like
+    it, the pallas path carries the caches STACKED through the layer
+    scan so the kernel reads them copy-free (decode.py _forward has the
+    why), and under a serving mesh the kernel + output projection run
+    TP-sharded in one manual region per layer (the ragged per-lane
+    ``pos`` vector is exactly the ``lengths`` operand the kernel's
+    index map already takes — replicated across shards)."""
+    pos = cache["pos"]
+    x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tok[:, None]]
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                cfg.rope_theta)
+
+    attn_impl = cfg.resolved_decode_attn()
+    use_sharded = D._use_sharded_kernel(cfg, mesh, attn_impl)
+    if D.mesh_tp(mesh) > 1 and not use_sharded:
+        attn_impl = "xla"   # whole GQA groups don't split: GSPMD einsum
+    if use_sharded:
+        from paddle_operator_tpu.ops.decode_attention import (
+            sharded_decode_attention,
+        )
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
+            vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
+            proj = sharded_decode_attention(
+                mesh, q[:, 0], kc, vc, pos + 1,
+                lp["attn"]["wo"]["kernel"], layer=li,
+                interpret=(attn_impl == "pallas-interpret"),
+                compute_dtype=cfg.dtype)
+            x = x + proj[:, None].astype(cfg.dtype)
+            return (D._ffn_residual(cfg, lp, x), kc, vc), ()
+
+        (x, k_new, v_new), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    elif attn_impl != "xla":
+        from paddle_operator_tpu.ops.decode_attention import decode_attention
+
+        b = x.shape[0]
+        hq, d = cfg.n_heads, cfg.head_dim
+
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
+            vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
+            out = decode_attention(
+                q[:, 0], kc, vc, pos + 1, layer=li,
+                interpret=(attn_impl == "pallas-interpret"))
+            out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+            return (D._finish_layer(cfg, lp, x, out), kc, vc), ()
+
+        (x, k_new, v_new), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+    else:
+        def body(x, layer_in):
+            lp, k_c, v_c = layer_in
+            y, k_c, v_c = _layer_step(cfg, lp, x, cos, sin, k_c, v_c, pos)
+            return y, (k_c, v_c)
+
+        x, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["layers"], cache["k"], cache["v"]))
+    x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    logits = D._mm(x, params["lm_head"]["kernel"],
+                   cfg.dtype).astype(jnp.float32)
+    return logits[:, 0], {"k": k_new, "v": v_new, "pos": pos + 1}
+
+
+def _sample_tokens(logits, temp, keys, pos, top_k, top_p):
+    """THE per-lane sampling rule — shared by the chunk step and EVERY
+    admission insert (inline, chunked final, suffix, disagg) so token 1
+    and tokens 2..N can never be drawn under different rules.  logits
+    [B, V], temp [B], keys [B, 2], pos [B] -> [B] int32: greedy at temp
+    0, else per-lane fold_in(position) (deterministic given (seed,
+    pos), independent across lanes and steps) feeding temperature +
+    top-k/top-p filtered categorical sampling."""
+    greedy = logits.argmax(-1).astype(jnp.int32)
+    filt = D._filter_logits(
+        logits / jnp.maximum(temp, 1e-6)[:, None], top_k, top_p)
+    sub = jax.vmap(jax.random.fold_in)(keys, pos)
+    drawn = jax.vmap(
+        lambda k, l: jax.random.categorical(k, l))(sub, filt)
+    return jnp.where(temp > 0, drawn.astype(jnp.int32), greedy)
+
+
+def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None, mesh=None,
+                    check_finite: bool = False):
+    """The ONE resident compiled decode program.
+
+    ``step(params, cache, tok [B], temp [B], keys [B,2], active [B])
+    -> (cache', tok', toks [chunk, B])``
+
+    Runs ``chunk_tokens`` ticks for every lane.  Inactive lanes compute
+    (their FLOPs are the price of static shapes — standard slot-server
+    trade) but neither advance their position nor write meaningful
+    state; their emitted tokens are ignored host-side.  The cache is
+    donated: the ring buffer must never be copied per chunk.  Under a
+    serving mesh the whole chunk remains ONE sharded dispatch — the
+    shard_map kernel regions and GSPMD einsums compile into the same
+    resident program, no eager per-device ops anywhere.
+
+    ``check_finite=True`` (infer/resilience.py nan_check): the step
+    additionally returns ``ok [B]`` — an isfinite fold of every tick's
+    logits per lane, so the host can quarantine a NaN-producing lane
+    (fail ONE request, never the ring) without shipping the logits
+    home.  Token outputs are unchanged; the fold rides the same scan.
+    """
+
+    def step(params, cache, tok, temp, keys, active):
+        def tick(carry, _):
+            # the isfinite fold rides the carry ONLY when requested —
+            # the default resident program is unchanged
+            if check_finite:
+                cache, tok, ok = carry
+            else:
+                cache, tok = carry
+            logits, new_cache = _ring_forward(cfg, params, tok, cache,
+                                              mesh=mesh)
+            nxt = _sample_tokens(logits, temp, keys, cache["pos"],
+                                 top_k, top_p)
+            # retired/free lanes: position ZEROED (a stale fill
+            # position must never outlive its request — the
+            # serving_status staleness fix); their (ignored) writes
+            # land at row 0, which the next admission's splice
+            # overwrites along with the rest of the lane
+            new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
+            nxt = jnp.where(active, nxt, tok)
+            if check_finite:
+                ok = ok & jnp.all(jnp.isfinite(logits), axis=-1)
+                return (new_cache, nxt, ok), nxt
+            return (new_cache, nxt), nxt
+
+        if check_finite:
+            (cache, tok, ok), toks = jax.lax.scan(
+                tick, (cache, tok, jnp.ones(tok.shape, bool)), None,
+                length=chunk_tokens)
+            return cache, tok, toks, ok
+        (cache, tok), toks = jax.lax.scan(
+            tick, (cache, tok), None, length=chunk_tokens)
+        return cache, tok, toks
+
+    return jax.jit(step, donate_argnums=(1,))
+
+
+def _splice_lane(ring: Dict[str, jax.Array], lane: Dict[str, jax.Array],
+                 slot, prompt_len) -> Dict[str, jax.Array]:
+    """Zero ring lane ``slot`` and splice a freshly prefilled
+    batch-of-one lane cache into it, setting the lane's fill position
+    to ``prompt_len`` — the device half of admission, shared by the
+    plain, speculative and chunked-final inserts so their splice
+    semantics cannot drift.  A lane cache LONGER than the ring lane
+    (a chunk-width-padded staging cache) is truncated: rows past the
+    ring allocation are pads by construction."""
+    ring_alloc = ring["k"].shape[3]
+    lane_k, lane_v = lane["k"], lane["v"]
+    if lane_k.shape[3] > ring_alloc:
+        lane_k = lane_k[:, :, :, :ring_alloc]
+        lane_v = lane_v[:, :, :, :ring_alloc]
+    k = jnp.zeros_like(ring["k"][:, 0])
+    k = jax.lax.dynamic_update_slice(k, lane_k[:, 0], (0, 0, 0, 0))
+    v = jnp.zeros_like(ring["v"][:, 0])
+    v = jax.lax.dynamic_update_slice(v, lane_v[:, 0], (0, 0, 0, 0))
+    new_k = jax.lax.dynamic_update_slice(
+        ring["k"], k[:, None], (0, slot, 0, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(
+        ring["v"], v[:, None], (0, slot, 0, 0, 0))
+    return {"k": new_k, "v": new_v,
+            "pos": ring["pos"].at[slot].set(prompt_len)}
+
+
+def make_prefill_insert(cfg: LlamaConfig, bucket: int,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None, mesh=None):
+    """Per-prompt-bucket compiled admission: prefill a [1, bucket]
+    (right-padded) prompt, splice its KV into ring lane ``slot``, sample
+    the first token, and update EVERY piece of lane state — tok, temp,
+    keys — in the same compiled program.
+
+    One dispatch on purpose: on relayed chips, EAGER ops (``.at[].set``,
+    ``argmax``) block until all in-flight device work drains (measured
+    ~500 ms behind a decoding chunk), so an admission built from eager
+    lane updates stalled the whole ring for ~half a second per request.
+    Everything device-side about admission lives inside this jit; the
+    host's only jobs are bookkeeping lists.
+
+    Exactness with padding: pad rows fill cache positions PAST the real
+    prompt; the causal mask keeps real rows from attending them, the
+    first token samples from ``prompt_len - 1`` (the last REAL
+    position), the lane position is set to ``prompt_len`` so decode
+    overwrites the pad rows before they ever become attendable.
+
+    ``insert(params, cache, tok, temp, keys, prompt [1,bucket],
+    prompt_len, slot, temp_val, seed)
+    -> (cache', tok', temp', keys', first_token)``
+    """
+
+    def insert(params, cache, tok, temp, keys, prompt, prompt_len, slot,
+               temp_val, seed):
+        lane = D.init_cache(cfg, 1, bucket)
+        logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
+        logits = logits[0, prompt_len - 1]                  # last real row
+        new_cache = _splice_lane(cache, lane, slot, prompt_len)
+        # first token through the SHARED sampling rule (_sample_tokens),
+        # batch-of-one shaped
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(1, 2, 3, 4))
+
+
+def make_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
+                             bucket: int, top_k: Optional[int] = None,
+                             top_p: Optional[float] = None, mesh=None):
+    """Admission for the SPECULATIVE ring: one compiled dispatch that
+    prefills the prompt into BOTH the target and the draft lane (the
+    draft's logits are discarded — it only needs the KV context to
+    propose from) and samples the first token from the target, with the
+    same exactness-with-padding story as :func:`make_prefill_insert`.
+
+    ``insert(params, dparams, cache, dcache, tok, temp, keys,
+    prompt [1,bucket], prompt_len, slot, temp_val, seed)
+    -> (cache', dcache', tok', temp', keys', first_token)``
+    """
+
+    def insert(params, dparams, cache, dcache, tok, temp, keys, prompt,
+               prompt_len, slot, temp_val, seed):
+        lane = D.init_cache(cfg, 1, bucket)
+        logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
+        logits = logits[0, prompt_len - 1]
+        new_cache = _splice_lane(cache, lane, slot, prompt_len)
+        dlane = D.init_cache(dcfg, 1, bucket)
+        _, dlane = D._forward(dcfg, dparams, prompt, dlane,
+                              last_only=True, mesh=mesh)
+        new_dcache = _splice_lane(dcache, dlane, slot, prompt_len)
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache, new_dcache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(2, 3, 4, 5, 6))
+
+
+# ---------------------------------------------------------------------------
+# Chunked prefill: intermediate slice + final-insert programs
+# ---------------------------------------------------------------------------
+
+
+def make_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
+                       staging_len: int, mesh=None):
+    """One INTERMEDIATE chunked-prefill slice against a contiguous
+    staging lane cache ([L, 1, H, staging_len, D], donated): append the
+    slice's KV rows at absolute positions [start, start + slice_bucket)
+    and skip the lm head entirely (only the FINAL slice needs logits).
+    Pad rows of the last full-width slice land past the real prompt and
+    are either overwritten by the next slice or truncated/masked at
+    splice — the contiguous ring's exactness-with-padding story.
+
+    ``chunk(params, lane_k, lane_v, toks [1, slice_bucket], start)
+    -> (lane_k', lane_v')``
+    """
+    from paddle_operator_tpu.infer.speculative import _multi_forward
+
+    def chunk(params, lane_k, lane_v, toks, start):
+        cache = {"k": lane_k, "v": lane_v,
+                 "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
+        _, new = _multi_forward(cfg, params, toks, cache, mesh=mesh,
+                                head=False)
+        return new["k"], new["v"]
+
+    return jax.jit(chunk, donate_argnums=(1, 2))
+
+
+def make_chunked_final_insert(cfg: LlamaConfig, slice_bucket: int,
+                              staging_len: int,
+                              top_k: Optional[int] = None,
+                              top_p: Optional[float] = None, mesh=None):
+    """The FINAL chunked-prefill slice for the contiguous ring: run the
+    last (right-padded) slice over the staging lane cache, splice the
+    completed lane into ring slot ``slot``, and sample the first token
+    — the back half of :func:`make_prefill_insert` with the forward
+    restricted to the rows the intermediate slices did not cover.
+
+    ``insert(params, cache, lane_k, lane_v, tok, temp, keys,
+    toks [1, slice_bucket], n_rows, start, prompt_len, slot, temp_val,
+    seed) -> (cache', tok', temp', keys', first_token)``
+    """
+    from paddle_operator_tpu.infer.speculative import _multi_forward
+
+    def insert(params, cache, lane_k, lane_v, tok, temp, keys, toks,
+               n_rows, start, prompt_len, slot, temp_val, seed):
+        stage = {"k": lane_k, "v": lane_v,
+                 "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
+        logits, new_lane = _multi_forward(cfg, params, toks, stage,
+                                          mesh=mesh)
+        logits = logits[0, n_rows - 1]
+        new_cache = _splice_lane(cache, new_lane, slot, prompt_len)
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    # the staging lane_k/lane_v are consumed but NOT donated: no output
+    # shares their shape, so donation only buys an XLA warning
+    return jax.jit(insert, donate_argnums=(1, 4, 5, 6))
+
+
+def make_spec_chunked_final_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
+                                   slice_bucket: int, staging_len: int,
+                                   bucket: int,
+                                   top_k: Optional[int] = None,
+                                   top_p: Optional[float] = None,
+                                   mesh=None):
+    """Chunked final insert for the SPECULATIVE contiguous ring: the
+    target's last slice rides the staging cache like
+    :func:`make_chunked_final_insert`; the DRAFT prefills its whole
+    prompt here in one pass (the draft is depth/4 x heads/2 by
+    construction — chunking it would buy a fraction of a fraction) and
+    splices alongside.
+
+    ``insert(params, dparams, cache, dcache, lane_k, lane_v, tok, temp,
+    keys, toks, n_rows, start, prompt [1, bucket], prompt_len, slot,
+    temp_val, seed) -> (cache', dcache', tok', temp', keys', first)``
+    """
+    from paddle_operator_tpu.infer.speculative import _multi_forward
+
+    def insert(params, dparams, cache, dcache, lane_k, lane_v, tok, temp,
+               keys, toks, n_rows, start, prompt, prompt_len, slot,
+               temp_val, seed):
+        stage = {"k": lane_k, "v": lane_v,
+                 "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
+        logits, new_lane = _multi_forward(cfg, params, toks, stage,
+                                          mesh=mesh)
+        logits = logits[0, n_rows - 1]
+        new_cache = _splice_lane(cache, new_lane, slot, prompt_len)
+        dlane = D.init_cache(dcfg, 1, bucket)
+        _, dlane = D._forward(dcfg, dparams, prompt, dlane,
+                              last_only=True, mesh=mesh)
+        new_dcache = _splice_lane(dcache, dlane, slot, prompt_len)
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache, new_dcache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(2, 3, 6, 7, 8))
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill: handoff programs + the prefill executor
+# ---------------------------------------------------------------------------
+
+
+def make_attach_lane():
+    """The decode ring's half of a disaggregated handoff: ONE tiny
+    compiled dispatch that activates lane ``slot`` — fill position,
+    carry token, temperature, sampling key — once the prefilled blocks
+    have been copied into the decode pool.  No forward runs here;
+    that is the point of disaggregation.
+
+    ``attach(pos, tok, temp, keys, slot, first, prompt_len, temp_val,
+    seed) -> (pos', tok', temp', keys')``
+    """
+
+    def attach(pos, tok, temp, keys, slot, first, prompt_len, temp_val,
+               seed):
+        return (pos.at[slot].set(prompt_len),
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(jax.random.PRNGKey(seed)))
+
+    return jax.jit(attach, donate_argnums=(0, 1, 2, 3))
+
+
+def make_spec_attach(cfg: LlamaConfig, dcfg: LlamaConfig, bucket: int,
+                     mesh=None):
+    """Disaggregated handoff for the SPECULATIVE ring: the target KV
+    arrived by block copy, but the DRAFT lane still needs its prompt
+    context to propose from — prefill it here (contiguous splice, the
+    draft never pages) together with the lane activation.
+
+    ``attach(dparams, dcache, pos, tok, temp, keys, prompt [1, bucket],
+    prompt_len, slot, first, temp_val, seed)
+    -> (dcache', pos', tok', temp', keys')``
+    """
+
+    def attach(dparams, dcache, pos, tok, temp, keys, prompt, prompt_len,
+               slot, first, temp_val, seed):
+        dlane = D.init_cache(dcfg, 1, bucket)
+        _, dlane = D._forward(dcfg, dparams, prompt, dlane,
+                              last_only=True, mesh=mesh)
+        new_dcache = _splice_lane(dcache, dlane, slot, prompt_len)
+        return (new_dcache,
+                pos.at[slot].set(prompt_len),
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(jax.random.PRNGKey(seed)))
+
+    return jax.jit(attach, donate_argnums=(1, 2, 3, 4, 5))
+
+
+def make_disagg_prefill(cfg: LlamaConfig, bucket: int, block_size: int,
+                        top_k: Optional[int] = None,
+                        top_p: Optional[float] = None, mesh=None):
+    """The prefill executor's whole-prompt program: prefill a
+    [1, bucket] prompt into the PREFILL pool's blocks (the same
+    ``decode.paged_prefill`` compiled ops as the inline paged insert —
+    what keeps the disagg first token bit-identical) and sample the
+    first token through the shared rule.  Unlike the ring inserts it
+    touches no ring state: the handoff copies blocks and attaches the
+    lane later, on the decode thread.
+
+    ``prefill(params, cache, table_row, prompt, prompt_len, temp_val,
+    seed) -> (cache', first_token)``
+    """
+
+    def prefill(params, cache, table_row, prompt, prompt_len, temp_val,
+                seed):
+        logits, new_cache = D.paged_prefill(params, cfg, prompt, cache,
+                                            table_row,
+                                            block_size=block_size,
+                                            mesh=mesh)
+        logits = logits[0, prompt_len - 1]
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return new_cache, first
+
+    # the pool is NOT donated, deliberately: each job's result rides the
+    # handoff queue as a snapshot of cache["k"]/["v"], and donating the
+    # cache on the NEXT job would delete exactly those buffers while the
+    # decode ring's transfer dispatch may still be reading them
+    return jax.jit(prefill)
+
+
+class PrefillExecutor:
+    """The disaggregated prefill engine: its OWN thread and its OWN
+    small block pool, so a cold 2k-token prefill never occupies the
+    decode ring's dispatch stream.  The decode scheduler submits
+    ``(request, slot)`` jobs; this thread prefills the whole prompt
+    into its private pool (one job at a time — prefill batches
+    independently of decode, which is the DistServe argument) and posts
+    ``(request, slot, k, v, n_blocks, first_token)`` results.  Because
+    jax arrays are immutable, the posted k/v SNAPSHOT stays valid while
+    the next job writes a fresh pool version — no block-release
+    protocol is needed and the pool is exactly one lane wide.
+
+    Fault isolation: a prefill dispatch failure posts ``(request, slot,
+    error)`` — the scheduler fails THAT request only; the decode ring
+    (and its watchdog/heal machinery) never sees the fault.  Drain and
+    close() flush the queue; jobs whose request resolved meanwhile
+    (cancel, deadline, heal) are dropped at either end."""
+
+    def __init__(self, params: Any, cfg: LlamaConfig, *, max_len: int,
+                 block_size: int, buckets: Tuple[int, ...],
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, mesh=None) -> None:
+        from paddle_operator_tpu.infer import paged as PG
+
+        self.params = params
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.mesh = mesh
+        alloc = D.cache_alloc_len(max_len)
+        self.max_blocks = -(-alloc // self.block_size)
+        # block 0 stays the trash block, same convention as the decode
+        # pool; the job's blocks are the FIXED identity row 1..M — one
+        # job at a time needs no allocator at all
+        self.cache = PG.init_paged_cache(cfg, 1, self.max_blocks + 1,
+                                         self.block_size, mesh=mesh)
+        self.table_row = jnp.arange(1, self.max_blocks + 1,
+                                    dtype=jnp.int32)
+        # the prefill engine's OWN bucket ladder, FINER than the ring's
+        # (block-multiple powers of two up to the ring's largest
+        # bucket): the decode ring keeps its compile set small because
+        # every admission insert is resident state it must carry, but
+        # prefill here is stateless-per-job, so it can afford shapes
+        # near the prompt length — a 300-token cold prompt runs a
+        # 512-row forward instead of the ring's padded 2048-row bucket.
+        # Phases shaping independently is the DistServe argument, and
+        # it is where the disagg TTFT win comes from in-process.
+        cap = max(buckets)
+        ladder = []
+        b = self.block_size
+        while b < cap:
+            ladder.append(b)
+            b *= 2
+        self.buckets = tuple(ladder) + (cap,)
+        self._progs = {b: make_disagg_prefill(cfg, b, self.block_size,
+                                              top_k, top_p, mesh=mesh)
+                       for b in self.buckets}
+        self.jobs: "queue.Queue[tuple]" = queue.Queue()
+        self.results: "queue.Queue[tuple]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="prefill-executor")
+        self._thread.start()
+
+    def submit(self, req, slot: int) -> None:
+        # queue depth is tracked scheduler-side (_disagg_waiting feeds
+        # the prefillQueueDepth gauge) — this thread keeps no counters
+        self.jobs.put((req, slot))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                req, slot = self.jobs.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                if req.done.is_set() or req._cancel:
+                    continue        # resolved while queued: drop
+                n = len(req.prompt)
+                pb = next(b for b in self.buckets if b >= n)
+                if pb <= req.dev_prompt.shape[1]:
+                    # re-bucket the already-shipped prompt: the ring
+                    # bucket is right-padded, so a narrower device
+                    # slice keeps every real token
+                    prompt = req.dev_prompt[:, :pb]
+                else:
+                    padded = np.zeros((1, pb), np.int32)
+                    padded[0, :n] = req.prompt
+                    prompt = jnp.asarray(padded)
+                prog = self._progs[pb]
+                self.cache, first = prog(
+                    self.params, self.cache, self.table_row,
+                    prompt, n, float(req.temperature), req.seed)
+                n_blocks = -(-len(req.prompt) // self.block_size)
+                try:
+                    first.copy_to_host_async()
+                except AttributeError:
+                    pass
+                # snapshot refs: immutable arrays — the next job's
+                # writes produce a NEW pool version, this one stays
+                # readable until the ring's copy dispatch consumes it
+                self.results.put((req, slot, self.cache["k"],
+                                  self.cache["v"], n_blocks, first))
+            except Exception as e:      # noqa: BLE001 — isolate per job
+                self.results.put((req, slot, e))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# RingExecutor: compiled programs + device state for one decode ring
+# ---------------------------------------------------------------------------
+
+
+class RingExecutor:
+    """Owns everything device-side about one continuous-batching ring:
+    the resident chunk/spec-round program, the per-bucket admission
+    inserts (inline, suffix, chunked, spec variants), the KV cache or
+    block pool, and the per-lane tok/temp/keys state.  The scheduler
+    (infer/scheduler.py ContinuousBatcher) holds NO jax arrays of its
+    own — it sequences dispatches on this object, which is what makes
+    the prefill/decode executor split (and the watchdog's full device
+    rebuild, :meth:`reset_state`) possible.
+    """
+
+    # a prefix hit with a LONGER divergent suffix admits through the
+    # cold scatter prefill instead: the suffix insert's per-row pool
+    # writes unroll O(rows) (paged._write_rows_paged), and past this
+    # many rows the block-granular cold path compiles and runs faster
+    # than what the cached prefix saves
+    SUFFIX_PREFILL_MAX_ROWS = 256
+
+    def __init__(self, params: Any, cfg: LlamaConfig, *, slots: int,
+                 max_len: int, chunk_tokens: int,
+                 prefill_buckets: Tuple[int, ...] = (),
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, mesh=None,
+                 draft_params: Any = None,
+                 draft_cfg: Optional[LlamaConfig] = None, spec_k: int = 0,
+                 paged: bool = False, block_size: int = 256,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefill_mode: str = "inline",
+                 prefill_chunk: int = 64,
+                 check_finite: bool = False) -> None:
+        self.mesh = mesh
+        if mesh is not None and D.mesh_tp(mesh) > 1:
+            params = D.shard_params_for_serving(params, cfg, mesh)
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len
+        self.chunk = chunk_tokens
+        self.check_finite = check_finite
+        self.prefill_mode = prefill_mode
+        self.buckets = tuple(sorted(prefill_buckets)) or _default_buckets(
+            max_len)
+        self.top_k, self.top_p = top_k, top_p
+        self.paged = bool(paged)
+        self.pool: Optional[Any] = None
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1 (got {prefill_chunk})")
+        if self.paged:
+            from paddle_operator_tpu.infer import paged as PG
+
+            self._pg = PG
+            self.block_size = int(block_size)
+            self._num_blocks = num_blocks
+            self.prefix_cache = prefix_cache and not spec_k
+            self.pool = PG.PagedCacheManager(
+                slots, max_len, self.block_size, num_blocks,
+                prefix_cache=self.prefix_cache)
+            # prefill buckets scatter whole blocks: round each up to a
+            # block multiple, capped at the lane view
+            self.buckets = tuple(sorted(
+                {min(-(-b // self.block_size) * self.block_size,
+                     self.pool.view_len) for b in self.buckets}))
+            self._copy_block = PG.make_block_copier()
+        else:
+            self.block_size = int(block_size)
+            self.prefix_cache = False
+        self._suffix_inserts: Dict[int, Any] = {}
+        # chunked-prefill compile caches: intermediate slice + final
+        # insert programs, keyed by staging length (contiguous) or just
+        # the fixed slice bucket (paged — writes are table-driven)
+        self._chunk_progs: Dict[Any, Any] = {}
+        self._final_inserts: Dict[Any, Any] = {}
+        self._attach = None
+        self._spec_attach: Dict[int, Any] = {}
+        self._transfer = None
+
+        self.spec_k = int(spec_k)
+        self.draft_cfg = draft_cfg
+        if self.spec_k > 0:
+            from paddle_operator_tpu.infer.speculative import (
+                check_draft_compat,
+                make_spec_round_fn,
+            )
+
+            if draft_params is None or draft_cfg is None:
+                raise ValueError("spec_k > 0 requires draft_params and "
+                                 "draft_cfg (see LlamaConfig.draft())")
+            check_draft_compat(cfg, draft_cfg)
+            if max_len > draft_cfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len ({draft_cfg.max_seq_len}) < ring "
+                    f"max_len ({max_len}); derive the draft with "
+                    "cfg.draft() to inherit the target's RoPE table")
+            if mesh is not None and D.mesh_tp(mesh) > 1:
+                draft_params = D.shard_params_for_serving(
+                    draft_params, draft_cfg, mesh)
+            self.draft_params = draft_params
+            self.spec_step = make_spec_round_fn(
+                cfg, draft_cfg, self.spec_k, top_k, top_p, mesh=mesh,
+                paged=self.paged)
+            self.step = None
+            if self.paged:
+                # target prefill scatters into the pool; the DRAFT lane
+                # stays a contiguous splice (speculative.py docstring)
+                self.inserts = {b: self._pg.make_paged_spec_prefill_insert(
+                    cfg, draft_cfg, b, self.block_size, top_k, top_p,
+                    mesh=mesh) for b in self.buckets}
+            else:
+                self.inserts = {b: make_spec_prefill_insert(
+                    cfg, draft_cfg, b, top_k, top_p, mesh=mesh)
+                    for b in self.buckets}
+        else:
+            self.draft_params = None
+            self.spec_step = None
+            if self.paged:
+                self.step = self._pg.make_paged_chunk_step(
+                    cfg, chunk_tokens, top_k, top_p, mesh=mesh,
+                    check_finite=check_finite)
+                self.inserts = {b: self._pg.make_paged_prefill_insert(
+                    cfg, b, self.block_size, top_k, top_p, mesh=mesh)
+                    for b in self.buckets}
+            else:
+                self.step = make_chunk_step(cfg, chunk_tokens, top_k,
+                                            top_p, mesh=mesh,
+                                            check_finite=check_finite)
+                self.inserts = {b: make_prefill_insert(cfg, b, top_k,
+                                                       top_p, mesh=mesh)
+                                for b in self.buckets}
+
+        # the disaggregated prefill engine (prefill_mode="disagg"):
+        # built here so its compile set and pool live with the rest of
+        # the device state; the scheduler drives its queues
+        self.prefill_exec: Optional[PrefillExecutor] = None
+        if prefill_mode == "disagg":
+            if not self.paged:
+                raise ValueError("prefill_mode='disagg' requires the "
+                                 "paged ring (block-granular handoff)")
+            self.prefill_exec = PrefillExecutor(
+                self.params, cfg, max_len=max_len,
+                block_size=self.block_size, buckets=self.buckets,
+                top_k=top_k, top_p=top_p, mesh=mesh)
+            self._transfer = self._pg.make_pool_transfer(self.pool.max_blocks)
+            self._attach = make_attach_lane()
+
+        self.reset_state()
+
+    # -- state lifecycle ---------------------------------------------------
+
+    def reset_state(self) -> None:
+        """(Re)build every piece of mutable device state from scratch —
+        construction AND the watchdog's self-heal both land here, so a
+        rebuilt ring can never carry poisoned state forward.  Compiled
+        programs are kept (they are pure)."""
+        if self.paged:
+            # ALWAYS a fresh allocator: the radix cache keys blocks of
+            # the about-to-be-replaced device arrays — carrying it over
+            # would map zeroed blocks as a "cached" prefix
+            self.pool = self._pg.PagedCacheManager(
+                self.slots, self.max_len, self.block_size,
+                self._num_blocks, prefix_cache=self.prefix_cache)
+            self.cache = self._pg.init_paged_cache(
+                self.cfg, self.slots, self.pool.total, self.block_size,
+                mesh=self.mesh)
+        else:
+            self.cache = init_ring_cache(self.cfg, self.slots,
+                                         self.max_len, mesh=self.mesh)
+        if self.spec_k:
+            self.dcache = init_ring_cache(self.draft_cfg, self.slots,
+                                          self.max_len, mesh=self.mesh)
+        else:
+            self.dcache = None
+        self.tok = jnp.zeros((self.slots,), jnp.int32)
+        self.temp = jnp.zeros((self.slots,), jnp.float32)
+        self.keys = jnp.zeros((self.slots, 2), jnp.uint32)
+
+    # -- lazily-compiled admission programs --------------------------------
+
+    def suffix_bucket(self, n: int) -> int:
+        """Compile bucket for a prefix-hit SUFFIX forward — sized
+        independently of the prompt buckets (whose smallest entry can
+        be prompt-sized: a 1-token suffix must not pay a 2048-row
+        forward).  Power-of-two ladder up to one block, then block
+        multiples; the compile set stays bounded by
+        log2(block_size) + SUFFIX_PREFILL_MAX_ROWS / block_size."""
+        cap = self.pool.view_len
+        b = 8
+        while b < min(n, self.block_size):
+            b *= 2
+        if b < n:
+            b = -(-n // self.block_size) * self.block_size
+        return min(b, cap)
+
+    def suffix_insert(self, sb: int):
+        ins = self._suffix_inserts.get(sb)
+        if ins is None:
+            ins = self._pg.make_paged_suffix_insert(
+                self.cfg, sb, self.block_size, self.top_k, self.top_p,
+                mesh=self.mesh)
+            self._suffix_inserts[sb] = ins
+        return ins
+
+    def chunk_prog(self, staging_len: Optional[int]):
+        """Intermediate chunked-prefill slice program: paged (keyed by
+        the fixed slice width) or contiguous (keyed by staging
+        length)."""
+        sb = self.prefill_chunk
+        key = ("paged", sb) if self.paged else ("ring", sb, staging_len)
+        prog = self._chunk_progs.get(key)
+        if prog is None:
+            if self.paged:
+                prog = self._pg.make_paged_prefill_chunk(
+                    self.cfg, sb, self.block_size, mesh=self.mesh)
+            else:
+                prog = make_prefill_chunk(self.cfg, sb, staging_len,
+                                          mesh=self.mesh)
+            self._chunk_progs[key] = prog
+        return prog
+
+    def final_insert(self, staging_len: Optional[int],
+                     bucket: Optional[int] = None):
+        """Final chunked-prefill slice program.  Paged rings reuse the
+        SUFFIX insert (a chunked prefill's last slice IS a suffix
+        insert whose 'hit' is the rows the earlier slices wrote) —
+        shared compile with the radix-hit path; spec rings get the
+        draft-prefilling variants."""
+        sb = self.prefill_chunk
+        if self.paged and not self.spec_k:
+            return self.suffix_insert(sb)
+        if self.paged:
+            key = ("paged-spec", sb, bucket)
+            prog = self._final_inserts.get(key)
+            if prog is None:
+                prog = self._pg.make_paged_spec_suffix_insert(
+                    self.cfg, self.draft_cfg, sb, bucket,
+                    self.block_size, self.top_k, self.top_p,
+                    mesh=self.mesh)
+                self._final_inserts[key] = prog
+            return prog
+        if self.spec_k:
+            key = ("ring-spec", sb, staging_len, bucket)
+            prog = self._final_inserts.get(key)
+            if prog is None:
+                prog = make_spec_chunked_final_insert(
+                    self.cfg, self.draft_cfg, sb, staging_len, bucket,
+                    self.top_k, self.top_p, mesh=self.mesh)
+                self._final_inserts[key] = prog
+            return prog
+        key = ("ring", sb, staging_len)
+        prog = self._final_inserts.get(key)
+        if prog is None:
+            prog = make_chunked_final_insert(
+                self.cfg, sb, staging_len, self.top_k, self.top_p,
+                mesh=self.mesh)
+            self._final_inserts[key] = prog
+        return prog
+
+    def spec_attach(self, bucket: int):
+        prog = self._spec_attach.get(bucket)
+        if prog is None:
+            prog = make_spec_attach(self.cfg, self.draft_cfg, bucket,
+                                    mesh=self.mesh)
+            self._spec_attach[bucket] = prog
+        return prog
+
+    def staging_len(self, bucket: int) -> int:
+        """Contiguous chunked prefill stages in a private lane cache
+        whose length is the bucket rounded up to whole slices, so every
+        full-width slice write stays in bounds (a clamped
+        dynamic_update_slice would silently shift pad rows over real
+        ones).  The splice truncates back to the ring allocation."""
+        sb = self.prefill_chunk
+        return -(-bucket // sb) * sb
+
+    def make_staging(self, bucket: int) -> Tuple[jax.Array, jax.Array]:
+        """Fresh zeroed staging K/V for one contiguous chunked prefill
+        ([L, 1, H_kv, staging_len(bucket), D], kv-head-sharded like the
+        ring cache so the slice programs compile against one layout)."""
+        sl = self.staging_len(bucket)
+        shape = (self.cfg.n_layers, 1, self.cfg.n_kv_heads, sl,
+                 self.cfg.head_dim)
+        return (D.alloc_kv_buffer(self.cfg, shape, self.mesh),
+                D.alloc_kv_buffer(self.cfg, shape, self.mesh))
+
+    # -- prewarm -----------------------------------------------------------
+
+    def prewarm(self) -> None:
+        """Compile the admission/step programs NOW, against throwaway
+        state of the real shapes/shardings, so the first long prompt of
+        a fresh server never pays a multi-second XLA compile on the
+        serving path (the jit dispatch cache keys on
+        shape/dtype/sharding — identical dummies make the real call a
+        cache hit).  Runs off-thread from the scheduler (opt-out:
+        prewarm=False / SERVE_PREWARM=0); jax dispatch is thread-safe,
+        and donated dummy buffers are garbage by design."""
+        slots = self.slots
+        if self.paged:
+            cache = self._pg.init_paged_cache(
+                self.cfg, slots, self.pool.total, self.block_size,
+                mesh=self.mesh)
+            tbl = jnp.zeros((slots, self.pool.max_blocks), jnp.int32)
+        else:
+            cache = init_ring_cache(self.cfg, slots, self.max_len,
+                                    mesh=self.mesh)
+            tbl = None
+        tok = jnp.zeros((slots,), jnp.int32)
+        temp = jnp.zeros((slots,), jnp.float32)
+        keys = jnp.zeros((slots, 2), jnp.uint32)
+        active = jnp.zeros((slots,), bool)
+        dcache = (init_ring_cache(self.draft_cfg, slots, self.max_len,
+                                  mesh=self.mesh) if self.spec_k else None)
+        # the resident step first: it is the program every lane shares
+        if self.spec_k:
+            args = (self.params, self.draft_params, cache, dcache)
+            if self.paged:
+                args += (tbl,)
+            out = self.spec_step(*args, tok, temp, keys, active)
+            cache, dcache, tok = out[0], out[1], out[2]
+        elif self.paged:
+            out = self.step(self.params, cache, tbl, tok, temp, keys,
+                            active)
+            cache, tok = out[0], out[1]
+        else:
+            out = self.step(self.params, cache, tok, temp, keys, active)
+            cache, tok = out[0], out[1]
+        for b in self.buckets:
+            prompt = jnp.zeros((1, b), jnp.int32)
+            if self.spec_k and self.paged:
+                row = jnp.zeros((self.pool.max_blocks,), jnp.int32)
+                cache, dcache, tok, temp, keys, _ = self.inserts[b](
+                    self.params, self.draft_params, cache, dcache, row,
+                    tok, temp, keys, prompt, 1, 0, 0.0, 0)
+            elif self.spec_k:
+                cache, dcache, tok, temp, keys, _ = self.inserts[b](
+                    self.params, self.draft_params, cache, dcache, tok,
+                    temp, keys, prompt, 1, 0, 0.0, 0)
+            elif self.paged:
+                row = jnp.zeros((self.pool.max_blocks,), jnp.int32)
+                cache, tok, temp, keys, _ = self.inserts[b](
+                    self.params, cache, row, tok, temp, keys, prompt,
+                    1, 0, 0.0, 0)
+            else:
+                cache, tok, temp, keys, _ = self.inserts[b](
+                    self.params, cache, tok, temp, keys, prompt, 1, 0,
+                    0.0, 0)
+        if self.paged and not self.spec_k:
+            # the SUFFIX-insert ladder: a radix prefix hit (even a
+            # partial-tail one on an otherwise cold prompt) admits
+            # through make_paged_suffix_insert, and its first use used
+            # to charge one request the compile — warm every bucket
+            # the ladder can produce, plus the CoW block copier the
+            # same admission path dispatches
+            row = jnp.zeros((self.pool.max_blocks,), jnp.int32)
+            cap = min(self.SUFFIX_PREFILL_MAX_ROWS, self.pool.view_len)
+            sbs, n = set(), 1
+            while n <= min(self.block_size, cap):   # power-of-2 rungs
+                sbs.add(self.suffix_bucket(n))
+                n *= 2
+            n = self.block_size                     # block-multiple rungs
+            while n <= cap:
+                sbs.add(self.suffix_bucket(n))
+                n += self.block_size
+            for sb in sorted(sbs):
+                toks = jnp.zeros((1, sb), jnp.int32)
+                cache, tok, temp, keys, _ = self.suffix_insert(sb)(
+                    self.params, cache, row, tok, temp, keys, toks,
+                    1, 0, 0, 0.0, 0)
+            k = jnp.zeros_like(cache["k"])
+            self._copy_block(k, jnp.zeros_like(cache["v"]), 0, 0)
+        if self.prefill_exec is not None:
+            # the disagg engine's whole-prompt programs compile on the
+            # PREFILL thread (they never stall decode), but the first
+            # cold prompt would still pay them in its TTFT — run each
+            # bucket against the executor's own pool (no donation, and
+            # pool content only matters mid-job, so racing a live job
+            # is safe); the handoff transfer + attach ride along
+            pe = self.prefill_exec
+            for b, prog in pe._progs.items():
+                prog(self.params, pe.cache, pe.table_row,
+                     jnp.zeros((1, b), jnp.int32), 1, 0.0, 0)
+            m = self.pool.max_blocks
+            ids = jnp.zeros((m,), jnp.int32)
+            self._transfer(jnp.zeros_like(cache["k"]),
+                           jnp.zeros_like(cache["v"]),
+                           pe.cache["k"], pe.cache["v"], ids, ids)
+        if self.prefill_mode == "chunked":
+            # the chunked path's first long prompt dispatches slice +
+            # final programs instead of the bucket inserts — warm those
+            # too, or the compile cliff just moves
+            sb = self.prefill_chunk
+            toks = jnp.zeros((1, sb), jnp.int32)
+            if self.paged:
+                row = jnp.zeros((self.pool.max_blocks,), jnp.int32)
+                cache = self.chunk_prog(None)(self.params, cache, row,
+                                              toks, 0, 0)
+                if self.spec_k:
+                    for b in self.buckets:
+                        prompt = jnp.zeros((1, b), jnp.int32)
+                        out = self.final_insert(None, b)(
+                            self.params, self.draft_params, cache,
+                            dcache, row, tok, temp, keys, toks, 1, 0, 0,
+                            prompt, 1, 0.0, 0)
+                        cache, dcache, tok, temp, keys = out[:5]
+                else:
+                    out = self.final_insert(None)(
+                        self.params, cache, row, tok, temp, keys, toks,
+                        1, 0, 0, 0.0, 0)
+                    cache, tok, temp, keys = out[:4]
+            else:
+                for b in self.buckets:
+                    sl = self.staging_len(b)
+                    lk, lv = self.make_staging(b)
+                    if sl > sb:
+                        lk, lv = self.chunk_prog(sl)(self.params, lk, lv,
+                                                     toks, 0)
+                    if self.spec_k:
+                        prompt = jnp.zeros((1, b), jnp.int32)
+                        out = self.final_insert(sl, b)(
+                            self.params, self.draft_params, cache,
+                            dcache, lk, lv, tok, temp, keys, toks, 1, 0,
+                            prompt, 1, 0, 0.0, 0)
+                        cache, dcache, tok, temp, keys = out[:5]
+                    else:
+                        out = self.final_insert(sl)(
+                            self.params, cache, lk, lv, tok, temp, keys,
+                            toks, 1, 0, 1, 0, 0.0, 0)
+                        cache, tok, temp, keys = out[:4]
+
+
+def _default_buckets(max_len: int) -> Tuple[int, ...]:
+    """2-3 prefill compile buckets, always ending at max_len so every
+    admissible prompt has a bucket."""
+    out: List[int] = []
+    b = 64
+    while b < max_len and len(out) < 2:
+        out.append(b)
+        b *= 8
+    out.append(max_len)
+    return tuple(out)

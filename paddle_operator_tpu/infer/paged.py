@@ -510,7 +510,7 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     per step would materialize it anyway), the kernel path hands the
     stacked pools + table to paged_decode_attention, the einsum path
     gathers the lane view per layer."""
-    from paddle_operator_tpu.infer.batcher import _qkv_ring
+    from paddle_operator_tpu.infer.executor import _qkv_ring
 
     pos = cache["pos"]
     block_size = cache["k"].shape[3]
@@ -604,7 +604,7 @@ def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     ``check_finite=True``: a fourth ``ok [B]`` output — the per-lane
     isfinite fold of every tick's logits (batcher NaN-lane quarantine;
     see make_chunk_step)."""
-    from paddle_operator_tpu.infer.batcher import _sample_tokens
+    from paddle_operator_tpu.infer.executor import _sample_tokens
 
     def step(params, cache, table, tok, temp, keys, active):
         def tick(carry, _):
@@ -639,19 +639,15 @@ def _scatter_prompt_blocks(pool: jax.Array, lane: jax.Array,
                            table_row: jax.Array,
                            block_size: int) -> jax.Array:
     """Write a contiguous [L, 1, H, bucket, D] prefilled lane cache
-    into the pool as block-aligned chunks at the lane's table entries.
-    Pad blocks past the real prompt scatter into whatever the table
-    maps there — the trash block for unmapped entries, a future decode
-    block otherwise, where every row is overwritten before it becomes
-    attendable (the contiguous ring's exactness-with-padding story,
-    block-granular)."""
-    bucket = lane.shape[3]
-    for j in range(bucket // block_size):
-        blk = jax.lax.slice_in_dim(lane, j * block_size,
-                                   (j + 1) * block_size, axis=3)
-        pool = jax.lax.dynamic_update_slice(
-            pool, blk, (0, table_row[j], 0, 0, 0))
-    return pool
+    into the pool as block-aligned chunks at the lane's table entries —
+    the block-granular prefill-write path, shared with the kernels'
+    module (ops/decode_attention.py scatter_prefill_blocks has the
+    whole-block-vs-per-row story)."""
+    from paddle_operator_tpu.ops.decode_attention import (
+        scatter_prefill_blocks,
+    )
+
+    return scatter_prefill_blocks(pool, lane, table_row, block_size)
 
 
 def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
@@ -668,7 +664,7 @@ def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
     prompt [1,bucket], prompt_len, slot, temp_val, seed)
     -> (cache', tok', temp', keys', first_token)``
     """
-    from paddle_operator_tpu.infer.batcher import _sample_tokens
+    from paddle_operator_tpu.infer.executor import _sample_tokens
 
     if bucket % block_size:
         raise ValueError(f"prefill bucket {bucket} not a multiple of the "
@@ -712,7 +708,7 @@ def make_paged_suffix_insert(cfg: LlamaConfig, suffix_bucket: int,
     suffix [1, suffix_bucket], suffix_len, hit_len, slot, temp_val,
     seed) -> (cache', tok', temp', keys', first_token)``
     """
-    from paddle_operator_tpu.infer.batcher import _sample_tokens
+    from paddle_operator_tpu.infer.executor import _sample_tokens
     from paddle_operator_tpu.infer.speculative import _multi_forward_paged
 
     def insert(params, cache, table_row, tok, temp, keys, suffix,
@@ -754,7 +750,7 @@ def make_paged_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
     keys, prompt, prompt_len, slot, temp_val, seed)
     -> (cache', dcache', tok', temp', keys', first_token)``
     """
-    from paddle_operator_tpu.infer.batcher import (
+    from paddle_operator_tpu.infer.executor import (
         _sample_tokens,
         _splice_lane,
     )
@@ -787,6 +783,109 @@ def make_paged_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
                 first)
 
     return jax.jit(insert, donate_argnums=(2, 3, 5, 6, 7))
+
+
+def make_paged_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
+                             block_size: int, mesh=None):
+    """One INTERMEDIATE chunked-prefill slice against the block pool
+    (executor/scheduler ``prefill_mode="chunked"``): append the slice's
+    KV rows at absolute positions [start, start + slice_bucket) through
+    the lane's table — no lm head, no lane-state update, no first
+    token; only the FINAL slice (which is exactly the SUFFIX insert
+    with ``hit_len = rows already written``) does those.  Rows at or
+    past ``limit`` route to the trash block, so a partial-tail radix
+    hit can start a chunked prefill mid-block safely.
+
+    ``chunk(params, cache, table_row [M], toks [1, slice_bucket],
+    start, limit) -> cache'``
+    """
+    from paddle_operator_tpu.infer.speculative import _multi_forward_paged
+
+    def chunk(params, cache, table_row, toks, start, limit):
+        lane_cache = {"k": cache["k"], "v": cache["v"],
+                      "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
+        _, new = _multi_forward_paged(
+            cfg, params, toks, lane_cache, table_row[None, :],
+            limit=jnp.reshape(limit, (1,)), mesh=mesh, head=False)
+        return {"k": new["k"], "v": new["v"], "pos": cache["pos"]}
+
+    return jax.jit(chunk, donate_argnums=(1,))
+
+
+def make_paged_spec_suffix_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
+                                  suffix_bucket: int, bucket: int,
+                                  block_size: int,
+                                  top_k: Optional[int] = None,
+                                  top_p: Optional[float] = None,
+                                  mesh=None):
+    """Final chunked-prefill slice for the SPECULATIVE paged ring: the
+    target's remaining suffix rows ride the block table exactly like
+    :func:`make_paged_suffix_insert`; the DRAFT prefills its whole
+    prompt in one pass (it is depth/4 x heads/2 by construction) and
+    splices contiguously, as everywhere else in spec mode.
+
+    ``insert(params, dparams, cache, dcache, table_row, tok, temp,
+    keys, suffix [1, suffix_bucket], suffix_len, hit_len, slot,
+    prompt [1, bucket], prompt_len, temp_val, seed)
+    -> (cache', dcache', tok', temp', keys', first_token)``
+    """
+    from paddle_operator_tpu.infer.executor import (
+        _sample_tokens,
+        _splice_lane,
+    )
+    from paddle_operator_tpu.infer.speculative import _multi_forward_paged
+
+    def insert(params, dparams, cache, dcache, table_row, tok, temp,
+               keys, suffix, suffix_len, hit_len, slot, prompt,
+               prompt_len, temp_val, seed):
+        lane_cache = {"k": cache["k"], "v": cache["v"],
+                      "pos": jnp.reshape(hit_len, (1,))}
+        logits, new_lane = _multi_forward_paged(
+            cfg, params, suffix, lane_cache, table_row[None, :],
+            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh)
+        logits = logits[0, suffix_len - 1]
+        new_cache = {"k": new_lane["k"], "v": new_lane["v"],
+                     "pos": cache["pos"].at[slot].set(prompt_len)}
+        dlane = D.init_cache(dcfg, 1, bucket)
+        _, dlane = D._forward(dcfg, dparams, prompt, dlane,
+                              last_only=True, mesh=mesh)
+        new_dcache = _splice_lane(dcache, dlane, slot, prompt_len)
+        key = jax.random.PRNGKey(seed)
+        first = _sample_tokens(
+            logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
+            key[None], jnp.reshape(prompt_len - 1, (1,)),
+            top_k, top_p)[0]
+        return (new_cache, new_dcache,
+                tok.at[slot].set(first),
+                temp.at[slot].set(temp_val),
+                keys.at[slot].set(key),
+                first)
+
+    return jax.jit(insert, donate_argnums=(2, 3, 5, 6, 7))
+
+
+@functools.lru_cache(maxsize=8)
+def make_pool_transfer(max_blocks: int):
+    """The disaggregated HANDOFF op: copy ``max_blocks`` pool blocks
+    from the prefill executor's (small, private) pool into the decode
+    pool — all layers, K and V, one donated jit.  Block-id vectors are
+    PADDED to ``max_blocks`` with the trash block so one compile serves
+    every prompt length (writing garbage into the trash block is its
+    job; gathering src block 0 reads the executor pool's own trash).
+    This is the in-process device-to-device stand-in for DistServe's
+    KV transfer; a DCN-crossing variant would replace only this op.
+
+    ``transfer(dst_k, dst_v, src_k, src_v, src_ids [M], dst_ids [M])
+    -> (dst_k', dst_v')``
+    """
+
+    def transfer(dst_k, dst_v, src_k, src_v, src_ids, dst_ids):
+        gk = jnp.take(src_k, src_ids, axis=1)     # [L, M, H, bs, D]
+        gv = jnp.take(src_v, src_ids, axis=1)
+        return (dst_k.at[:, dst_ids].set(gk),
+                dst_v.at[:, dst_ids].set(gv))
+
+    return jax.jit(transfer, donate_argnums=(0, 1))
 
 
 @functools.lru_cache(maxsize=4)

@@ -1,0 +1,1502 @@
+"""Host half of the serving ring: the continuous-batching scheduler.
+
+ISSUE 6 split ``infer/batcher.py`` into this scheduler (admission,
+queues, deadlines, request lifecycle, resilience hooks — pure host
+code; the only jax it touches is sequencing dispatches on its
+executor) and ``infer/executor.py`` (compiled programs + device state).
+:class:`ContinuousBatcher` keeps its name, constructor surface and
+behavior — ``infer/batcher.py`` re-exports it — and gains the prefill
+modes the split exists for:
+
+- ``prefill_mode="inline"``: admission prefills the whole prompt in one
+  compiled dispatch on the ring thread (the original behavior — one
+  cold 2k prompt stalls every resident decode lane for a full prefill).
+- ``prefill_mode="chunked"``: prefill runs in ``prefill_chunk``-token
+  slices, at most ONE slice per ring iteration interleaved with the
+  decode chunk — resident lanes never wait more than one slice
+  (Sarathi-Serve).  Works on the contiguous and the paged ring.
+- ``prefill_mode="disagg"``: cold prompts prefill on a separate
+  :class:`~paddle_operator_tpu.infer.executor.PrefillExecutor` thread
+  into its own block pool; the ring's only admission work is a
+  device-to-device block copy + a tiny attach dispatch (DistServe,
+  in-process).  Requires the paged ring; radix prefix HITS still admit
+  through the suffix insert on the ring thread, so only uncached
+  suffix tokens are ever prefilled anywhere.
+
+All three modes are greedy-bit-identical to the inline ring and compose
+with spec decode, paged KV, deadlines, drain, and the watchdog rebuild
+(tests/test_prefill_modes.py; dryrun ``serve-disagg``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from paddle_operator_tpu.infer import executor as X
+from paddle_operator_tpu.infer.resilience import (
+    DispatchWatchdog,
+    LaneQuarantined,
+    RestartBudget,
+    RetriableError,
+    RingResilience,
+    ShuttingDown,
+)
+from paddle_operator_tpu.models.llama import LlamaConfig
+
+PREFILL_MODES = ("inline", "chunked", "disagg")
+
+
+def _fold_seed(seed: int) -> int:
+    """Fold an out-of-int32-range seed to [0, 2**31) via the splitmix64
+    finalizer (a bijection on 64-bit ints before the final fold) —
+    distinct wide seeds stay distinct with overwhelming probability,
+    unlike the ``& 0x7FFFFFFF`` mask that mapped s and s + 2**31 to the
+    same sampling stream."""
+    x = seed & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+    x ^= x >> 31
+    return x & 0x7FFFFFFF
+
+
+class QueueFull(RuntimeError):
+    """submit() backpressure signal: the bounded request queue stayed
+    full past the put timeout.  A RuntimeError subclass so serve.py's
+    generic 503 mapping already handles it (retry/fail-over, not a
+    client error) while callers that care can catch it specifically."""
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
+                 "done", "out", "error", "_stream", "_cancel",
+                 "dev_prompt", "bucket", "accepted", "drafted",
+                 "deadline", "deadline_exceeded")
+
+    def __init__(self, prompt, max_new, temperature, seed, eos,
+                 wants_stream=False, deadline=None):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temperature = temperature
+        self.seed = seed
+        self.eos = eos
+        self.done = threading.Event()
+        self.out: Optional[List[int]] = None
+        self.error: Optional[Exception] = None
+        self._cancel = False
+        # absolute time.monotonic() deadline (or None): the ring retires
+        # the lane when it passes — the request RESOLVES with the tokens
+        # produced so far and this flag set (the 504-style partial), so
+        # a slow client can never pin a lane / its paged blocks
+        self.deadline: Optional[float] = deadline
+        self.deadline_exceeded = False
+        # speculative-decoding telemetry (spec_k > 0 rings): drafts
+        # offered / accepted for THIS request — serve.py surfaces the
+        # rate per response
+        self.accepted = 0
+        self.drafted = 0
+        # padded prompt, transferred to device on the SUBMIT thread
+        # (batcher.submit): on relayed chips a host->device copy costs a
+        # full round-trip, and paying it on the decode-ring thread
+        # stalls every lane; caller threads pay it concurrently instead
+        self.dev_prompt: Optional[Any] = None
+        self.bucket: int = 0
+        # token streaming is opt-in (submit(stream=True)): the dominant
+        # result()-only path must not pay per-token queue puts inside
+        # the decode-ring thread that gates every lane's throughput
+        self._stream: Optional["queue.Queue"] = (
+            queue.Queue() if wants_stream else None)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error is not None:
+            raise self.error
+        return self.out
+
+    @property
+    def accept_rate(self) -> Optional[float]:
+        """Speculative acceptance rate for this request (accepted
+        drafts / offered drafts), or None when the ring is not
+        speculative (or no round has consumed yet)."""
+        if not self.drafted:
+            return None
+        return round(self.accepted / self.drafted, 4)
+
+    def cancel(self) -> None:
+        """Stop decoding this request: the ring evicts its lane at the
+        next chunk boundary (or drops it from the queue if not yet
+        admitted) and ``result()`` returns the tokens produced so far.
+        A disconnect-abandoned long stream must not keep occupying a
+        decode lane to its full token budget."""
+        self._cancel = True
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield generated tokens as the ring emits them (one int at a
+        time, arriving in chunk-sized bursts).  Raises the request's
+        error at the point of failure; `timeout` bounds the wait for
+        EACH burst, not the whole generation."""
+        if self._stream is None:
+            raise RuntimeError("request was not submitted with "
+                               "stream=True")
+        while True:
+            try:
+                item = self._stream.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError("no tokens within timeout") from None
+            if item is None:
+                if self.error is not None:
+                    raise self.error
+                return
+            yield item
+
+
+class _PrefillState:
+    """Host bookkeeping for one mid-flight CHUNKED prefill: the slice
+    frontier plus (contiguous only) the staging K/V the slices append
+    into."""
+
+    __slots__ = ("req", "start", "hit_len", "seq", "lane_k", "lane_v")
+
+    def __init__(self, req, start, hit_len, seq, lane_k=None, lane_v=None):
+        self.req = req
+        self.start = start          # next absolute row to prefill
+        self.hit_len = hit_len      # radix-hit rows (paged; 0 otherwise)
+        self.seq = seq              # admission order — oldest advances
+        self.lane_k = lane_k
+        self.lane_v = lane_v
+
+
+class ContinuousBatcher:
+    """Slot scheduler over the resident chunk step.
+
+    ``submit()`` is thread-safe and returns a handle whose ``result()``
+    blocks until the sequence finishes; the decode loop runs on a
+    background thread, admitting queued requests into free lanes at
+    chunk boundaries (bucketed prefill) and evicting lanes on eos /
+    budget.  ``stats`` counts admissions, evictions, decoded chunks and
+    the high-water mark of concurrently active lanes — the numbers the
+    slot-reuse tests pin.
+
+    Device state and compiled programs live on the
+    :class:`~paddle_operator_tpu.infer.executor.RingExecutor`
+    (``self.executor``); this object only sequences dispatches on it.
+    The legacy attribute surface (``cache``/``pool``/``_step``/...)
+    forwards there so tests and the chaos injector keep working.
+
+    ``paged=True`` (infer/paged.py) swaps the per-lane contiguous KV
+    region for a global block pool + per-lane block tables with a radix
+    prefix cache; greedy token streams stay BIT-IDENTICAL to the
+    contiguous ring (``paged=False`` is both the fallback and the
+    parity oracle).  ``prefill_mode``/``prefill_chunk`` select how
+    admission prefill reaches the device (module docstring);
+    ``prewarm`` compiles the admission/step programs off-thread at
+    construction so the first long prompt pays no compile cliff
+    (SERVE_PREWARM=0 opts out).
+    """
+
+    SUFFIX_PREFILL_MAX_ROWS = X.RingExecutor.SUFFIX_PREFILL_MAX_ROWS
+
+    def __init__(self, params: Any, cfg: LlamaConfig, *, slots: int = 8,
+                 max_len: Optional[int] = None, chunk_tokens: int = 8,
+                 prefill_buckets=(), top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 pipeline_depth: int = 2, mesh=None,
+                 draft_params: Any = None,
+                 draft_cfg: Optional[LlamaConfig] = None,
+                 spec_k: int = 0,
+                 max_queue: int = 0,
+                 queue_timeout: float = 5.0,
+                 paged: bool = False,
+                 block_size: int = 256,
+                 num_blocks: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefill_mode: str = "inline",
+                 prefill_chunk: int = 64,
+                 prewarm: bool = False,
+                 resilience: Optional[RingResilience] = None) -> None:
+        if prefill_mode not in PREFILL_MODES:
+            raise ValueError(f"prefill_mode {prefill_mode!r} not in "
+                             f"{PREFILL_MODES}")
+        if prefill_mode == "disagg":
+            # the disaggregated handoff is block-granular by design —
+            # the paged pool IS the transfer unit
+            paged = True
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len or cfg.max_seq_len
+        self.chunk = chunk_tokens
+        self.prefill_mode = prefill_mode
+        # fault tolerance (infer/resilience.py): with a RingResilience a
+        # ring-level dispatch fault fails the RESIDENT requests with a
+        # retriable 503 and rebuilds the ring from scratch (fresh
+        # cache/pool; queued work re-admitted) behind exponential
+        # backoff, until the restart budget flips ``healthy`` — without
+        # one the batcher keeps its legacy die-on-first-error behavior.
+        self.resilience = resilience
+        self._budget = (RestartBudget(resilience)
+                        if resilience is not None else None)
+        self._check_finite = bool(resilience and resilience.nan_check)
+        if self._check_finite and spec_k:
+            raise ValueError("nan_check is not supported on speculative "
+                             "rings (the spec round has no per-lane "
+                             "finite fold); disable one of them")
+        self.healthy = True
+        self._draining = False
+        self._rebuilding = False
+        # ring-level fault observed (by the loop thread or the watchdog
+        # monitor) and not yet healed; the loop rebuilds at the next top
+        self._fault: Optional[Exception] = None
+        self._watchdog: Optional[DispatchWatchdog] = None
+        if resilience is not None and resilience.watchdog:
+            self._watchdog = DispatchWatchdog(
+                resilience, self._on_stall, self._on_hard_stall)
+        # max dispatched-but-unconsumed chunks; the oldest is consumed
+        # once `depth` are in flight, so depth 2 = one chunk always
+        # decoding while the host consumes the previous one (depth 1
+        # disables the overlap entirely).  Deeper than 2 delays the
+        # eviction bookkeeping by depth-1 chunks, so freed lanes sit
+        # idle before re-admission — lane turnover costs more than the
+        # extra hidden round-trip saves (measured).
+        self.pipeline_depth = max(1, pipeline_depth)
+
+        # the device half: compiled programs + cache/pool/lane state
+        self.executor = X.RingExecutor(
+            params, cfg, slots=slots, max_len=self.max_len,
+            chunk_tokens=chunk_tokens, prefill_buckets=prefill_buckets,
+            top_k=top_k, top_p=top_p, mesh=mesh,
+            draft_params=draft_params, draft_cfg=draft_cfg,
+            spec_k=spec_k, paged=paged, block_size=block_size,
+            num_blocks=num_blocks, prefix_cache=prefix_cache,
+            prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
+            check_finite=self._check_finite)
+        self.mesh = mesh
+        self.paged = self.executor.paged
+        self.spec_k = self.executor.spec_k
+        self.draft_cfg = self.executor.draft_cfg
+        self._top_k, self._top_p = top_k, top_p
+
+        self.lane: List[Optional[_Request]] = [None] * slots
+        self._lane_out: List[List[int]] = [[] for _ in range(slots)]
+        self._lane_left = [0] * slots
+        # host mirror of each lane's device fill position — set by
+        # admission, advanced at consume, ZEROED on eviction so
+        # serving_status never reports a retired lane's stale pos (and,
+        # paged, so on-demand block mapping tracks the true frontier)
+        self._lane_pos = [0] * slots
+        # per-lane device future of the admission-sampled first token,
+        # materialized at the next chunk consume (async admission)
+        self._lane_first: List[Optional[Any]] = [None] * slots
+        # prefill-in-flight bookkeeping: lanes reserved but not yet
+        # decode-active — chunked slices mid-flight, or a disagg prompt
+        # away on the prefill executor (slot -> _PrefillState / request)
+        self._prefilling: Dict[int, _PrefillState] = {}
+        self._disagg_waiting: Dict[int, _Request] = {}
+        self._admit_seq = 0
+
+        # bounded admission queue (max_queue > 0): submit() blocks up to
+        # queue_timeout for a slot, then REJECTS (QueueFull) — saturation
+        # degrades into backpressure instead of unbounded request RAM
+        self.max_queue = int(max_queue)
+        self._queue_timeout = queue_timeout
+        self._pending: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=self.max_queue)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self.stats = {"admitted": 0, "evicted": 0, "chunks": 0,
+                      "max_active": 0, "rejected_queue_full": 0,
+                      "spec_accepted": 0, "spec_drafted": 0,
+                      # prefill accounting: the prefix-cache acceptance
+                      # gate — a full prefix hit admits with ZERO
+                      # prefill forward passes over cached blocks.
+                      # chunked_prefill_tokens counts the share that
+                      # arrived in interleaved slices; disagg_prefills
+                      # the prompts prefilled off the ring thread.
+                      "prefill_calls": 0, "prefill_tokens": 0,
+                      "chunked_prefill_tokens": 0, "disagg_prefills": 0,
+                      "cow_copies": 0,
+                      # fault-tolerance accounting (infer/resilience.py):
+                      # deadline partials delivered, self-healing ring
+                      # rebuilds, and NaN-quarantined lanes — surfaced
+                      # through serving_status -> tpujob_serve_* gauges
+                      "deadline_exceeded": 0, "watchdog_restarts": 0,
+                      "quarantined_lanes": 0}
+        # served-token telemetry for serving_status(): cumulative emitted
+        # tokens since construction (the /metrics tokens-per-sec gauge)
+        self._tokens_emitted = 0
+        self._t_start = time.monotonic()
+        # off-thread compile prewarm (opt-in param; serve.py flips it on
+        # unless SERVE_PREWARM=0): without it the per-bucket insert (and
+        # the chunked slice programs) compile lazily on the FIRST prompt
+        # that needs them, charging one unlucky request a full XLA
+        # compile — tens of seconds for a big model.
+        self.prewarmed = threading.Event()
+        if prewarm:
+            threading.Thread(target=self._prewarm, daemon=True,
+                             name="prefill-prewarm").start()
+        else:
+            self.prewarmed.set()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="decode-ring")
+        self._thread.start()
+
+    # -- executor state forwarding (legacy surface: tests + chaos) ---------
+
+    @property
+    def params(self):
+        return self.executor.params
+
+    @property
+    def draft_params(self):
+        return self.executor.draft_params
+
+    @property
+    def buckets(self):
+        return self.executor.buckets
+
+    @property
+    def block_size(self):
+        return self.executor.block_size
+
+    @property
+    def cache(self):
+        return self.executor.cache
+
+    @cache.setter
+    def cache(self, v):
+        self.executor.cache = v
+
+    @property
+    def dcache(self):
+        return self.executor.dcache
+
+    @dcache.setter
+    def dcache(self, v):
+        self.executor.dcache = v
+
+    @property
+    def tok(self):
+        return self.executor.tok
+
+    @tok.setter
+    def tok(self, v):
+        self.executor.tok = v
+
+    @property
+    def temp(self):
+        return self.executor.temp
+
+    @temp.setter
+    def temp(self, v):
+        self.executor.temp = v
+
+    @property
+    def keys(self):
+        return self.executor.keys
+
+    @keys.setter
+    def keys(self, v):
+        self.executor.keys = v
+
+    @property
+    def pool(self):
+        return self.executor.pool
+
+    @property
+    def _step(self):
+        return self.executor.step
+
+    @_step.setter
+    def _step(self, fn):
+        self.executor.step = fn
+
+    @property
+    def _spec_step(self):
+        return self.executor.spec_step
+
+    @_spec_step.setter
+    def _spec_step(self, fn):
+        self.executor.spec_step = fn
+
+    @property
+    def _inserts(self):
+        return self.executor.inserts
+
+    @property
+    def _suffix_inserts(self):
+        return self.executor._suffix_inserts
+
+    def _prewarm(self) -> None:
+        try:
+            self.executor.prewarm()
+        except Exception:
+            # a prewarm failure must never take the server down — the
+            # lazily-compiling fallback path still works
+            pass
+        finally:
+            self.prewarmed.set()
+
+    # -- public ------------------------------------------------------------
+
+    def submit(self, prompt, *, max_new_tokens: int,
+               temperature: float = 0.0, seed: int = 0,
+               eos_token: Optional[int] = None,
+               stream: bool = False,
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> _Request:
+        """Queue one generation request; returns a handle whose
+        ``result()``/``stream()`` deliver the tokens.
+
+        ``deadline_s`` (serve.py: the ``X-Request-Deadline`` header):
+        relative budget in seconds for the WHOLE generation.  When it
+        expires the ring retires the lane at the next chunk boundary —
+        its paged blocks freed, the request resolving with the tokens
+        produced so far and ``handle.deadline_exceeded`` set (the
+        504-style partial) — so one slow/greedy client can never pin a
+        lane indefinitely.  Requests still queued at expiry resolve
+        prompt-only with the same flag.
+
+        ``request_id`` (optional, e.g. serve.py's per-row id) is woven
+        into every validation error so an operator reading a rejection
+        in a multi-request log knows WHICH request overflowed —
+        validation runs (and raises) BEFORE the host-side tokenize copy
+        and device transfer below, so a rejected request costs no
+        bandwidth.
+
+        ``seed``: sampling seed with an effective range of [0, 2**31) —
+        it rides into the compiled insert as an int32 traced argument.
+        In-range seeds are used as-is (streams are stable across
+        versions for the common case); anything outside (negative or
+        >= 2**31 — clients send arbitrary 64-bit ints, serve.py even
+        derives seed+i per row) is folded through a splitmix64 hash
+        rather than truncated, so distinct wide seeds keep distinct
+        streams (masking would collide s with s + 2**31)."""
+        rid = f" [request {request_id}]" if request_id is not None else ""
+        n = len(prompt)
+        if not n:
+            raise ValueError(f"empty prompt{rid}")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1{rid}")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0{rid}")
+        if self._draining:
+            raise ShuttingDown("server draining; retry another replica")
+        if self._stop.is_set() or not self._thread.is_alive():
+            raise ShuttingDown("batcher closed")
+        if n > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {n} exceeds the largest prefill "
+                f"bucket ({self.buckets[-1]}){rid}")
+        if self.spec_k:
+            # a verify round starting at the last in-budget position
+            # (prompt + max_new - 2) writes rows through pos + spec_k,
+            # so spec_k - 1 positions of headroom must exist past
+            # prompt + max_new (infer/speculative.py has the derivation)
+            if n + max_new_tokens + self.spec_k - 1 > self.max_len:
+                raise ValueError(
+                    f"prompt ({n}) + max_new_tokens "
+                    f"({max_new_tokens}) + speculative headroom "
+                    f"({self.spec_k - 1}) exceeds max_len "
+                    f"({self.max_len}){rid}")
+        else:
+            # the FIRST token is sampled from the prefill logits, so only
+            # max_new-1 tokens ride chunk steps; the worst-case cache
+            # position is prompt + ceil((max_new-1)/chunk)*chunk
+            # (validating with ceil(max_new/chunk) rejected requests up
+            # to chunk-1 tokens INSIDE capacity)
+            budget = -(-(max_new_tokens - 1) // self.chunk) * self.chunk
+            if n + budget > self.max_len:
+                raise ValueError(
+                    f"prompt ({n}) + chunk-rounded budget "
+                    f"({budget}) exceeds max_len ({self.max_len}){rid}")
+        # validation passed: NOW pay the tokenize copy
+        prompt = list(map(int, prompt))
+        # int32-range seeds pass through untouched; wide/negative seeds
+        # hash-fold (see docstring)
+        seed = int(seed)
+        if not 0 <= seed < 0x80000000:
+            seed = _fold_seed(seed)
+        if self.max_queue and self._pending.full():
+            # shed BEFORE the host->device prompt transfer below: the
+            # rejection path is the overload path, and a full round-trip
+            # device copy per shed request (relayed chips) would spend
+            # exactly the bandwidth backpressure exists to protect.
+            # Non-authoritative (racy) — the timed put below enforces
+            # the bound; this only waits for space to appear first.
+            deadline = time.monotonic() + self._queue_timeout
+            while self._pending.full():
+                if self._stop.is_set() or self._draining:
+                    raise ShuttingDown("batcher shutting down")
+                if time.monotonic() >= deadline:
+                    self.stats["rejected_queue_full"] += 1
+                    raise QueueFull(
+                        f"request queue full (max_queue={self.max_queue},"
+                        f" waited {self._queue_timeout}s)")
+                time.sleep(0.005)
+        req = _Request(prompt, max_new_tokens, temperature, seed,
+                       eos_token, wants_stream=stream,
+                       deadline=(time.monotonic() + deadline_s
+                                 if deadline_s is not None else None))
+        # pad + ship the prompt to the device HERE, on the caller's
+        # thread — see _Request.dev_prompt
+        req.bucket = self._bucket_for(len(prompt))
+        padded = np.zeros((1, req.bucket), np.int32)
+        padded[0, :len(prompt)] = prompt
+        req.dev_prompt = jnp.asarray(padded)
+        # bounded queue: poll briefly for a slot (smooths bursts) then
+        # reject — the caller's thread, not the decode ring, pays the
+        # wait.  Short put ticks so close()/drain() interrupt a BLOCKED
+        # submitter with ShuttingDown immediately instead of leaving it
+        # hanging out the full queue timeout against a dead ring.
+        deadline = time.monotonic() + self._queue_timeout
+        while True:
+            if self._stop.is_set() or self._draining:
+                raise ShuttingDown("batcher shutting down")
+            try:
+                self._pending.put(req, timeout=0.05)
+                break
+            except queue.Full:
+                if time.monotonic() >= deadline:
+                    self.stats["rejected_queue_full"] += 1
+                    raise QueueFull(
+                        f"request queue full (max_queue={self.max_queue},"
+                        f" waited {self._queue_timeout}s)") from None
+        if self._stop.is_set() and not req.done.is_set():
+            # loop died between the liveness check above and the put:
+            # fail the request instead of letting result() hang
+            self._finish(req, ShuttingDown("batcher closed"))
+            return req
+        self._wake.set()
+        return req
+
+    def prefill_queue_depth(self) -> int:
+        """Requests admitted to a lane but still PREFILLING: chunked
+        slices mid-flight plus disagg jobs queued/running on the
+        prefill executor or awaiting handoff — the
+        ``tpujob_serve_prefill_queue_depth`` gauge."""
+        depth = len(self._prefilling) + len(self._disagg_waiting)
+        return depth
+
+    def serving_status(self) -> Dict[str, Any]:
+        """The ``TPUJob.status.serving`` block (camelCase, like
+        GoodputTracker.to_status): cumulative served-token throughput,
+        speculative acceptance rate, and current queue depth — what the
+        manager exports as ``tpujob_serve_*`` gauges on /metrics
+        (utils/observability.py serving_gauges)."""
+        elapsed = max(1e-9, time.monotonic() - self._t_start)
+        drafted = self.stats["spec_drafted"]
+        pf_tok = self.stats["prefill_tokens"]
+        # per-lane visibility EXCLUDES retired lanes: _evict zeroes the
+        # host pos mirror (and the compiled step zeroes the device pos),
+        # so a freed lane can never leak its last request's fill
+        # position or tokens into the telemetry (test_serve_metrics)
+        return {
+            "tokensPerSec": round(self._tokens_emitted / elapsed, 2),
+            "acceptRate": (round(self.stats["spec_accepted"] / drafted, 4)
+                           if drafted else 0.0),
+            "queueDepth": self._pending.qsize(),
+            "tokensTotal": self._tokens_emitted,
+            "activeLanes": sum(r is not None for r in self.lane),
+            "lanePos": [int(p) for p in self._lane_pos],
+            "prefixHitRate": (self.pool.hit_rate() if self.pool is not None
+                              else 0.0),
+            "kvBlocksFree": (self.pool.blocks_free()
+                             if self.pool is not None else 0),
+            "kvBlocksHwm": (self.pool.stats["blocks_hwm"]
+                            if self.pool is not None else 0),
+            # prefill-path visibility (ISSUE 6): which admission path
+            # this ring runs, how many admitted requests are still
+            # prefilling, and the share of prefill tokens that arrived
+            # in interleaved chunked slices
+            "prefillMode": self.prefill_mode,
+            "prefillQueueDepth": self.prefill_queue_depth(),
+            "chunkedPrefillTokenShare": (
+                round(self.stats["chunked_prefill_tokens"] / pf_tok, 4)
+                if pf_tok else 0.0),
+            # fault tolerance (infer/resilience.py): drain/rebuild
+            # visibility for /readyz and the CRD's status.serving block
+            "draining": self._draining,
+            "healthy": self.healthy,
+            "deadlineExceeded": self.stats["deadline_exceeded"],
+            "watchdogRestarts": self.stats["watchdog_restarts"],
+            "quarantinedLanes": self.stats["quarantined_lanes"],
+        }
+
+    @property
+    def accepting(self) -> bool:
+        """Readiness (/readyz): the ring takes new admissions — not
+        draining, not mid-rebuild, loop alive, budget unspent."""
+        return (self.healthy and not self._draining
+                and not self._rebuilding and not self._stop.is_set()
+                and self._thread.is_alive())
+
+    def drain(self, budget_s: float = 30.0) -> None:
+        """SIGTERM drain (the serving half of docs/fault-tolerance.md):
+        stop admissions — queued and newly submitted requests fail with
+        :class:`ShuttingDown` (503 + Retry-After upstream) — let the
+        RESIDENT lanes finish within ``budget_s`` (lanes still
+        PREFILLING — chunked slices or a disagg handoff — finish their
+        prefill and their decode like any resident), cancel stragglers
+        at the budget (their callers receive the tokens produced so
+        far; paged blocks verifiably return to the pool), then close."""
+        self._draining = True
+        self._wake.set()
+        deadline = time.monotonic() + budget_s
+        while time.monotonic() < deadline and self._thread.is_alive():
+            if all(r is None for r in self.lane) and self._pending.empty():
+                break
+            time.sleep(0.02)
+        for req in list(self.lane):
+            if req is not None:
+                req.cancel()            # partial flush at chunk boundary
+        grace = time.monotonic() + max(5.0, budget_s)
+        while (any(r is not None for r in self.lane)
+               and self._thread.is_alive()
+               and time.monotonic() < grace):
+            time.sleep(0.02)
+        self.close()
+
+    def abort(self, error: Optional[Exception] = None) -> None:
+        """Second-SIGTERM semantics: immediate teardown.  Resident
+        requests RESOLVE with their partial tokens (best-effort flush —
+        an undrained kill would have lost them entirely); queued ones
+        fail with ShuttingDown."""
+        self._draining = True
+        self._stop.set()
+        self._wake.set()
+        for i, req in enumerate(self.lane):
+            if req is not None and not req.done.is_set():
+                req.out = req.prompt + self._lane_out[i]
+                self._finish(req)
+        self._shed_queue(error or ShuttingDown("server killed"))
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=30)
+        if self._watchdog is not None:
+            self._watchdog.close()
+        if self.executor.prefill_exec is not None:
+            self.executor.prefill_exec.close()
+        # late blocked submitters can land requests after the loop's own
+        # drain pass — sweep again so none hangs at result()
+        self._shed_queue(ShuttingDown("batcher closed"))
+
+    # -- fault handling ----------------------------------------------------
+
+    def _shed_queue(self, error: Exception) -> None:
+        while True:
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            self._finish(req, error)
+
+    def _on_stall(self, elapsed: float) -> None:
+        """Watchdog monitor callback: a dispatch/consume wait crossed
+        N x rolling-p95.  Fail the resident requests NOW — their
+        clients get retriable 503s while the ring thread is still stuck
+        inside the wedged dispatch — and flag the rebuild the loop runs
+        once it unwedges."""
+        err = RetriableError(
+            f"compiled dispatch stalled {elapsed:.1f}s (watchdog "
+            f"threshold {self._watchdog.threshold():.1f}s); ring "
+            "rebuilding — retry")
+        for req in list(self.lane):
+            if req is not None and not req.done.is_set():
+                self._finish(req, err)
+        self._fault = err
+
+    def _on_hard_stall(self, elapsed: float) -> None:
+        """The stall outlived hard_stall_factor x threshold: the host
+        thread is unrecoverably stuck inside the runtime.  Flip
+        /healthz so the orchestrator replaces the pod (crash-only)."""
+        self.healthy = False
+
+    def _heal(self, err: Exception) -> bool:
+        """Self-heal after a ring-level fault: fail whatever is still
+        resident with a retriable error, rebuild every piece of device
+        state from scratch (cache, paged pool + radix cache, lane
+        state — RingExecutor.reset_state), back off exponentially.
+        Requests mid-prefill (chunked or away on the prefill executor)
+        fail with the residents; a disagg result for a healed-away
+        request is dropped at handoff.  Returns False — and flips
+        ``healthy`` — when the restart budget is exhausted (the loop
+        then dies the legacy way and /healthz goes unhealthy)."""
+        wrapped = (err if isinstance(err, RetriableError)
+                   else RetriableError(
+                       f"ring dispatch failed ({err}); rebuilt — retry"))
+        # decide + account for the restart BEFORE unblocking any client:
+        # a caller released by the _finish below may immediately read
+        # stats/healthy, and must see the restart it was shed for
+        healing = self._budget is not None and not self._budget.exhausted
+        if healing:
+            self._rebuilding = True
+            self.stats["watchdog_restarts"] += 1
+        else:
+            self.healthy = False
+        for req in list(self.lane):
+            if req is not None and not req.done.is_set():
+                self._finish(req, wrapped)
+        self.lane = [None] * self.slots
+        self._lane_out = [[] for _ in range(self.slots)]
+        self._lane_left = [0] * self.slots
+        self._lane_pos = [0] * self.slots
+        self._lane_first = [None] * self.slots
+        self._prefilling.clear()
+        self._disagg_waiting.clear()
+        if not healing:
+            return False
+        backoff = self._budget.spend()
+        self.executor.reset_state()
+        self._stop.wait(backoff)
+        self._rebuilding = False
+        return True
+
+    def _expire_deadlines(self) -> None:
+        now = time.monotonic()
+        for i, req in enumerate(self.lane):
+            if (req is not None and req.deadline is not None
+                    and now >= req.deadline and not req.done.is_set()):
+                req.deadline_exceeded = True
+                self.stats["deadline_exceeded"] += 1
+                self._evict(i)        # resolves with the partial tokens
+
+    # -- admission ---------------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"no bucket fits prompt length {n}")
+
+    def _activate(self, slot: int, req: _Request, first) -> None:
+        """A lane's prefill completed (whatever path delivered it):
+        wire up the decode-side bookkeeping so the next chunk dispatch
+        includes it."""
+        try:                            # ship the first token host-ward
+            first.copy_to_host_async()  # early: TTFT then needs no
+        except AttributeError:          # extra round-trip at consume
+            pass
+        n = len(req.prompt)
+        self._lane_out[slot] = []
+        self._lane_first[slot] = first
+        self._lane_left[slot] = req.max_new
+        self._lane_pos[slot] = n
+        if req.max_new == 1:
+            # degenerate budget: sync now and free the lane immediately
+            # rather than riding a whole wasted chunk
+            self._materialize_first(slot, req)
+            self._evict(slot)
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        """Admission entry: reserve the lane, then route by prefill
+        mode.  ``inline`` is ONE compiled dispatch and nothing else on
+        the device path (make_prefill_insert does the splice,
+        first-token sample and all lane-state updates in a single jit):
+        eager ops here would block behind whatever chunk is decoding —
+        measured ~500 ms EACH on relayed chips.  ``chunked`` maps
+        blocks / allocates staging and lets the loop interleave slices;
+        ``disagg`` ships cold prompts to the prefill executor (prefix
+        hits stay inline — the suffix insert is already cheap)."""
+        ex = self.executor
+        n = len(req.prompt)
+        self.lane[slot] = req
+        # reset the lane's host mirrors NOW, not at activation: a
+        # chunked/disagg lane evicted MID-PREFILL (cancel, deadline,
+        # drain) resolves through ``req.prompt + _lane_out[slot]``, and
+        # the previous occupant's tokens must never leak into it
+        self._lane_out[slot] = []
+        self._lane_first[slot] = None
+        if self.prefill_mode == "chunked":
+            self._admit_chunked(slot, req)
+            self.stats["admitted"] += 1
+            return
+        if self.prefill_mode == "disagg":
+            self._admit_disagg(slot, req)
+            self.stats["admitted"] += 1
+            return
+        if self.paged:
+            first = self._admit_paged(slot, req)
+        elif self.spec_k:
+            (ex.cache, ex.dcache, ex.tok, ex.temp, ex.keys,
+             first) = ex.inserts[req.bucket](
+                ex.params, ex.draft_params, ex.cache, ex.dcache,
+                ex.tok, ex.temp, ex.keys, req.dev_prompt,
+                n, slot, float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+        else:
+            ex.cache, ex.tok, ex.temp, ex.keys, first = \
+                ex.inserts[req.bucket](
+                    ex.params, ex.cache, ex.tok, ex.temp,
+                    ex.keys, req.dev_prompt, n, slot,
+                    float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+        # counted only once the insert dispatched: a NoFreeBlocks /
+        # insert failure above fails the request and must not drift
+        # ``admitted`` past real admissions (the slot-reuse tests and
+        # the bench saturation wait both read it)
+        self.stats["admitted"] += 1
+        self._activate(slot, req, first)
+
+    def _admit_paged(self, slot: int, req: _Request):
+        """Inline paged admission: map blocks (radix hits read-only,
+        CoW'd where the suffix will write, fresh for the rest), then
+        ONE compiled insert — the full-prompt scatter insert cold, the
+        suffix-only insert on a prefix hit.  A full prefix hit runs a
+        ONE-token forward (the first sampled token needs the last
+        prompt position's logits — logits are not cached, KV is) and
+        zero forwards over cached blocks; the prefill-call counters are
+        the tests' acceptance gate for that claim."""
+        ex = self.executor
+        n = len(req.prompt)
+        # max_suffix: beyond it a prefix hit is not worth taking — the
+        # suffix insert's per-row pool writes (paged._write_rows_paged)
+        # unroll O(rows), so a long divergent suffix admits faster
+        # through the cold block-granular scatter prefill; the
+        # allocator then maps fresh blocks instead of the cached ones
+        # (never written over) when spec mode is off
+        hit_len, cow = self.pool.admit(          # NoFreeBlocks -> req fails
+            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
+        for src, dst in cow:
+            ex.cache["k"], ex.cache["v"] = ex._copy_block(
+                ex.cache["k"], ex.cache["v"], src, dst)
+        self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+        tbl_row = jnp.asarray(self.pool.table[slot])
+        if self.spec_k:
+            (ex.cache, ex.dcache, ex.tok, ex.temp, ex.keys,
+             first) = ex.inserts[req.bucket](
+                ex.params, ex.draft_params, ex.cache, ex.dcache,
+                tbl_row, ex.tok, ex.temp, ex.keys, req.dev_prompt,
+                n, slot, float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+        elif hit_len:
+            first = self._suffix_admit(slot, req, tbl_row, hit_len)
+        else:
+            ex.cache, ex.tok, ex.temp, ex.keys, first = \
+                ex.inserts[req.bucket](
+                    ex.params, ex.cache, tbl_row, ex.tok,
+                    ex.temp, ex.keys, req.dev_prompt, n, slot,
+                    float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+        # register this lane's full prompt blocks for future admissions
+        # (content is valid for any later dispatch — same device stream)
+        self.pool.publish(slot, req.prompt)
+        return first
+
+    def _suffix_admit(self, slot: int, req: _Request, tbl_row, hit_len):
+        """Prefix-hit admission: one suffix-only insert over the
+        uncached tail — shared by the inline paged path and disagg's
+        hit short-circuit."""
+        ex = self.executor
+        suffix = req.prompt[hit_len:]
+        sb = ex.suffix_bucket(len(suffix))
+        ins = ex.suffix_insert(sb)
+        padded = np.zeros((1, sb), np.int32)
+        padded[0, :len(suffix)] = suffix
+        ex.cache, ex.tok, ex.temp, ex.keys, first = ins(
+            ex.params, ex.cache, tbl_row, ex.tok, ex.temp,
+            ex.keys, jnp.asarray(padded), len(suffix), hit_len,
+            slot, float(req.temperature), req.seed)
+        self.stats["prefill_calls"] += 1
+        self.stats["prefill_tokens"] += len(suffix)
+        return first
+
+    def _admit_chunked(self, slot: int, req: _Request) -> None:
+        """Chunked admission: reserve the lane and (paged) map its
+        blocks now — the loop then advances ONE prefill slice per ring
+        iteration (:meth:`_advance_prefill`), so resident decode lanes
+        never wait more than one slice."""
+        ex = self.executor
+        hit_len = 0
+        if self.paged:
+            hit_len, cow = self.pool.admit(
+                slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
+            for src, dst in cow:
+                ex.cache["k"], ex.cache["v"] = ex._copy_block(
+                    ex.cache["k"], ex.cache["v"], src, dst)
+            self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+            lane_k = lane_v = None
+        else:
+            lane_k, lane_v = ex.make_staging(req.bucket)
+        self._admit_seq += 1
+        self._prefilling[slot] = _PrefillState(
+            req, hit_len, hit_len, self._admit_seq, lane_k, lane_v)
+
+    def _advance_prefill(self, slot: int) -> None:
+        """Dispatch the NEXT chunked-prefill slice for lane ``slot``:
+        an intermediate slice appends KV only; the final slice runs the
+        suffix/final insert (first-token sample + lane activation) and
+        publishes the prompt's blocks to the radix cache."""
+        ex = self.executor
+        st = self._prefilling[slot]
+        req = st.req
+        n = len(req.prompt)
+        sb = ex.prefill_chunk
+        remaining = n - st.start
+        if remaining > sb:
+            # intermediate slice: KV only, no logits, no lane state
+            toks = np.zeros((1, sb), np.int32)
+            toks[0, :] = req.prompt[st.start:st.start + sb]
+            if self.paged:
+                tbl_row = jnp.asarray(self.pool.table[slot])
+                ex.cache = ex.chunk_prog(None)(
+                    ex.params, ex.cache, tbl_row, jnp.asarray(toks),
+                    st.start, st.start + sb)
+            else:
+                sl = ex.staging_len(req.bucket)
+                st.lane_k, st.lane_v = ex.chunk_prog(sl)(
+                    ex.params, st.lane_k, st.lane_v, jnp.asarray(toks),
+                    st.start)
+            st.start += sb
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += sb
+            self.stats["chunked_prefill_tokens"] += sb
+            return
+        # final slice
+        toks = np.zeros((1, sb), np.int32)
+        toks[0, :remaining] = req.prompt[st.start:]
+        toks = jnp.asarray(toks)
+        if self.paged and not self.spec_k:
+            ins = ex.final_insert(None)
+            ex.cache, ex.tok, ex.temp, ex.keys, first = ins(
+                ex.params, ex.cache, jnp.asarray(self.pool.table[slot]),
+                ex.tok, ex.temp, ex.keys, toks, remaining, st.start,
+                slot, float(req.temperature), req.seed)
+        elif self.paged:
+            ins = ex.final_insert(None, req.bucket)
+            (ex.cache, ex.dcache, ex.tok, ex.temp, ex.keys, first) = ins(
+                ex.params, ex.draft_params, ex.cache, ex.dcache,
+                jnp.asarray(self.pool.table[slot]), ex.tok, ex.temp,
+                ex.keys, toks, remaining, st.start, slot,
+                req.dev_prompt, n, float(req.temperature), req.seed)
+        elif self.spec_k:
+            sl = ex.staging_len(req.bucket)
+            ins = ex.final_insert(sl, req.bucket)
+            (ex.cache, ex.dcache, ex.tok, ex.temp, ex.keys, first) = ins(
+                ex.params, ex.draft_params, ex.cache, ex.dcache,
+                st.lane_k, st.lane_v, ex.tok, ex.temp, ex.keys, toks,
+                remaining, st.start, req.dev_prompt, n, slot,
+                float(req.temperature), req.seed)
+        else:
+            sl = ex.staging_len(req.bucket)
+            ins = ex.final_insert(sl)
+            ex.cache, ex.tok, ex.temp, ex.keys, first = ins(
+                ex.params, ex.cache, st.lane_k, st.lane_v, ex.tok,
+                ex.temp, ex.keys, toks, remaining, st.start, n, slot,
+                float(req.temperature), req.seed)
+        self.stats["prefill_calls"] += 1
+        self.stats["prefill_tokens"] += remaining
+        self.stats["chunked_prefill_tokens"] += remaining
+        del self._prefilling[slot]
+        if self.paged:
+            self.pool.publish(slot, req.prompt)
+        self._activate(slot, req, first)
+
+    def _admit_disagg(self, slot: int, req: _Request) -> None:
+        """Disaggregated admission: a radix prefix HIT admits inline
+        through the suffix insert (the cached blocks live in the decode
+        pool; the suffix forward is already cheap).  A COLD prompt maps
+        fresh decode-pool blocks now (reserved — the handoff can never
+        fail on NoFreeBlocks) and ships the prefill to the executor
+        thread; the loop attaches the lane when the result lands."""
+        hit_len, cow = self.pool.admit(
+            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
+        if hit_len and not self.spec_k:
+            ex = self.executor
+            for src, dst in cow:
+                ex.cache["k"], ex.cache["v"] = ex._copy_block(
+                    ex.cache["k"], ex.cache["v"], src, dst)
+            self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+            first = self._suffix_admit(
+                slot, req, jnp.asarray(self.pool.table[slot]), hit_len)
+            self.pool.publish(slot, req.prompt)
+            self._activate(slot, req, first)
+            return
+        # cold: fresh blocks are already mapped by admit (hit_len == 0
+        # here unless spec, whose prefix cache is off -> also 0)
+        self._disagg_waiting[slot] = req
+        self.executor.prefill_exec.submit(req, slot)
+
+    def _drain_handoffs(self) -> None:
+        """Attach completed disaggregated prefills: device-to-device
+        block copy from the prefill executor's pool into the lane's
+        already-mapped decode-pool blocks, then one tiny attach
+        dispatch (pos/tok/temp/keys).  Results for requests that
+        resolved meanwhile (cancel, deadline, heal) are dropped — their
+        decode blocks were already retired with the lane."""
+        ex = self.executor
+        pexec = ex.prefill_exec
+        while True:
+            try:
+                item = pexec.results.get_nowait()
+            except queue.Empty:
+                return
+            req, slot = item[0], item[1]
+            if (self._disagg_waiting.get(slot) is not req
+                    or self.lane[slot] is not req or req.done.is_set()):
+                continue                    # stale result: drop
+            del self._disagg_waiting[slot]
+            if len(item) == 3:              # (req, slot, error)
+                self._finish(req, item[2])
+                self._evict(slot)
+                continue
+            _, _, src_k, src_v, n_blocks, first = item
+            n = len(req.prompt)
+            # src blocks are the executor's fixed identity row 1..M;
+            # dst blocks were mapped at admission.  Both id vectors pad
+            # to the table width with the TRASH block — garbage written
+            # there is the trash block's job — so ONE transfer compile
+            # serves every prompt length.
+            m = self.pool.max_blocks
+            src_ids = np.zeros((m,), np.int32)
+            dst_ids = np.zeros((m,), np.int32)
+            src_ids[:n_blocks] = np.arange(1, n_blocks + 1)
+            dst_ids[:n_blocks] = self.pool.table[slot][:n_blocks]
+            ex.cache["k"], ex.cache["v"] = ex._transfer(
+                ex.cache["k"], ex.cache["v"], src_k, src_v,
+                jnp.asarray(src_ids), jnp.asarray(dst_ids))
+            if self.spec_k:
+                (ex.dcache, ex.cache["pos"], ex.tok, ex.temp,
+                 ex.keys) = ex.spec_attach(req.bucket)(
+                    ex.draft_params, ex.dcache, ex.cache["pos"], ex.tok,
+                    ex.temp, ex.keys, req.dev_prompt, n, slot, first,
+                    float(req.temperature), req.seed)
+            else:
+                (ex.cache["pos"], ex.tok, ex.temp,
+                 ex.keys) = ex._attach(
+                    ex.cache["pos"], ex.tok, ex.temp, ex.keys, slot,
+                    first, n, float(req.temperature), req.seed)
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+            self.stats["disagg_prefills"] += 1
+            self.pool.publish(slot, req.prompt)
+            self._activate(slot, req, first)
+
+    # -- consume / evict ---------------------------------------------------
+
+    def _materialize_first(self, i: int, req: _Request) -> None:
+        """Bring the admission-sampled first token to the host (the only
+        per-request sync, folded into a chunk consume) and run it through
+        the same budget/eos/stream bookkeeping as chunk tokens."""
+        fd = self._lane_first[i]
+        if fd is None:
+            return
+        self._lane_first[i] = None
+        t = int(fd)
+        self._lane_out[i].append(t)
+        self._tokens_emitted += 1
+        if req._stream is not None:
+            req._stream.put(t)
+        self._lane_left[i] -= 1
+        if req.eos is not None and t == req.eos:
+            self._lane_left[i] = 0
+
+    @staticmethod
+    def _finish(req: _Request, error: Optional[Exception] = None) -> None:
+        # a request that already RESOLVED keeps its outcome: attaching a
+        # late error (e.g. the loop's shutdown sweep racing abort()'s
+        # partial flush) would turn a delivered partial into a raise
+        if error is not None and req.error is None \
+                and not req.done.is_set():
+            req.error = error
+        # done BEFORE the stream sentinel: a stream() consumer that sees
+        # the close must find result() already resolvable
+        req.done.set()
+        if req._stream is not None:
+            req._stream.put(None)
+
+    def _evict(self, slot: int) -> None:
+        # host bookkeeping ONLY — no device ops (an eager .at[].set here
+        # blocks behind the in-flight chunk on relayed chips).  The
+        # lane's stale temp/keys are harmless: inactive lanes' tokens
+        # are ignored, and the next admission overwrites all lane state
+        # inside its compiled insert.
+        req = self.lane[slot]
+        self.lane[slot] = None
+        self._lane_pos[slot] = 0        # retired lanes report no pos
+        # a lane evicted MID-PREFILL (cancel, deadline, drain) drops its
+        # slice/handoff state; a late disagg result is dropped by the
+        # identity check in _drain_handoffs
+        self._prefilling.pop(slot, None)
+        self._disagg_waiting.pop(slot, None)
+        if self.pool is not None:
+            # return the lane's blocks: published prompt blocks become
+            # reclaimable cache, private ones rejoin the free list; the
+            # zeroed table row routes any in-flight pipelined write for
+            # this lane into the trash block
+            self.pool.retire(slot)
+        self.stats["evicted"] += 1
+        if req is not None and not req.done.is_set():
+            # error-path evictions can race ahead of the first consume
+            self._materialize_first(slot, req)
+            req.out = req.prompt + self._lane_out[slot]
+            self._finish(req)
+        else:
+            # already resolved (watchdog stall / quarantine failed it
+            # from another thread): just release the lane state
+            self._lane_first[slot] = None
+
+    def _loop(self) -> None:
+        try:
+            self._loop_body()
+        except Exception as e:       # unrecoverable failure: fail loudly
+            # flip dead-state BEFORE unblocking any client: a caller
+            # released by the _finish below may immediately submit
+            # again, and must be refused rather than queued into a void
+            self.healthy = False
+            self._stop.set()
+            for req in self.lane:
+                if req is not None:
+                    self._finish(req, e)
+            self.lane = [None] * self.slots
+        # drain: fail whatever is still queued or resident
+        for i, req in enumerate(self.lane):
+            if req is not None:
+                self._finish(req, ShuttingDown("batcher closed"))
+                self.lane[i] = None
+        self._shed_queue(ShuttingDown("batcher closed"))
+
+    def _scrub_lane_blocks(self, slot: int) -> None:
+        """Zero lane ``slot``'s PRIVATE pool blocks before they return
+        to the free list: a NaN row in a re-mapped block would poison
+        the next lane through the masked-tail contraction (softmax
+        underflows masked columns to exactly 0, but 0 * NaN = NaN) —
+        the same invariant the contiguous ring keeps by zeroing the
+        whole lane at splice, block-granular.
+
+        PUBLISHED (radix-cached) blocks are skipped: they hold shared
+        prefix KV other admissions still read, and this lane cannot
+        have poisoned them — every block the lane writes is private by
+        construction (admit CoWs any hit block at/after the first
+        written position).  One fused scatter over all victim blocks
+        per pool (not one eager update per block): each ``.at[].set``
+        materializes a full pool copy, and this runs on the ring
+        thread behind the in-flight chunk."""
+        ex = self.executor
+        row = self.pool.table[slot]
+        blks = [int(row[j]) for j in range(self.pool.mapped_count[slot])
+                if self.pool.ref[int(row[j])] == 1
+                and int(row[j]) not in self.pool.by_block]
+        if blks:
+            idx = jnp.asarray(blks)
+            ex.cache["k"] = ex.cache["k"].at[:, idx].set(0)
+            ex.cache["v"] = ex.cache["v"].at[:, idx].set(0)
+
+    def _consume(self, chunk_reqs, toks, counts=None, ok=None) -> None:
+        """Apply one finished chunk's tokens ([chunk, slots] on host).
+        ``chunk_reqs`` pins each lane to the REQUEST the chunk was
+        dispatched for: under pipelining a lane may have been evicted
+        (and even re-admitted) since dispatch — such in-flight tokens
+        belong to the old request and are dropped.
+
+        ``counts`` (speculative mode): per-lane count of VALID rows in
+        ``toks`` — the variable accept-length advance.  Lane i takes
+        ``toks[:counts[i], i]`` (its accepted drafts + the correction
+        token); None means every row is valid (plain chunk mode).  The
+        budget/eos walk below is shared, so an eos landing mid-
+        speculated-block truncates exactly like one landing mid-chunk —
+        no tokens after eos ever reach the result or the stream.
+
+        ``ok`` (nan_check mode): per-lane isfinite verdict for this
+        chunk — a False lane is QUARANTINED: its request fails
+        (:class:`LaneQuarantined`), its blocks are scrubbed + freed,
+        and no token of the poisoned chunk reaches any consumer.  The
+        other lanes are attention-independent, so their streams stay
+        bit-identical to a fault-free run."""
+        for i, req in chunk_reqs:
+            if req is None or self.lane[i] is not req \
+                    or req.done.is_set():
+                continue
+            if ok is not None and not bool(ok[i]):
+                self.stats["quarantined_lanes"] += 1
+                if self.pool is not None:
+                    self._scrub_lane_blocks(i)
+                self._finish(req, LaneQuarantined(
+                    f"lane {i} produced non-finite logits; request "
+                    "failed, lane quarantined (ring unaffected)"))
+                self._evict(i)
+                continue
+            self._materialize_first(i, req)
+            n = toks.shape[0] if counts is None else int(counts[i])
+            # the host fill-position mirror advances exactly like the
+            # device pos (chunk ticks, or the spec round's commit count)
+            self._lane_pos[i] += n
+            if counts is not None:
+                self.stats["spec_drafted"] += self.spec_k
+                self.stats["spec_accepted"] += max(0, n - 1)
+                req.drafted += self.spec_k
+                req.accepted += max(0, n - 1)
+            for t in toks[:n, i]:
+                if self._lane_left[i] <= 0:
+                    break
+                self._lane_out[i].append(int(t))
+                self._tokens_emitted += 1
+                if req._stream is not None:
+                    req._stream.put(int(t))
+                self._lane_left[i] -= 1
+                if req.eos is not None and int(t) == req.eos:
+                    self._lane_left[i] = 0
+            if self._lane_left[i] <= 0:
+                self._evict(i)
+
+    def _consume_oldest(self, pending: List[tuple]) -> None:
+        """Pop + apply the oldest in-flight chunk.  The blocking
+        device->host completion wait sits under the watchdog: a wedged
+        dispatch surfaces HERE on real chips (dispatches are async), and
+        the monitor fails the waiting clients while this thread is still
+        stuck."""
+        chunk_reqs, toks_dev, counts_dev, ok_dev = pending.pop(0)
+        wd = self._watchdog
+        if wd is not None:
+            wd.begin()
+        try:
+            toks = np.asarray(toks_dev)
+            counts = None if counts_dev is None else np.asarray(counts_dev)
+            ok = None if ok_dev is None else np.asarray(ok_dev)
+        finally:
+            if wd is not None:
+                wd.end()
+        if self._fault is None:     # stall-failed chunks must not apply
+            self._consume(chunk_reqs, toks, counts, ok)
+
+    def _pending_prefill_slots(self) -> set:
+        """Lanes reserved but not yet decode-active."""
+        return set(self._prefilling) | set(self._disagg_waiting)
+
+    def _loop_body(self) -> None:
+        # Up to ``pipeline_depth`` chunks in flight at all times (when
+        # lanes are active): the host consumes chunk N's tokens — per-
+        # token queue pushes, evict bookkeeping, and crucially the
+        # device->host transfer latency — WHILE the device decodes
+        # chunks N+1..N+depth.  Without this the ring serializes RTT
+        # with compute; depth 1 was still RTT-bound on relayed chips
+        # whose round-trip exceeds a chunk's device time (measured by
+        # bench.py measure_ring_throughput), hence depth 2 by default.
+        ex = self.executor
+        pending: List[tuple] = []   # [(chunk_reqs, toks, counts, ok)]
+        while not self._stop.is_set():
+            # ring-level fault (dispatch raised, or the watchdog
+            # declared a stall): drop the in-flight chunks and self-heal
+            # — rebuild everything device-side, re-admit queued work —
+            # or die (legacy / budget exhausted) via the raise, which
+            # the _loop wrapper turns into fail-everything + unhealthy
+            if self._fault is not None:
+                err, self._fault = self._fault, None
+                pending.clear()
+                if not self._heal(err):
+                    raise err
+                continue
+            if self._draining:
+                # drain: no new admissions; whatever is queued sheds
+                # with ShuttingDown (clients retry another replica)
+                self._shed_queue(ShuttingDown(
+                    "server draining; retry another replica"))
+            self._expire_deadlines()
+            # cancelled lanes leave at the chunk boundary: the request
+            # resolves with whatever tokens it has, the lane frees for
+            # the next admission (serve.py calls cancel() when a stream
+            # consumer disconnects mid-generation)
+            for i, r in enumerate(self.lane):
+                if r is not None and r._cancel:
+                    self._evict(i)
+            # disaggregated prefills that completed since last pass:
+            # block-copy handoff + lane attach (cheap dispatches).
+            # Gated on the ENGINE, not on _disagg_waiting: a result
+            # posted for an evicted request must still be popped (and
+            # dropped), or its full prefill-pool K/V snapshot stays
+            # pinned in the results queue until the next cold admission
+            if ex.prefill_exec is not None:
+                try:
+                    self._drain_handoffs()
+                except Exception as e:
+                    self._fault = e
+                    continue
+            # admit into free lanes
+            while not self._draining and any(r is None for r in self.lane):
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                if req._cancel:                 # cancelled while queued
+                    req.out = list(req.prompt)
+                    self._finish(req)
+                    continue
+                if (req.deadline is not None
+                        and time.monotonic() >= req.deadline):
+                    # expired while queued: prompt-only 504 partial —
+                    # resolved, never silently dropped
+                    req.deadline_exceeded = True
+                    self.stats["deadline_exceeded"] += 1
+                    req.out = list(req.prompt)
+                    self._finish(req)
+                    continue
+                slot = self.lane.index(None)
+                try:
+                    self._admit(slot, req)
+                except Exception as e:          # bad request: fail it only
+                    self._finish(req, e)
+                    self.lane[slot] = None
+                    self._lane_pos[slot] = 0
+                    self._prefilling.pop(slot, None)
+                    self._disagg_waiting.pop(slot, None)
+                    if self.pool is not None:
+                        # admission may have mapped blocks before the
+                        # dispatch failed — unmap them (no-op when the
+                        # allocator itself rejected)
+                        self.pool.retire(slot)
+            # chunked prefill: advance exactly ONE slice per iteration
+            # (oldest admission first) — the interleave that bounds how
+            # long resident decode lanes ever wait
+            if self._prefilling:
+                slot = min(self._prefilling,
+                           key=lambda s: self._prefilling[s].seq)
+                req = self._prefilling[slot].req
+                wd = self._watchdog
+                if wd is not None:
+                    wd.begin()
+                try:
+                    self._advance_prefill(slot)
+                except Exception as e:          # fail THIS request only
+                    self._finish(req, e)
+                    self._evict(slot)
+                finally:
+                    if wd is not None:
+                        wd.end()
+
+            prefill_pending = self._pending_prefill_slots()
+            active_idx = [i for i, r in enumerate(self.lane)
+                          if r is not None and i not in prefill_pending]
+            if not active_idx:
+                if pending:
+                    try:
+                        self._consume_oldest(pending)
+                    except Exception as e:
+                        self._fault = e
+                    continue            # eviction may have freed lanes
+                if prefill_pending:
+                    # no decode work, but prefill in flight: spin the
+                    # loop (chunked slices run back-to-back; disagg
+                    # handoffs land as soon as they arrive)
+                    self._wake.wait(timeout=0.002)
+                    self._wake.clear()
+                    continue
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+                continue
+            self.stats["max_active"] = max(self.stats["max_active"],
+                                           len(active_idx))
+
+            tbl = None
+            if self.paged:
+                # on-demand block mapping: grow each active lane's table
+                # to cover this dispatch PLUS every chunk already in
+                # flight for it (the host pos mirror lags dispatched-
+                # but-unconsumed work; spec rounds advance a
+                # data-dependent 1..K+1, so the bound is the worst case).
+                # An UNDERSIZED pool (num_blocks oversubscription) can
+                # run dry mid-generation: only the lane that cannot
+                # grow fails — evicting it (its request resolves with
+                # the error) frees its blocks for the rest of the ring,
+                # which must keep serving.
+                advance = (self.spec_k + 1) if self.spec_k else self.chunk
+                for i in list(active_idx):
+                    inflight = sum(
+                        1 for chunk_reqs, _, _, _ in pending
+                        for j, r in chunk_reqs
+                        if j == i and r is self.lane[i])
+                    try:
+                        self.pool.ensure(
+                            i, self._lane_pos[i] + (inflight + 1) * advance)
+                    except self.executor._pg.NoFreeBlocks as e:
+                        r = self.lane[i]
+                        if r is not None and r.error is None:
+                            r.error = e
+                        self._evict(i)
+                        active_idx.remove(i)
+                if not active_idx:
+                    continue        # every lane starved: retry the loop
+                tbl_np = self.pool.table
+                if prefill_pending:
+                    # lanes mid-prefill hold REAL mapped blocks, but the
+                    # chunk step writes every lane's (ignored) token at
+                    # its zeroed pos — mask their rows to the trash
+                    # block so an inactive write can never touch a
+                    # block a prefill slice / handoff is filling
+                    tbl_np = tbl_np.copy()
+                    tbl_np[sorted(prefill_pending)] = \
+                        self.executor._pg.TRASH_BLOCK
+                tbl = jnp.asarray(tbl_np)
+            active = jnp.asarray(
+                [r is not None and i not in prefill_pending
+                 for i, r in enumerate(self.lane)], bool)
+            # async dispatch: returns device futures immediately.  The
+            # watchdog brackets it anyway — a chaos-injected host-side
+            # hang (and a synchronous-dispatch backend) wedges HERE —
+            # and any raise becomes a ring fault handled at the loop top
+            # (fail resident requests retriably, rebuild, back off).
+            wd = self._watchdog
+            if wd is not None:
+                wd.begin()
+            try:
+                ok_dev = None
+                if self.spec_k:
+                    spec_args = (ex.params, ex.draft_params,
+                                 ex.cache, ex.dcache)
+                    if self.paged:
+                        spec_args += (tbl,)
+                    (ex.cache, ex.dcache, ex.tok, toks_dev,
+                     counts_dev) = ex.spec_step(
+                        *spec_args, ex.tok, ex.temp, ex.keys,
+                        active)
+                elif self.paged:
+                    out = ex.step(
+                        ex.params, ex.cache, tbl, ex.tok,
+                        ex.temp, ex.keys, active)
+                    counts_dev = None
+                    if self._check_finite:
+                        ex.cache, ex.tok, toks_dev, ok_dev = out
+                    else:
+                        ex.cache, ex.tok, toks_dev = out
+                else:
+                    out = ex.step(
+                        ex.params, ex.cache, ex.tok, ex.temp,
+                        ex.keys, active)
+                    counts_dev = None
+                    if self._check_finite:
+                        ex.cache, ex.tok, toks_dev, ok_dev = out
+                    else:
+                        ex.cache, ex.tok, toks_dev = out
+            except Exception as e:
+                self._fault = e
+                continue
+            finally:
+                if wd is not None:
+                    wd.end()
+            self.stats["chunks"] += 1
+            # kick the device->host copy NOW, before the consume wait:
+            # by consume time the tokens are already on the wire and
+            # np.asarray is a cheap completion wait instead of a full
+            # round-trip on the ring's critical path
+            for dev in (toks_dev, counts_dev, ok_dev):
+                try:
+                    dev.copy_to_host_async()
+                except AttributeError:  # None / interpret-mode ndarray
+                    pass
+            pending.append(([(i, self.lane[i]) for i in active_idx],
+                            toks_dev, counts_dev, ok_dev))
+            if len(pending) >= self.pipeline_depth:
+                try:
+                    self._consume_oldest(pending)
+                except Exception as e:
+                    self._fault = e

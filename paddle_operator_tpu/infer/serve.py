@@ -471,6 +471,27 @@ def main() -> int:
                 "SERVE_PREFIX_CACHE", "1") == "1"
             if os.environ.get("SERVE_NUM_BLOCKS"):
                 ring_kw["num_blocks"] = int(os.environ["SERVE_NUM_BLOCKS"])
+        # SERVE_PREFILL=inline|chunked|disagg (docs/serving.md): how
+        # admission prefill reaches the device.  ``chunked`` interleaves
+        # SERVE_PREFILL_CHUNK-token slices into ring iterations so a
+        # cold long prompt never stalls resident decode lanes for a
+        # whole prefill; ``disagg`` moves cold prefills to a separate
+        # executor thread + block pool entirely (implies SERVE_PAGED —
+        # the handoff is block-granular).  Both are greedy-bit-identical
+        # to inline (the dryrun serve-disagg gate pins it).
+        prefill_mode = os.environ.get("SERVE_PREFILL", "inline")
+        if prefill_mode != "inline":
+            ring_kw["prefill_mode"] = prefill_mode
+            if prefill_mode == "disagg" and not ring_kw.get("paged"):
+                print("SERVE_PREFILL=disagg implies SERVE_PAGED=1 "
+                      "(block-granular handoff)", flush=True)
+        if os.environ.get("SERVE_PREFILL_CHUNK"):
+            ring_kw["prefill_chunk"] = int(
+                os.environ["SERVE_PREFILL_CHUNK"])
+        # SERVE_PREWARM=0 opts out of the off-thread compile prewarm
+        # (the first long prompt then pays the per-bucket insert
+        # compile — the lazy-compile cliff the prewarm exists to hide)
+        ring_kw["prewarm"] = os.environ.get("SERVE_PREWARM", "1") == "1"
         if spec_k > 0:
             # SERVE_SPEC_K=K: speculative decoding through the ring.
             # SERVE_DRAFT names the draft config — "auto" derives the
@@ -519,6 +540,7 @@ def main() -> int:
           f"(resumed={resumed}, "
           f"quantize={os.environ.get('QUANTIZE', 'off')}, "
           f"tp={tp}, spec_k={spec_k if continuous else 0}, "
+          f"prefill={ring_kw.get('prefill_mode', 'inline') if continuous else '-'}, "
           f"mode={'continuous' if continuous else 'batch'}) on :{env.port}",
           flush=True)
     srv = make_server("0.0.0.0", env.port, params, cfg,

@@ -145,12 +145,18 @@ def _layer_multi(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
 def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
                    toks: jax.Array, cache: Dict[str, jax.Array],
-                   mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                   mesh=None, head: bool = True
+                   ) -> Tuple[Optional[jax.Array], Dict[str, jax.Array]]:
     """[B, T] new tokens at per-lane cache['pos'] -> ([B, T, vocab]
     logits, advanced cache).  The chunked-verify forward: every einsum
     is the ring path's, so under a serving mesh the whole thing rides
     GSPMD off the param/cache shardings (T is a handful of rows — the
-    pallas single-query kernel has nothing to win here)."""
+    pallas single-query kernel has nothing to win here).
+
+    ``head=False`` skips the final norm + lm head and returns
+    ``(None, cache)`` — an INTERMEDIATE chunked-prefill slice
+    (executor.make_prefill_chunk) only appends KV, and head logits
+    over a whole slice are the biggest tensor in the prefill path."""
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
@@ -163,10 +169,13 @@ def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
     x, (k_new, v_new) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"]))
+    new_cache = {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
+    if not head:
+        return None, new_cache
     x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     logits = D._mm(x, params["lm_head"]["kernel"],
                    cfg.dtype).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
+    return logits, new_cache
 
 
 def _layer_multi_paged(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
@@ -229,14 +238,17 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
                          toks: jax.Array, cache: Dict[str, jax.Array],
                          table: jax.Array,
                          limit: Optional[jax.Array] = None,
-                         mesh=None
-                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                         mesh=None, head: bool = True
+                         ) -> Tuple[Optional[jax.Array],
+                                    Dict[str, jax.Array]]:
     """:func:`_multi_forward` with the target cache PAGED: the
     chunked-verify (and paged suffix-prefill) forward whose writes and
     attention walk the block table.  ``table`` [B, M] int32;
     ``limit`` [B] (optional) bounds real rows per lane — pads beyond it
     write to the trash block.  The pools ride the layer scan as carry
-    (block ids are dynamic)."""
+    (block ids are dynamic).  ``head=False``: KV append only, logits
+    None (intermediate chunked-prefill slices,
+    paged.make_paged_prefill_chunk)."""
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
@@ -252,10 +264,13 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
     (x, k_new, v_new), _ = jax.lax.scan(
         body, (x, cache["k"], cache["v"]),
         (params["layers"], jnp.arange(cfg.n_layers)))
+    new_cache = {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
+    if not head:
+        return None, new_cache
     x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     logits = D._mm(x, params["lm_head"]["kernel"],
                    cfg.dtype).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
+    return logits, new_cache
 
 
 # ---------------------------------------------------------------------------
@@ -288,7 +303,7 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
     and the verify forward walks it (:func:`_multi_forward_paged`).
     The DRAFT cache stays a contiguous ring either way: its propose
     loop keeps the fast contiguous write path and pays no paging."""
-    from paddle_operator_tpu.infer.batcher import _ring_forward
+    from paddle_operator_tpu.infer.executor import _ring_forward
 
     kk = spec_k
 

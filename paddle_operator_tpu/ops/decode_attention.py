@@ -482,6 +482,37 @@ def sharded_decode_attention(mesh, q: jax.Array, k_cache: jax.Array,
     return fn(*args)
 
 
+def scatter_prefill_blocks(pool: jax.Array, rows: jax.Array,
+                           table_row: jax.Array, block_size: int,
+                           start_block: int = 0) -> jax.Array:
+    """The prefill-WRITE path against the block pool: place a
+    contiguous slab of freshly prefilled KV rows
+    (``[L, 1, H, T, D]``, T a multiple of ``block_size``) into the pool
+    as WHOLE-block writes at the lane's table entries, starting at
+    lane-local block ``start_block``.
+
+    Whole blocks on purpose: the per-row unroll the suffix insert uses
+    (infer/paged.py ``_write_rows_paged``) costs O(rows)
+    dynamic_update_slice ops — fine for a short divergent suffix,
+    pathological for a 2k-token cold prefill.  Block-aligned prefill
+    output (decode.paged_prefill, the chunked slices of a cold prompt)
+    writes O(blocks) instead, and each write is exactly the pallas
+    decode kernel's DMA unit (``paged_decode_attention`` streams these
+    same [H, bs, D] tiles back out through its index map).  Pad rows
+    past the real prompt scatter into whatever the table maps there —
+    the trash block for unmapped entries, a future decode block
+    otherwise, where every row is overwritten before it becomes
+    attendable (the exactness-with-padding contract, block-granular).
+    """
+    t = rows.shape[3]
+    for j in range(t // block_size):
+        blk = jax.lax.slice_in_dim(rows, j * block_size,
+                                   (j + 1) * block_size, axis=3)
+        pool = jax.lax.dynamic_update_slice(
+            pool, blk, (0, table_row[start_block + j], 0, 0, 0))
+    return pool
+
+
 def decode_attention_reference(q: jax.Array, k_cache: jax.Array,
                                v_cache: jax.Array,
                                lengths: jax.Array) -> jax.Array:

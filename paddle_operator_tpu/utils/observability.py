@@ -98,6 +98,17 @@ def serving_gauges(status_serving: dict, job: str) -> dict:
             float(status_serving.get("prefixHitRate", 0.0)),
         f"tpujob_serve_kv_blocks_free{lbl}":
             float(status_serving.get("kvBlocksFree", 0.0)),
+        # prefill path (ISSUE 6 scheduler/executor split): requests
+        # admitted but still prefilling (chunked slices mid-flight or
+        # disagg jobs on the prefill executor), labeled with the ring's
+        # prefill mode so dashboards can split inline/chunked/disagg
+        # fleets, plus the share of prefill tokens that arrived in
+        # interleaved chunked slices
+        ("tpujob_serve_prefill_queue_depth"
+         f'{{job="{job}",mode="{status_serving.get("prefillMode", "inline")}"}}'):
+            float(status_serving.get("prefillQueueDepth", 0.0)),
+        f"tpujob_serve_chunked_prefill_token_share{lbl}":
+            float(status_serving.get("chunkedPrefillTokenShare", 0.0)),
         # serving fault tolerance (infer/resilience.py): deadline
         # partials served, self-healing ring rebuilds, NaN-quarantined
         # lanes, and the drain flag (1 while the pod sheds admissions)

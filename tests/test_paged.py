@@ -208,6 +208,7 @@ class TestPagedRingParity:
     contiguous ring / decode.generate — cold, prefix-hit, and CoW
     admissions alike."""
 
+    @pytest.mark.slow      # dryrun serve-paged pins cold-admit parity
     def test_cold_admissions_match_generate(self, setup):
         _, cfg, params = setup
         b = _batcher(cfg, params)
@@ -353,6 +354,7 @@ class TestPagedSpecRing:
     target verify walks the block table — greedy output still
     bit-identical to plain generate."""
 
+    @pytest.mark.slow      # dryrun serve-paged pins spec-on parity
     def test_spec_paged_matches_generate(self, setup):
         _, cfg, params = setup
         dcfg = cfg.draft()
@@ -375,6 +377,7 @@ class TestPagedSpecRing:
 
 
 class TestShardedPagedRing:
+    @pytest.mark.slow      # dryrun serve-paged pins the tp=2 parity
     def test_tp2_paged_matches_generate(self, setup):
         """The block pool sharded over its kv-head axis on a tp=2
         serving mesh (paged kernel through shard_map) — tokens

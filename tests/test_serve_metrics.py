@@ -22,7 +22,9 @@ NS = "default"
 TMPL = {"spec": {"containers": [{"name": "m", "image": "jax:latest"}]}}
 
 SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
-           "tokensTotal": 9000, "prefixHitRate": 0.31, "kvBlocksFree": 17}
+           "tokensTotal": 9000, "prefixHitRate": 0.31, "kvBlocksFree": 17,
+           "prefillMode": "chunked", "prefillQueueDepth": 2,
+           "chunkedPrefillTokenShare": 0.85}
 
 
 class TestGaugeNaming:
@@ -33,6 +35,18 @@ class TestGaugeNaming:
         assert g['tpujob_serve_queue_depth{job="default/j"}'] == 3.0
         assert g['tpujob_serve_prefix_hit_rate{job="default/j"}'] == 0.31
         assert g['tpujob_serve_kv_blocks_free{job="default/j"}'] == 17.0
+        # prefill-path gauges (ISSUE 6): the queue-depth gauge carries
+        # the ring's mode as a label so dashboards can split
+        # inline/chunked/disagg fleets on one metric name
+        assert g['tpujob_serve_prefill_queue_depth'
+                 '{job="default/j",mode="chunked"}'] == 2.0
+        assert g['tpujob_serve_chunked_prefill_token_share'
+                 '{job="default/j"}'] == 0.85
+
+    def test_prefill_mode_label_defaults_inline(self):
+        g = serving_gauges({}, "ns/x")
+        assert ('tpujob_serve_prefill_queue_depth'
+                '{job="ns/x",mode="inline"}') in g
 
     def test_missing_keys_default_zero(self):
         g = serving_gauges({}, "ns/x")
@@ -143,9 +157,14 @@ class TestBatcherServingStatus:
         assert set(st) == {"tokensPerSec", "acceptRate", "queueDepth",
                            "tokensTotal", "activeLanes", "lanePos",
                            "prefixHitRate", "kvBlocksFree", "kvBlocksHwm",
+                           # prefill-path block (ISSUE 6 split)
+                           "prefillMode", "prefillQueueDepth",
+                           "chunkedPrefillTokenShare",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
+        assert st["prefillMode"] == "inline"
+        assert st["prefillQueueDepth"] == 0
         assert st["tokensTotal"] == 4
         assert st["tokensPerSec"] > 0
         assert st["acceptRate"] == 0.0         # non-speculative ring

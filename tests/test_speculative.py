@@ -276,6 +276,7 @@ class TestSpeculativeRing:
 
 
 class TestShardedSpeculative:
+    @pytest.mark.slow      # dryrun serve-spec pins the tp=2 parity
     def test_tp2_speculative_matches_single_device(self, setup):
         """The tentpole's sharding claim: the draft's single-token steps
         and the chunked verify ride the same tp mesh, tokens unchanged."""
