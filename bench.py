@@ -231,6 +231,12 @@ def measure_decode(cfg, batch: int, prompt_len: int, new_tokens: int,
 
         fills = range(prompt_len + n_small, prompt_len + new_tokens)
         streamed_len = sum(-(-f // _BK) * _BK for f in fills) / len(fills)
+    # NOTE: this path always streams the cache at COMPUTE dtype
+    # (D.generate over the contiguous ring).  The quantized pool's
+    # hbm accounting — where storage width (1-byte int8 codes) differs
+    # from compute width — lives in measure_quantized_pool, whose
+    # timed run actually streams int8; charging compute bytes THERE
+    # would overstate util ~2x.
     cache_bytes = (2 * cfg.n_layers * batch * streamed_len
                    * cfg.n_kv_heads * cfg.head_dim * bpe)
     hbm_util = (weight_bytes + cache_bytes) / step_s / (HBM_GBPS * 1e9)
@@ -623,6 +629,143 @@ def measure_disagg_serving(cfg, params, *, slots: int = 4,
             })
         finally:
             b.close()
+    return out
+
+
+def measure_quantized_pool(cfg, params, *, prompt_len: int = 16,
+                           new_tokens: int = 240, block_size: int = 8,
+                           lanes_bf16: int = 5, chunk: int = 8,
+                           waves: int = 3, mesh=None) -> list:
+    """Quantized-pool sweep (ISSUE 7, docs/serving.md): resident-lane
+    CAPACITY and AGGREGATE ring throughput at FIXED pool HBM bytes,
+    int8 codes+scales vs the bf16 pool — the trade the
+    ops/decode_attention.py header prices.  Three cells:
+
+    1. ``bf16`` — a paged ring whose pool holds ``lanes_bf16`` full
+       lanes; its byte footprint (pool planes + per-lane state) is the
+       budget.
+    2. ``int8`` — as many blocks as the SAME byte budget buys once
+       blocks store int8 codes + f32 per-(block, kv-head) scales +
+       the bf16 staging tails (all counted), lanes sized to match.
+    3. ``int8-iso`` — int8 at the bf16 cell's LANE count: the
+       per-step dequant cost isolated from the capacity win
+       (``kvq_step_ms_ratio``; the header's ~17% v5e bound).
+
+    Each throughput cell runs ``waves x capacity`` admission-bound
+    requests (slots == capacity, so excess requests QUEUE on free
+    lanes instead of failing on NoFreeBlocks) and reports generated
+    tokens / wall — the aggregate tok/s the capacity buys.  Greedy
+    parity/quality is the dryrun ``serve-kvquant`` line's job; this
+    measures, it does not assert."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+
+    # a lane's worst-case block need (prompt + chunk-rounded budget,
+    # plus one chunk of pipelined ensure() projection)
+    budget_rows = prompt_len + -(-(new_tokens - 1) // chunk) * chunk
+    max_len = budget_rows
+    blocks_per_lane = -(-(budget_rows + chunk) // block_size)
+    elems = (cfg.n_layers * cfg.n_kv_heads * block_size * cfg.head_dim)
+    bpe = jnp.dtype(cfg.dtype).itemsize
+    per_block_bf16 = 2 * elems * bpe                 # K + V planes
+    per_block_int8 = 2 * elems + 2 * cfg.n_layers * cfg.n_kv_heads * 4
+    per_tail = 2 * elems * bpe                       # one lane's bf16 tail
+
+    nb_bf16 = lanes_bf16 * blocks_per_lane
+    budget = nb_bf16 * per_block_bf16
+    # int8 blocks the same budget buys, tails (lanes + 1 rows) included
+    # — the staging tail is part of the quantized design's footprint,
+    # not free working memory
+    nb_int8, lanes_int8 = nb_bf16, lanes_bf16
+    while True:
+        cand_blocks = nb_int8 + blocks_per_lane
+        cand_lanes = (nb_int8 + blocks_per_lane) // blocks_per_lane
+        cand = (cand_blocks * per_block_int8
+                + (cand_lanes + 1) * per_tail)
+        if cand > budget:
+            break
+        nb_int8, lanes_int8 = cand_blocks, cand_lanes
+    rng = np.random.default_rng(0)
+
+    # KV bytes one decode step streams PER LANE at STORAGE width —
+    # the decode_hbm_util accounting for the quantized pool: int8
+    # codes count 1 byte/elem plus one f32 scale per (block, kv-head)
+    # amortized (4 / (bs * head_dim) per element) plus the lane's
+    # bf16 staging tail block read in place of its write-frontier
+    # block.  Charging the compute dtype here would overstate util
+    # ~2x — the pool is streamed at storage width, the dequant
+    # happens in-register (fused kernel) / in the gather view.  This
+    # lives HERE, not in measure_decode, because this cell's timed
+    # run is the one that actually streams int8 bytes.
+    view_rows = blocks_per_lane * block_size
+    kv_elems = 2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+
+    def kv_bytes_per_step(quant, lanes):
+        if quant == "int8":
+            per_elem = 1 + 4.0 / (block_size * cfg.head_dim)
+            tail_extra = kv_elems * block_size * (bpe - per_elem)
+            return lanes * (kv_elems * view_rows * per_elem + tail_extra)
+        return lanes * kv_elems * view_rows * bpe
+
+    def run_cell(mode, quant, lanes, nb):
+        b = ContinuousBatcher(
+            params, cfg, slots=lanes, max_len=max_len,
+            chunk_tokens=chunk, prefill_buckets=(prompt_len, max_len),
+            paged=True, block_size=block_size, num_blocks=nb,
+            prefix_cache=False, kv_quant=quant, mesh=mesh)
+        try:
+            # warm the compile set outside the window
+            b.submit(rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist(),
+                     max_new_tokens=chunk).result(timeout=600)
+            n_req = waves * lanes
+            t0 = time.perf_counter()
+            hs = [b.submit(rng.integers(0, cfg.vocab_size,
+                                        (prompt_len,)).tolist(),
+                           max_new_tokens=new_tokens)
+                  for _ in range(n_req)]
+            for h in hs:
+                h.result(timeout=600)
+            dt = time.perf_counter() - t0
+            b.pool.check_invariant()
+            return {
+                "kvq_mode": mode,
+                "kvq_block_size": block_size,
+                "kvq_blocks_per_lane": blocks_per_lane,
+                "kvq_num_blocks": nb,
+                "kvq_capacity_lanes": lanes,
+                "kvq_pool_bytes": b.executor.pool_bytes(),
+                "kvq_requests": n_req,
+                "kvq_max_active": b.stats["max_active"],
+                "kvq_tok_per_sec": round(n_req * new_tokens / dt, 1),
+                "kvq_step_ms": round(
+                    dt / max(1, b.stats["chunks"]) * 1000, 2),
+                # storage-width KV stream per decode step (whole
+                # gathered view, the einsum-path convention of
+                # measure_decode's "xla" accounting) — int8 cells
+                # count 1 byte/elem + amortized scales + bf16 tail
+                "kvq_kv_stream_mb_per_step": round(
+                    kv_bytes_per_step(quant, lanes) / 1e6, 3),
+            }
+        finally:
+            b.close()
+
+    out = [run_cell("bf16", "none", lanes_bf16, nb_bf16),
+           run_cell("int8", "int8", lanes_int8, nb_int8),
+           # iso-lane cell: the kernel-level regression alone
+           run_cell("int8-iso", "int8", lanes_bf16, nb_bf16)]
+    base, quant8, iso = out
+    out.append({
+        "kvq_capacity_ratio": round(
+            quant8["kvq_capacity_lanes"] / base["kvq_capacity_lanes"], 2),
+        "kvq_tok_s_ratio": round(
+            quant8["kvq_tok_per_sec"] / base["kvq_tok_per_sec"], 2),
+        "kvq_step_ms_ratio": round(
+            iso["kvq_step_ms"] / base["kvq_step_ms"], 2),
+        "kvq_pool_bytes_budget": budget,
+    })
     return out
 
 
@@ -1361,6 +1504,36 @@ def main() -> int:
 
         _fold_disagg_summary(guarded("disagg", cpu_disagg), summary,
                              emit)
+
+        # quantized-pool sweep on CPU: capacity/aggregate-throughput
+        # ratios at fixed pool bytes are REAL (pure allocator + lane
+        # arithmetic); the per-step ratio is CPU-einsum physics, not
+        # the v5e kernel's (the decode_attention.py header carries the
+        # v5e dequant analysis the TPU run would measure)
+        def cpu_kvquant():
+            from paddle_operator_tpu.infer.quant import serving_params
+
+            tcfg = dataclasses.replace(L.CONFIGS["tiny"],
+                                       max_seq_len=256)
+            tparams = serving_params(L.Llama(tcfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"], tcfg.dtype)
+            return measure_quantized_pool(
+                tcfg, tparams, prompt_len=16, new_tokens=240,
+                block_size=8, lanes_bf16=5, chunk=8, waves=3)
+
+        kvq = guarded("kvquant", cpu_kvquant)
+        if isinstance(kvq, list):
+            for entry in kvq:
+                emit("kvquant_sweep", entry)
+            ratios = kvq[-1]
+            summary["kvq_capacity_ratio"] = ratios.get(
+                "kvq_capacity_ratio")
+            summary["kvq_tok_s_ratio"] = ratios.get("kvq_tok_s_ratio")
+            summary["kvq_step_ms_ratio"] = ratios.get(
+                "kvq_step_ms_ratio")
+        else:
+            emit("kvquant_sweep", kvq)
 
         # speculative sweep on CPU: tiny pattern-trained pair — speeds
         # are meaningless but accept-rate and the greedy-parity path run

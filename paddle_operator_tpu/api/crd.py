@@ -290,9 +290,10 @@ def _status_schema() -> Dict[str, Any]:
             # serving_status) — exported as tpujob_serve_* manager
             # gauges.  Includes the fault-tolerance keys
             # (infer/resilience.py): draining, deadlineExceeded,
-            # watchdogRestarts, quarantinedLanes — and the prefill-path
+            # watchdogRestarts, quarantinedLanes — the prefill-path
             # keys (ISSUE 6): prefillMode, prefillQueueDepth,
-            # chunkedPrefillTokenShare — schemaless on purpose
+            # chunkedPrefillTokenShare — and the quantized-pool keys
+            # (ISSUE 7): kvQuantMode, kvPoolBytes — schemaless on purpose
             # (preserve-unknown-fields) so the workload can grow
             # telemetry without a CRD rev.
             "serving": {

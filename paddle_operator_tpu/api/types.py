@@ -410,10 +410,12 @@ class TPUJobStatus:
     # ContinuousBatcher.serving_status): served tokens/sec, speculative
     # acceptance rate, request-queue depth, the prefill-path block
     # (ISSUE 6 scheduler/executor split) — prefillMode (inline|chunked|
-    # disagg), prefillQueueDepth, chunkedPrefillTokenShare — plus the
-    # fault-tolerance block (infer/resilience.py) — draining,
-    # deadlineExceeded, watchdogRestarts, quarantinedLanes.  The
-    # manager exports it as tpujob_serve_* gauges on /metrics.
+    # disagg), prefillQueueDepth, chunkedPrefillTokenShare — the
+    # quantized-pool block (ISSUE 7) — kvQuantMode (none|int8),
+    # kvPoolBytes — plus the fault-tolerance block
+    # (infer/resilience.py) — draining, deadlineExceeded,
+    # watchdogRestarts, quarantinedLanes.  The manager exports it as
+    # tpujob_serve_* gauges on /metrics.
     serving: Dict[str, Any] = field(default_factory=dict)
     # k8s-style status conditions; the reconciler maintains a "Goodput"
     # condition from the published block.

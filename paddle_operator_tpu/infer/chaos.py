@@ -163,6 +163,30 @@ class ChaosInjector:
 
         b = self.batcher
         if getattr(b, "paged", False):
+            if getattr(b.executor, "quant", False):
+                # int8 codes cannot hold a NaN (the cast would just
+                # produce a finite garbage value) — poison the lane's
+                # PRIVATE bf16 staging tail (write-frontier reads)
+                # AND the scale planes of its private mapped blocks.
+                # The tail alone has a washout hole: injected at
+                # pos % bs == 0 the fresh tail row is fully
+                # overwritten by real writes before any offset
+                # becomes attendable and the fault silently vanishes
+                # (~1/block_size of injections).  A NaN SCALE makes
+                # every dequantized read of a committed block
+                # non-finite; the frontier block's scale is
+                # overwritten at its commit, so set every private
+                # block (any committed one triggers).  The quarantine
+                # scrub resets both (scales -> sentinel, tail -> 0).
+                b.cache["kt"] = b.cache["kt"].at[:, slot].set(np.nan)
+                pool = b.pool
+                row = pool.table[slot]
+                for j in range(pool.mapped_count[slot]):
+                    blk = int(row[j])
+                    if pool.ref[blk] == 1 and blk not in pool.by_block:
+                        b.cache["ks"] = b.cache["ks"].at[:, blk].set(
+                            np.nan)
+                return
             # poison one PRIVATE (refcount-1, uncached) mapped block —
             # a shared prefix block would poison other lanes' streams
             pool = b.pool

@@ -408,7 +408,8 @@ def prefill(params: Dict[str, Any], cfg: LlamaConfig, tokens: jax.Array,
 def paged_prefill(params: Dict[str, Any], cfg: LlamaConfig,
                   tokens: jax.Array, pool_cache: Dict[str, jax.Array],
                   table_row: jax.Array, *, block_size: Optional[int] = None,
-                  mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                  mesh=None, quant: bool = False,
+                  prompt_len: Optional[jax.Array] = None):
     """Prefill a whole [1, bucket] prompt and write its KV into the
     PAGED block pool (infer/paged.py) as block-aligned chunks at the
     lane's ``table_row`` entries — the cold-admission half of paged
@@ -420,15 +421,61 @@ def paged_prefill(params: Dict[str, Any], cfg: LlamaConfig,
     (the trash block when unmapped — exactness-with-padding,
     block-granular).  Returns ([1, bucket, vocab] logits — the caller
     samples at ``prompt_len - 1`` — and the pool cache with this
-    lane's position untouched (the caller's insert sets it)."""
+    lane's position untouched (the caller's insert sets it).
+
+    ``quant=True`` (needs ``prompt_len``, traced): whole blocks
+    quantize ONCE on the way into the int8 pool
+    (ops/decode_attention.py scatter_prefill_blocks_quant), and the
+    prompt's partial last block is returned as exact bf16 tail tiles
+    ``(logits, cache', tail_k, tail_v)`` [L, 1, H, bs, D] for the
+    caller's insert to splice into the lane's staging tail — the one
+    block whose scale cannot be final yet."""
     from paddle_operator_tpu.infer.paged import _scatter_prompt_blocks
 
     bs = block_size or pool_cache["k"].shape[3]
     lane = init_cache(cfg, 1, tokens.shape[1])
     logits, lane = _forward(cfg, params, tokens, lane, mesh=mesh)
-    k = _scatter_prompt_blocks(pool_cache["k"], lane["k"], table_row, bs)
-    v = _scatter_prompt_blocks(pool_cache["v"], lane["v"], table_row, bs)
-    return logits, {"k": k, "v": v, "pos": pool_cache["pos"]}
+    if not quant:
+        k = _scatter_prompt_blocks(pool_cache["k"], lane["k"], table_row,
+                                   bs)
+        v = _scatter_prompt_blocks(pool_cache["v"], lane["v"], table_row,
+                                   bs)
+        return logits, {"k": k, "v": v, "pos": pool_cache["pos"]}
+    from paddle_operator_tpu.ops.decode_attention import (
+        scatter_prefill_blocks_quant,
+    )
+
+    if prompt_len is None:
+        raise ValueError("quant paged_prefill needs prompt_len for the "
+                         "staging-tail slice")
+    k, ks = scatter_prefill_blocks_quant(
+        pool_cache["k"], pool_cache["ks"], lane["k"], table_row, bs)
+    v, vs = scatter_prefill_blocks_quant(
+        pool_cache["v"], pool_cache["vs"], lane["v"], table_row, bs)
+    # the write-frontier block's exact rows: [start, start + bs) of the
+    # lane cache.  The lane alloc need not be a block multiple, and
+    # dynamic_slice CLAMPS an out-of-range start backwards — which
+    # would hand back rows of the PREVIOUS block at the wrong tail
+    # offsets (positions start+o would attend K/V of start-pad+o) —
+    # so pad the time axis up to a block multiple first.  The one
+    # remaining clamp (block-aligned prompt filling the whole padded
+    # alloc, start == padded len) is harmless: decode then begins a
+    # FRESH block and every stale tail row sits behind the fill mask.
+    L, _, h, t_alloc, dd = lane["k"].shape
+    pad = -t_alloc % bs
+    lane_k, lane_v = lane["k"], lane["v"]
+    if pad:
+        widths = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
+        lane_k = jnp.pad(lane_k, widths)
+        lane_v = jnp.pad(lane_v, widths)
+    start = (prompt_len // bs) * bs
+    tail_k = jax.lax.dynamic_slice(lane_k, (0, 0, 0, start, 0),
+                                   (L, 1, h, bs, dd))
+    tail_v = jax.lax.dynamic_slice(lane_v, (0, 0, 0, start, 0),
+                                   (L, 1, h, bs, dd))
+    cache = {"k": k, "v": v, "ks": ks, "vs": vs, "kt": pool_cache["kt"],
+             "vt": pool_cache["vt"], "pos": pool_cache["pos"]}
+    return logits, cache, tail_k, tail_v
 
 
 def decode_step(params: Dict[str, Any], cfg: LlamaConfig,

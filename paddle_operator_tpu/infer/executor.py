@@ -603,7 +603,8 @@ def make_spec_attach(cfg: LlamaConfig, dcfg: LlamaConfig, bucket: int,
 
 def make_disagg_prefill(cfg: LlamaConfig, bucket: int, block_size: int,
                         top_k: Optional[int] = None,
-                        top_p: Optional[float] = None, mesh=None):
+                        top_p: Optional[float] = None, mesh=None,
+                        quant: bool = False):
     """The prefill executor's whole-prompt program: prefill a
     [1, bucket] prompt into the PREFILL pool's blocks (the same
     ``decode.paged_prefill`` compiled ops as the inline paged insert —
@@ -612,16 +613,31 @@ def make_disagg_prefill(cfg: LlamaConfig, bucket: int, block_size: int,
     touches no ring state: the handoff copies blocks and attaches the
     lane later, on the decode thread.
 
+    ``quant=True``: blocks quantize once into the executor's own int8
+    pool; the prompt's partial last block lands exact in the pool's
+    tail row 0 (the executor pool is one lane wide) — the handoff
+    transfer then carries codes, scales AND tail across.
+
     ``prefill(params, cache, table_row, prompt, prompt_len, temp_val,
     seed) -> (cache', first_token)``
     """
 
     def prefill(params, cache, table_row, prompt, prompt_len, temp_val,
                 seed):
-        logits, new_cache = D.paged_prefill(params, cfg, prompt, cache,
-                                            table_row,
-                                            block_size=block_size,
-                                            mesh=mesh)
+        if quant:
+            logits, new_cache, tail_k, tail_v = D.paged_prefill(
+                params, cfg, prompt, cache, table_row,
+                block_size=block_size, mesh=mesh, quant=True,
+                prompt_len=prompt_len)
+            new_cache["kt"] = jax.lax.dynamic_update_slice(
+                new_cache["kt"], tail_k, (0, 0, 0, 0, 0))
+            new_cache["vt"] = jax.lax.dynamic_update_slice(
+                new_cache["vt"], tail_v, (0, 0, 0, 0, 0))
+        else:
+            logits, new_cache = D.paged_prefill(params, cfg, prompt,
+                                                cache, table_row,
+                                                block_size=block_size,
+                                                mesh=mesh)
         logits = logits[0, prompt_len - 1]
         key = jax.random.PRNGKey(seed)
         first = _sample_tokens(
@@ -658,20 +674,24 @@ class PrefillExecutor:
     def __init__(self, params: Any, cfg: LlamaConfig, *, max_len: int,
                  block_size: int, buckets: Tuple[int, ...],
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None, mesh=None) -> None:
+                 top_p: Optional[float] = None, mesh=None,
+                 kv_quant: str = "none") -> None:
         from paddle_operator_tpu.infer import paged as PG
 
         self.params = params
         self.cfg = cfg
         self.block_size = int(block_size)
         self.mesh = mesh
+        self.kv_quant = kv_quant
+        self.quant = kv_quant == "int8"
         alloc = D.cache_alloc_len(max_len)
         self.max_blocks = -(-alloc // self.block_size)
         # block 0 stays the trash block, same convention as the decode
         # pool; the job's blocks are the FIXED identity row 1..M — one
         # job at a time needs no allocator at all
         self.cache = PG.init_paged_cache(cfg, 1, self.max_blocks + 1,
-                                         self.block_size, mesh=mesh)
+                                         self.block_size, mesh=mesh,
+                                         quant=kv_quant)
         self.table_row = jnp.arange(1, self.max_blocks + 1,
                                     dtype=jnp.int32)
         # the prefill engine's OWN bucket ladder, FINER than the ring's
@@ -691,7 +711,8 @@ class PrefillExecutor:
             b *= 2
         self.buckets = tuple(ladder) + (cap,)
         self._progs = {b: make_disagg_prefill(cfg, b, self.block_size,
-                                              top_k, top_p, mesh=mesh)
+                                              top_k, top_p, mesh=mesh,
+                                              quant=self.quant)
                        for b in self.buckets}
         self.jobs: "queue.Queue[tuple]" = queue.Queue()
         self.results: "queue.Queue[tuple]" = queue.Queue()
@@ -737,8 +758,11 @@ class PrefillExecutor:
                 # snapshot refs: immutable arrays — the next job's
                 # writes produce a NEW pool version, this one stays
                 # readable until the ring's copy dispatch consumes it
-                self.results.put((req, slot, self.cache["k"],
-                                  self.cache["v"], n_blocks, first))
+                # (quant pools snapshot codes+scales+tails alike)
+                snap = {key: self.cache[key]
+                        for key in ("k", "v", "ks", "vs", "kt", "vt")
+                        if key in self.cache}
+                self.results.put((req, slot, snap, n_blocks, first))
             except Exception as e:      # noqa: BLE001 — isolate per job
                 self.results.put((req, slot, e))
 
@@ -782,7 +806,8 @@ class RingExecutor:
                  prefix_cache: bool = True,
                  prefill_mode: str = "inline",
                  prefill_chunk: int = 64,
-                 check_finite: bool = False) -> None:
+                 check_finite: bool = False,
+                 kv_quant: str = "none") -> None:
         self.mesh = mesh
         if mesh is not None and D.mesh_tp(mesh) > 1:
             params = D.shard_params_for_serving(params, cfg, mesh)
@@ -802,6 +827,21 @@ class RingExecutor:
         if self.prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1 (got {prefill_chunk})")
+        # SERVE_KV_QUANT: int8 codes + per-block scales for the paged
+        # pool, dequant fused into the kernels — ~2x resident lanes per
+        # HBM byte; "none" (default) keeps the bf16 pool bit-identical
+        # to pre-quantization behavior (infer/paged.py module note)
+        from paddle_operator_tpu.infer import paged as _PGQ
+
+        if kv_quant not in _PGQ.KV_QUANT_MODES:
+            raise ValueError(f"kv_quant {kv_quant!r} not in "
+                             f"{_PGQ.KV_QUANT_MODES}")
+        self.kv_quant = kv_quant
+        self.quant = kv_quant == "int8"
+        if self.quant and not self.paged:
+            raise ValueError("kv_quant='int8' requires the paged ring "
+                             "(the pool block is the quantization "
+                             "unit); set paged=True / SERVE_PAGED=1")
         if self.paged:
             from paddle_operator_tpu.infer import paged as PG
 
@@ -817,7 +857,8 @@ class RingExecutor:
             self.buckets = tuple(sorted(
                 {min(-(-b // self.block_size) * self.block_size,
                      self.pool.view_len) for b in self.buckets}))
-            self._copy_block = PG.make_block_copier()
+            self._copy_block = PG.make_block_copier(quant=self.quant)
+            self._tail_init = PG.make_tail_init() if self.quant else None
         else:
             self.block_size = int(block_size)
             self.prefix_cache = False
@@ -854,14 +895,14 @@ class RingExecutor:
             self.draft_params = draft_params
             self.spec_step = make_spec_round_fn(
                 cfg, draft_cfg, self.spec_k, top_k, top_p, mesh=mesh,
-                paged=self.paged)
+                paged=self.paged, quant=self.quant)
             self.step = None
             if self.paged:
                 # target prefill scatters into the pool; the DRAFT lane
                 # stays a contiguous splice (speculative.py docstring)
                 self.inserts = {b: self._pg.make_paged_spec_prefill_insert(
                     cfg, draft_cfg, b, self.block_size, top_k, top_p,
-                    mesh=mesh) for b in self.buckets}
+                    mesh=mesh, quant=self.quant) for b in self.buckets}
             else:
                 self.inserts = {b: make_spec_prefill_insert(
                     cfg, draft_cfg, b, top_k, top_p, mesh=mesh)
@@ -872,9 +913,10 @@ class RingExecutor:
             if self.paged:
                 self.step = self._pg.make_paged_chunk_step(
                     cfg, chunk_tokens, top_k, top_p, mesh=mesh,
-                    check_finite=check_finite)
+                    check_finite=check_finite, quant=self.quant)
                 self.inserts = {b: self._pg.make_paged_prefill_insert(
-                    cfg, b, self.block_size, top_k, top_p, mesh=mesh)
+                    cfg, b, self.block_size, top_k, top_p, mesh=mesh,
+                    quant=self.quant)
                     for b in self.buckets}
             else:
                 self.step = make_chunk_step(cfg, chunk_tokens, top_k,
@@ -895,8 +937,10 @@ class RingExecutor:
             self.prefill_exec = PrefillExecutor(
                 self.params, cfg, max_len=max_len,
                 block_size=self.block_size, buckets=self.buckets,
-                top_k=top_k, top_p=top_p, mesh=mesh)
-            self._transfer = self._pg.make_pool_transfer(self.pool.max_blocks)
+                top_k=top_k, top_p=top_p, mesh=mesh,
+                kv_quant=self.kv_quant)
+            self._transfer = self._pg.make_pool_transfer(
+                self.pool.max_blocks, quant=self.quant)
             self._attach = make_attach_lane()
 
         self.reset_state()
@@ -917,7 +961,7 @@ class RingExecutor:
                 self._num_blocks, prefix_cache=self.prefix_cache)
             self.cache = self._pg.init_paged_cache(
                 self.cfg, self.slots, self.pool.total, self.block_size,
-                mesh=self.mesh)
+                mesh=self.mesh, quant=self.kv_quant)
         else:
             self.cache = init_ring_cache(self.cfg, self.slots,
                                          self.max_len, mesh=self.mesh)
@@ -952,9 +996,23 @@ class RingExecutor:
         if ins is None:
             ins = self._pg.make_paged_suffix_insert(
                 self.cfg, sb, self.block_size, self.top_k, self.top_p,
-                mesh=self.mesh)
+                mesh=self.mesh, quant=self.quant)
             self._suffix_inserts[sb] = ins
         return ins
+
+    def pool_bytes(self) -> int:
+        """Device bytes held by the KV cache (block pool incl. scale
+        planes and staging tails, or the contiguous ring) — the
+        ``tpujob_serve_kv_pool_bytes`` gauge.  Pure shape arithmetic,
+        no device sync."""
+        import numpy as np
+
+        total = 0
+        for key in ("k", "v", "ks", "vs", "kt", "vt"):
+            buf = self.cache.get(key)
+            if buf is not None:
+                total += int(np.prod(buf.shape)) * buf.dtype.itemsize
+        return total
 
     def chunk_prog(self, staging_len: Optional[int]):
         """Intermediate chunked-prefill slice program: paged (keyed by
@@ -966,7 +1024,8 @@ class RingExecutor:
         if prog is None:
             if self.paged:
                 prog = self._pg.make_paged_prefill_chunk(
-                    self.cfg, sb, self.block_size, mesh=self.mesh)
+                    self.cfg, sb, self.block_size, mesh=self.mesh,
+                    quant=self.quant)
             else:
                 prog = make_prefill_chunk(self.cfg, sb, staging_len,
                                           mesh=self.mesh)
@@ -990,7 +1049,7 @@ class RingExecutor:
                 prog = self._pg.make_paged_spec_suffix_insert(
                     self.cfg, self.draft_cfg, sb, bucket,
                     self.block_size, self.top_k, self.top_p,
-                    mesh=self.mesh)
+                    mesh=self.mesh, quant=self.quant)
                 self._final_inserts[key] = prog
             return prog
         if self.spec_k:
@@ -1053,7 +1112,7 @@ class RingExecutor:
         if self.paged:
             cache = self._pg.init_paged_cache(
                 self.cfg, slots, self.pool.total, self.block_size,
-                mesh=self.mesh)
+                mesh=self.mesh, quant=self.kv_quant)
             tbl = jnp.zeros((slots, self.pool.max_blocks), jnp.int32)
         else:
             cache = init_ring_cache(self.cfg, slots, self.max_len,
@@ -1121,8 +1180,20 @@ class RingExecutor:
                 cache, tok, temp, keys, _ = self.suffix_insert(sb)(
                     self.params, cache, row, tok, temp, keys, toks,
                     1, 0, 0, 0.0, 0)
-            k = jnp.zeros_like(cache["k"])
-            self._copy_block(k, jnp.zeros_like(cache["v"]), 0, 0)
+            if self.quant:
+                self._copy_block(jnp.zeros_like(cache["k"]),
+                                 jnp.zeros_like(cache["v"]),
+                                 jnp.zeros_like(cache["ks"]),
+                                 jnp.zeros_like(cache["vs"]), 0, 0)
+                # the mid-block radix-hit admission also dispatches the
+                # staging-tail seed (scheduler._dispatch_cow)
+                self._tail_init(jnp.zeros_like(cache["kt"]),
+                                jnp.zeros_like(cache["vt"]),
+                                cache["k"], cache["ks"], cache["v"],
+                                cache["vs"], 0, 0)
+            else:
+                k = jnp.zeros_like(cache["k"])
+                self._copy_block(k, jnp.zeros_like(cache["v"]), 0, 0)
         if self.prefill_exec is not None:
             # the disagg engine's whole-prompt programs compile on the
             # PREFILL thread (they never stall decode), but the first
@@ -1136,9 +1207,21 @@ class RingExecutor:
                      jnp.zeros((1, b), jnp.int32), 1, 0.0, 0)
             m = self.pool.max_blocks
             ids = jnp.zeros((m,), jnp.int32)
-            self._transfer(jnp.zeros_like(cache["k"]),
-                           jnp.zeros_like(cache["v"]),
-                           pe.cache["k"], pe.cache["v"], ids, ids)
+            if self.quant:
+                self._transfer(jnp.zeros_like(cache["k"]),
+                               jnp.zeros_like(cache["v"]),
+                               jnp.zeros_like(cache["ks"]),
+                               jnp.zeros_like(cache["vs"]),
+                               jnp.zeros_like(cache["kt"]),
+                               jnp.zeros_like(cache["vt"]),
+                               pe.cache["k"], pe.cache["v"],
+                               pe.cache["ks"], pe.cache["vs"],
+                               pe.cache["kt"], pe.cache["vt"],
+                               ids, ids, 0)
+            else:
+                self._transfer(jnp.zeros_like(cache["k"]),
+                               jnp.zeros_like(cache["v"]),
+                               pe.cache["k"], pe.cache["v"], ids, ids)
         if self.prefill_mode == "chunked":
             # the chunked path's first long prompt dispatches slice +
             # final programs instead of the bucket inserts — warm those
@@ -1147,8 +1230,10 @@ class RingExecutor:
             toks = jnp.zeros((1, sb), jnp.int32)
             if self.paged:
                 row = jnp.zeros((self.pool.max_blocks,), jnp.int32)
-                cache = self.chunk_prog(None)(self.params, cache, row,
-                                              toks, 0, 0)
+                chunk_args = (self.params, cache, row, toks, 0, 0)
+                if self.quant:      # quant slices take a trailing slot
+                    chunk_args += (0,)
+                cache = self.chunk_prog(None)(*chunk_args)
                 if self.spec_k:
                     for b in self.buckets:
                         prompt = jnp.zeros((1, b), jnp.int32)

@@ -59,6 +59,38 @@ from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
 
 TRASH_BLOCK = 0
 
+# SERVE_KV_QUANT: "none" keeps the bf16 pool (the default AND the
+# parity oracle — byte-identical to pre-quantization behavior); "int8"
+# stores pool blocks as int8 codes + one f32 scale per (layer, block,
+# kv-head), with dequant fused into the paged kernels
+# (ops/decode_attention.py _paged_kernel_quant) / the gather view.
+# The win is CAPACITY, not kernel latency: ~2x resident lanes per HBM
+# byte, with a bounded per-step regression (the decode_attention.py
+# header has the v5e physics; bench.py measure_quantized_pool the
+# measured trade).
+KV_QUANT_MODES = ("none", "int8")
+
+
+def quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One pool block (…, bs, D) -> (int8 codes, f32 absmax/127 scale
+    over the trailing two axes — per-(…, kv-head) when called on
+    [L, 1, H, bs, D] tiles).  An all-zero block gets scale 1.0 so the
+    dequant never divides by zero; round-half-even + clip to ±127 keeps
+    the quantize→dequant→quantize roundtrip BIT-EXACT (the max element
+    maps to ±127, so the recomputed scale is identical — pinned by
+    tests/test_kvquant.py)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    codes = jnp.clip(jnp.round(xf / scale[..., None, None]), -127, 127)
+    return codes.astype(jnp.int8), scale
+
+
+def dequantize_kv(codes: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """codes (…, bs, D) x scale (…) -> values in ``dtype``."""
+    return (codes.astype(jnp.float32)
+            * scale[..., None, None].astype(jnp.float32)).astype(dtype)
+
 
 class NoFreeBlocks(RuntimeError):
     """The pool has no free block and no reclaimable (refcount-0)
@@ -410,17 +442,64 @@ class PagedCacheManager:
 # ---------------------------------------------------------------------------
 
 
+def _alloc_pool_buf(cfg: LlamaConfig, shape, dtype, mesh,
+                    head_axis: int) -> jax.Array:
+    """A pool-side buffer of arbitrary rank/dtype sharded over its
+    kv-head axis under a serving mesh (the generalization of
+    decode.alloc_kv_buffer the int8 codes/scales/tails need — their
+    ranks and dtypes differ from the bf16 pool's)."""
+    buf = jnp.zeros(shape, dtype)
+    if (mesh is not None and D.mesh_tp(mesh) > 1
+            and cfg.n_kv_heads % D.mesh_tp(mesh) == 0):
+        from jax.sharding import NamedSharding
+
+        from paddle_operator_tpu.parallel.sharding import logical_to_mesh
+
+        spec = tuple("kv_heads" if i == head_axis else None
+                     for i in range(len(shape)))
+        buf = jax.device_put(
+            buf, NamedSharding(mesh, logical_to_mesh(spec, None, mesh)))
+    return buf
+
+
 def init_paged_cache(cfg: LlamaConfig, slots: int, total_blocks: int,
-                     block_size: int, mesh=None) -> Dict[str, jax.Array]:
+                     block_size: int, mesh=None,
+                     quant: str = "none") -> Dict[str, jax.Array]:
     """The paged ring state: k/v pools [L, total_blocks, H_kv, bs, D]
     (kv-head-sharded under a serving mesh, like the ring cache) plus
     the per-lane fill position vector.  ``total_blocks`` INCLUDES the
-    trash block (PagedCacheManager.total)."""
+    trash block (PagedCacheManager.total).
+
+    ``quant="int8"`` splits each pool into int8 codes (same shape, half
+    the bytes) + f32 scales ``ks``/``vs`` [L, total_blocks, H_kv] (one
+    per block per kv head), and adds the bf16 staging tails ``kt``/
+    ``vt`` [L, slots + 1, H_kv, bs, D]: lane b's WRITE block accumulates
+    exact rows in tail row b and quantizes into the pool once, on block
+    completion — so a block's scale is computed exactly once from its
+    full contents, never re-derived per token.  Tail row ``slots`` is
+    the TRASH tail: rows that must not land anywhere (prefill pads,
+    inactive-lane ticks) redirect there, the per-lane analogue of pool
+    block 0.  Everything shards over the kv-head axis."""
     shape = (cfg.n_layers, total_blocks, cfg.n_kv_heads, block_size,
              cfg.head_dim)
+    if quant == "none":
+        return {
+            "k": D.alloc_kv_buffer(cfg, shape, mesh),
+            "v": D.alloc_kv_buffer(cfg, shape, mesh),
+            "pos": jnp.zeros((slots,), jnp.int32),
+        }
+    if quant != "int8":
+        raise ValueError(f"kv_quant {quant!r} not in {KV_QUANT_MODES}")
+    scale_shape = (cfg.n_layers, total_blocks, cfg.n_kv_heads)
+    tail_shape = (cfg.n_layers, slots + 1, cfg.n_kv_heads, block_size,
+                  cfg.head_dim)
     return {
-        "k": D.alloc_kv_buffer(cfg, shape, mesh),
-        "v": D.alloc_kv_buffer(cfg, shape, mesh),
+        "k": _alloc_pool_buf(cfg, shape, jnp.int8, mesh, 2),
+        "v": _alloc_pool_buf(cfg, shape, jnp.int8, mesh, 2),
+        "ks": _alloc_pool_buf(cfg, scale_shape, jnp.float32, mesh, 2),
+        "vs": _alloc_pool_buf(cfg, scale_shape, jnp.float32, mesh, 2),
+        "kt": _alloc_pool_buf(cfg, tail_shape, cfg.dtype, mesh, 2),
+        "vt": _alloc_pool_buf(cfg, tail_shape, cfg.dtype, mesh, 2),
         "pos": jnp.zeros((slots,), jnp.int32),
     }
 
@@ -460,6 +539,73 @@ def _write_rows_paged(pool: jax.Array, kv: jax.Array, li: jax.Array,
                 pool, kv[lane, :, j][None, None, :, None, :],
                 (li, blk, 0, p % block_size, 0))
     return pool
+
+
+def _write_token_quant(pool: jax.Array, scales: jax.Array,
+                       tail: jax.Array, kv: jax.Array, li: jax.Array,
+                       table: jax.Array, pos: jax.Array,
+                       rows_idx: jax.Array, block_size: int):
+    """Quantized-pool single-token write: lane b's new row ([B, H, 1, D]
+    at position ``pos[b]``) lands in its bf16 staging tail (row
+    ``rows_idx[b]`` — the lane's own row, or the trash tail for
+    inactive lanes) at offset ``pos % bs``; a row that COMPLETES its
+    block quantizes the whole tail block into the pool — codes + one
+    scale — at the lane's table entry.  The commit sits behind a
+    ``lax.cond`` so the 1-in-``block_size`` completing tick is the ONLY
+    one paying the tile quantize + pool write (an always-computed tile
+    discarded into the trash block would cost ~block_size x the bf16
+    path's single-row write traffic, per lane per layer per step).
+    Retired/masked lanes stay safe: their zeroed table rows send even
+    a "complete" commit to the trash block."""
+    hkv, d2 = kv.shape[1], kv.shape[3]
+    for lane in range(kv.shape[0]):
+        row = rows_idx[lane]
+        tail = jax.lax.dynamic_update_slice(
+            tail, kv[lane][None, None],
+            (li, row, 0, pos[lane] % block_size, 0))
+        complete = (pos[lane] + 1) % block_size == 0
+        dst = table[lane, pos[lane] // block_size]
+
+        def _commit(ps, row=row, dst=dst, tail=tail):
+            pool, scales = ps
+            tile = jax.lax.dynamic_slice(
+                tail, (li, row, 0, 0, 0), (1, 1, hkv, block_size, d2))
+            codes, scale = quantize_kv(tile)
+            return (jax.lax.dynamic_update_slice(pool, codes,
+                                                 (li, dst, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(scales, scale,
+                                                 (li, dst, 0)))
+
+        pool, scales = jax.lax.cond(complete, _commit, lambda ps: ps,
+                                    (pool, scales))
+    return pool, scales, tail
+
+
+def _gather_lane_view_quant(pool: jax.Array, scales: jax.Array,
+                            tail: jax.Array, table: jax.Array,
+                            li: jax.Array, wb: jax.Array) -> jax.Array:
+    """:func:`_gather_lane_view` for the INT8 pool: gather codes AND
+    scales through the block tables, dequantize, then substitute lane
+    b's bf16 staging tail for its write-frontier block ``wb[b]`` — the
+    partial block's exact rows live in the tail, not the pool.  Columns
+    past the fill are masked by the caller's attention mask exactly as
+    in the bf16 view (stale tail rows are finite, so masked columns
+    still contribute exact zeros)."""
+    layer = jax.lax.dynamic_index_in_dim(pool, li, 0, keepdims=False)
+    sl = jax.lax.dynamic_index_in_dim(scales, li, 0, keepdims=False)
+    tl = jax.lax.dynamic_index_in_dim(tail, li, 0, keepdims=False)
+    b, m = table.shape
+    _, h, bs, d = layer.shape
+    v = jnp.take(layer, table.reshape(-1), axis=0)      # [B*M, H, bs, D]
+    s = jnp.take(sl, table.reshape(-1), axis=0)         # [B*M, H]
+    deq = v.astype(jnp.float32) * s[..., None, None]
+    deq = deq.reshape(b, m, h, bs, d).transpose(0, 2, 1, 3, 4)
+    deq = deq.reshape(b, h, m * bs, d)
+    lt = tl[:b].astype(jnp.float32)                     # [B, H, bs, D]
+    tiled = jnp.tile(lt, (1, 1, m, 1))                  # [B, H, m*bs, D]
+    use_tail = (jnp.arange(m * bs) // bs)[None, :] == wb[:, None]
+    out = jnp.where(use_tail[:, None, :, None], tiled, deq)
+    return out.astype(tail.dtype)
 
 
 def _gather_lane_view(pool: jax.Array, table: jax.Array,
@@ -502,14 +648,25 @@ def _attend_einsum(cfg: LlamaConfig, q: jax.Array, k_view: jax.Array,
 
 def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
                        tok: jax.Array, cache: Dict[str, jax.Array],
-                       table: jax.Array, mesh=None
+                       table: jax.Array, mesh=None, quant: bool = False,
+                       active: Optional[jax.Array] = None
                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """batcher._ring_forward over the paged pool: tok [B] at per-lane
     cache['pos'] -> (logits [B, V], advanced cache).  The pools ride
     the layer scan as CARRY (block ids are dynamic; slicing a layer out
     per step would materialize it anyway), the kernel path hands the
     stacked pools + table to paged_decode_attention, the einsum path
-    gathers the lane view per layer."""
+    gathers the lane view per layer.
+
+    ``quant=True`` (SERVE_KV_QUANT=int8): the cache is the codes+scales
+    +staging-tails dict (init_paged_cache quant) — new rows accumulate
+    exact in the lane's bf16 tail and quantize into the pool on block
+    completion (:func:`_write_token_quant`); attention reads codes with
+    the dequant fused in-kernel (or the dequantizing gather view on the
+    einsum path).  ``active`` [B] redirects inactive lanes' tail writes
+    to the trash tail — a mid-prefill lane's tail is live state the
+    resident chunk step must not touch (the tail analogue of masking
+    prefill-pending table rows to the trash block)."""
     from paddle_operator_tpu.infer.executor import _qkv_ring
 
     pos = cache["pos"]
@@ -522,6 +679,10 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     use_sharded = D._use_sharded_kernel(cfg, mesh, attn_impl)
     if D.mesh_tp(mesh) > 1 and not use_sharded:
         attn_impl = "xla"
+    if quant:
+        return _paged_ring_forward_quant(
+            cfg, params, x, cache, table, pos, block_size, cos, sin,
+            attn_impl, use_sharded, active, mesh)
     if use_sharded:
         from paddle_operator_tpu.ops.decode_attention import (
             sharded_paged_decode_attention,
@@ -586,10 +747,106 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     return logits[:, 0], {"k": k_new, "v": v_new, "pos": pos + 1}
 
 
+def _paged_ring_forward_quant(cfg, params, x, cache, table, pos,
+                              block_size, cos, sin, attn_impl,
+                              use_sharded, active, mesh):
+    """The quantized-pool decode forward (split out of
+    :func:`paged_ring_forward` so the bf16 path stays byte-identical):
+    same layer math, with the token write going through the staging
+    tail (:func:`_write_token_quant`) and the attention reading int8
+    codes — fused-dequant kernel where eligible, dequantizing gather
+    view on the einsum path."""
+    from paddle_operator_tpu.infer.executor import _qkv_ring
+
+    b = x.shape[0]
+    hq, d = cfg.n_heads, cfg.head_dim
+    trash_row = cache["kt"].shape[1] - 1
+    lanes = jnp.arange(b)
+    rows_idx = (jnp.where(active, lanes, trash_row)
+                if active is not None else lanes)
+
+    if use_sharded:
+        from paddle_operator_tpu.ops.decode_attention import (
+            sharded_paged_decode_attention,
+        )
+
+        def body(carry, layer_in):
+            x, kc, vc, ks, vs, kt, vt = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc, ks, kt = _write_token_quant(
+                kc, ks, kt, k.transpose(0, 2, 1, 3), li, table, pos,
+                rows_idx, block_size)
+            vc, vs, vt = _write_token_quant(
+                vc, vs, vt, v.transpose(0, 2, 1, 3), li, table, pos,
+                rows_idx, block_size)
+            proj = sharded_paged_decode_attention(
+                mesh, q[:, 0], kc, vc, table, pos + 1,
+                lp["attn"]["wo"]["kernel"], layer=li,
+                interpret=(attn_impl == "pallas-interpret"),
+                compute_dtype=cfg.dtype,
+                k_scale=ks, v_scale=vs, k_tail=kt, v_tail=vt)
+            x = x + proj[:, None].astype(cfg.dtype)
+            return (D._ffn_residual(cfg, lp, x), kc, vc, ks, vs,
+                    kt, vt), ()
+    elif attn_impl != "xla":
+        from paddle_operator_tpu.ops.decode_attention import (
+            paged_decode_attention,
+        )
+
+        def body(carry, layer_in):
+            x, kc, vc, ks, vs, kt, vt = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc, ks, kt = _write_token_quant(
+                kc, ks, kt, k.transpose(0, 2, 1, 3), li, table, pos,
+                rows_idx, block_size)
+            vc, vs, vt = _write_token_quant(
+                vc, vs, vt, v.transpose(0, 2, 1, 3), li, table, pos,
+                rows_idx, block_size)
+            out = paged_decode_attention(
+                q[:, 0], kc, vc, table, pos + 1, layer=li,
+                interpret=(attn_impl == "pallas-interpret"),
+                k_scale=ks, v_scale=vs, k_tail=kt, v_tail=vt)
+            out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
+            return (D._finish_layer(cfg, lp, x, out), kc, vc, ks, vs,
+                    kt, vt), ()
+    else:
+        wb = pos // block_size
+
+        def body(carry, layer_in):
+            x, kc, vc, ks, vs, kt, vt = carry
+            lp, li = layer_in
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            kc, ks, kt = _write_token_quant(
+                kc, ks, kt, k.transpose(0, 2, 1, 3), li, table, pos,
+                rows_idx, block_size)
+            vc, vs, vt = _write_token_quant(
+                vc, vs, vt, v.transpose(0, 2, 1, 3), li, table, pos,
+                rows_idx, block_size)
+            out = _attend_einsum(
+                cfg, q, _gather_lane_view_quant(kc, ks, kt, table, li, wb),
+                _gather_lane_view_quant(vc, vs, vt, table, li, wb), pos)
+            return (D._finish_layer(cfg, lp, x, out), kc, vc, ks, vs,
+                    kt, vt), ()
+
+    (x, k_new, v_new, ks_new, vs_new, kt_new, vt_new), _ = jax.lax.scan(
+        body, (x, cache["k"], cache["v"], cache["ks"], cache["vs"],
+               cache["kt"], cache["vt"]),
+        (params["layers"], jnp.arange(cfg.n_layers)))
+    x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    logits = D._mm(x, params["lm_head"]["kernel"],
+                   cfg.dtype).astype(jnp.float32)
+    return logits[:, 0], {"k": k_new, "v": v_new, "ks": ks_new,
+                          "vs": vs_new, "kt": kt_new, "vt": vt_new,
+                          "pos": pos + 1}
+
+
 def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                           top_k: Optional[int] = None,
                           top_p: Optional[float] = None, mesh=None,
-                          check_finite: bool = False):
+                          check_finite: bool = False,
+                          quant: bool = False):
     """The resident compiled decode program of the PAGED ring — the
     exact contract of batcher.make_chunk_step plus the block table:
 
@@ -603,7 +860,11 @@ def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
 
     ``check_finite=True``: a fourth ``ok [B]`` output — the per-lane
     isfinite fold of every tick's logits (batcher NaN-lane quarantine;
-    see make_chunk_step)."""
+    see make_chunk_step).
+
+    ``quant=True``: the cache is the int8 codes+scales+tails dict;
+    ``active`` additionally steers inactive lanes' tail writes to the
+    trash tail (see paged_ring_forward)."""
     from paddle_operator_tpu.infer.executor import _sample_tokens
 
     def step(params, cache, table, tok, temp, keys, active):
@@ -612,8 +873,9 @@ def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                 cache, tok, ok = carry
             else:
                 cache, tok = carry
-            logits, new_cache = paged_ring_forward(cfg, params, tok, cache,
-                                                   table, mesh=mesh)
+            logits, new_cache = paged_ring_forward(
+                cfg, params, tok, cache, table, mesh=mesh, quant=quant,
+                active=active if quant else None)
             nxt = _sample_tokens(logits, temp, keys, cache["pos"],
                                  top_k, top_p)
             new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
@@ -653,12 +915,17 @@ def _scatter_prompt_blocks(pool: jax.Array, lane: jax.Array,
 def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
                               block_size: int,
                               top_k: Optional[int] = None,
-                              top_p: Optional[float] = None, mesh=None):
+                              top_p: Optional[float] = None, mesh=None,
+                              quant: bool = False):
     """Cold (no prefix hit) paged admission — the contiguous
     make_prefill_insert with the splice replaced by a block scatter.
     The prefill forward and first-token sample are the SAME compiled
     ops as the contiguous insert, which is what makes the first token
     bit-identical between the two rings.
+
+    ``quant=True``: whole blocks quantize once into the int8 pool; the
+    prompt's partial last block lands exact in the lane's staging tail
+    (decode.paged_prefill quant contract).
 
     ``insert(params, cache, table_row, tok, temp, keys,
     prompt [1,bucket], prompt_len, slot, temp_val, seed)
@@ -672,10 +939,20 @@ def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
 
     def insert(params, cache, table_row, tok, temp, keys, prompt,
                prompt_len, slot, temp_val, seed):
-        logits, new_cache = D.paged_prefill(params, cfg, prompt, cache,
-                                            table_row,
-                                            block_size=block_size,
-                                            mesh=mesh)
+        if quant:
+            logits, new_cache, tail_k, tail_v = D.paged_prefill(
+                params, cfg, prompt, cache, table_row,
+                block_size=block_size, mesh=mesh, quant=True,
+                prompt_len=prompt_len)
+            new_cache["kt"] = jax.lax.dynamic_update_slice(
+                new_cache["kt"], tail_k, (0, slot, 0, 0, 0))
+            new_cache["vt"] = jax.lax.dynamic_update_slice(
+                new_cache["vt"], tail_v, (0, slot, 0, 0, 0))
+        else:
+            logits, new_cache = D.paged_prefill(params, cfg, prompt,
+                                                cache, table_row,
+                                                block_size=block_size,
+                                                mesh=mesh)
         logits = logits[0, prompt_len - 1]
         new_cache["pos"] = new_cache["pos"].at[slot].set(prompt_len)
         key = jax.random.PRNGKey(seed)
@@ -692,10 +969,36 @@ def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
     return jax.jit(insert, donate_argnums=(1, 3, 4, 5))
 
 
+def _slice_lane_tails(cache: Dict[str, jax.Array], slot):
+    """One lane's staging tails as 2-row mini-arrays (row 0 = the lane,
+    row 1 = a zeroed trash row) for a batch-of-one quant forward —
+    _multi_forward_paged addresses tails by lane index with the LAST
+    row as trash, so a B=1 call needs exactly this shape."""
+    lcount, _, h, bs, d = cache["kt"].shape
+    mk = jax.lax.dynamic_slice(cache["kt"], (0, slot, 0, 0, 0),
+                               (lcount, 1, h, bs, d))
+    mv = jax.lax.dynamic_slice(cache["vt"], (0, slot, 0, 0, 0),
+                               (lcount, 1, h, bs, d))
+    return (jnp.concatenate([mk, jnp.zeros_like(mk)], axis=1),
+            jnp.concatenate([mv, jnp.zeros_like(mv)], axis=1))
+
+
+def _restore_lane_tails(cache: Dict[str, jax.Array],
+                        new_lane: Dict[str, jax.Array], slot):
+    """Write a B=1 quant forward's mini-tail row back into the full
+    per-slot tail arrays."""
+    kt = jax.lax.dynamic_update_slice(
+        cache["kt"], new_lane["kt"][:, :1], (0, slot, 0, 0, 0))
+    vt = jax.lax.dynamic_update_slice(
+        cache["vt"], new_lane["vt"][:, :1], (0, slot, 0, 0, 0))
+    return kt, vt
+
+
 def make_paged_suffix_insert(cfg: LlamaConfig, suffix_bucket: int,
                              block_size: int,
                              top_k: Optional[int] = None,
-                             top_p: Optional[float] = None, mesh=None):
+                             top_p: Optional[float] = None, mesh=None,
+                             quant: bool = False):
     """Prefix-HIT paged admission: the lane's table already maps the
     cached prefix blocks (read-only; CoW'd where the suffix will
     write), so the forward runs over the SUFFIX ONLY — a multi-token
@@ -703,6 +1006,12 @@ def make_paged_suffix_insert(cfg: LlamaConfig, suffix_bucket: int,
     attention walks the block table.  A shared 2048-token system prompt
     costs its followers exactly the suffix; the prefill-call counter
     the tests assert on never ticks for the cached prefix.
+
+    ``quant=True``: the suffix rows accumulate in the lane's staging
+    tail (sliced to a 2-row mini-tail for the B=1 forward) and whole
+    blocks quantize on completion; the CoW'd hit block's content must
+    already be dequantized into the tail by the scheduler's tail-init
+    dispatch when ``hit_len`` lands mid-block.
 
     ``insert(params, cache, table_row [M], tok, temp, keys,
     suffix [1, suffix_bucket], suffix_len, hit_len, slot, temp_val,
@@ -716,12 +1025,21 @@ def make_paged_suffix_insert(cfg: LlamaConfig, suffix_bucket: int,
         prompt_len = hit_len + suffix_len
         lane_cache = {"k": cache["k"], "v": cache["v"],
                       "pos": jnp.reshape(hit_len, (1,))}
+        if quant:
+            lane_cache["ks"], lane_cache["vs"] = cache["ks"], cache["vs"]
+            lane_cache["kt"], lane_cache["vt"] = _slice_lane_tails(
+                cache, slot)
         logits, new_lane = _multi_forward_paged(
             cfg, params, suffix, lane_cache, table_row[None, :],
-            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh)
+            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh, quant=quant)
         logits = logits[0, suffix_len - 1]
         new_cache = {"k": new_lane["k"], "v": new_lane["v"],
                      "pos": cache["pos"].at[slot].set(prompt_len)}
+        if quant:
+            new_cache["ks"], new_cache["vs"] = (new_lane["ks"],
+                                                new_lane["vs"])
+            new_cache["kt"], new_cache["vt"] = _restore_lane_tails(
+                cache, new_lane, slot)
         key = jax.random.PRNGKey(seed)
         first = _sample_tokens(
             logits[None], jnp.reshape(temp_val, (1,)).astype(jnp.float32),
@@ -740,11 +1058,13 @@ def make_paged_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
                                    bucket: int, block_size: int,
                                    top_k: Optional[int] = None,
                                    top_p: Optional[float] = None,
-                                   mesh=None):
+                                   mesh=None, quant: bool = False):
     """Speculative paged admission: target prefill scatters into the
     pool, the DRAFT lane stays a contiguous ring splice (the draft
     cache is small — paging it buys nothing, and the draft's propose
-    loop keeps the fast contiguous write path).
+    loop keeps the fast contiguous write path).  ``quant=True``
+    quantizes the TARGET pool only — the draft ring stays bf16, the
+    same asymmetry (infer/speculative.py docstring).
 
     ``insert(params, dparams, cache, dcache, table_row, tok, temp,
     keys, prompt, prompt_len, slot, temp_val, seed)
@@ -761,10 +1081,20 @@ def make_paged_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
 
     def insert(params, dparams, cache, dcache, table_row, tok, temp, keys,
                prompt, prompt_len, slot, temp_val, seed):
-        logits, new_cache = D.paged_prefill(params, cfg, prompt, cache,
-                                            table_row,
-                                            block_size=block_size,
-                                            mesh=mesh)
+        if quant:
+            logits, new_cache, tail_k, tail_v = D.paged_prefill(
+                params, cfg, prompt, cache, table_row,
+                block_size=block_size, mesh=mesh, quant=True,
+                prompt_len=prompt_len)
+            new_cache["kt"] = jax.lax.dynamic_update_slice(
+                new_cache["kt"], tail_k, (0, slot, 0, 0, 0))
+            new_cache["vt"] = jax.lax.dynamic_update_slice(
+                new_cache["vt"], tail_v, (0, slot, 0, 0, 0))
+        else:
+            logits, new_cache = D.paged_prefill(params, cfg, prompt,
+                                                cache, table_row,
+                                                block_size=block_size,
+                                                mesh=mesh)
         logits = logits[0, prompt_len - 1]
         new_cache["pos"] = new_cache["pos"].at[slot].set(prompt_len)
         dlane = D.init_cache(dcfg, 1, bucket)
@@ -786,7 +1116,8 @@ def make_paged_spec_prefill_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
 
 
 def make_paged_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
-                             block_size: int, mesh=None):
+                             block_size: int, mesh=None,
+                             quant: bool = False):
     """One INTERMEDIATE chunked-prefill slice against the block pool
     (executor/scheduler ``prefill_mode="chunked"``): append the slice's
     KV rows at absolute positions [start, start + slice_bucket) through
@@ -798,6 +1129,11 @@ def make_paged_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
 
     ``chunk(params, cache, table_row [M], toks [1, slice_bucket],
     start, limit) -> cache'``
+
+    ``quant=True`` adds a trailing ``slot`` argument (the tail rows
+    address by lane): slices accumulate in the lane's staging tail and
+    quantize whole blocks as they complete, so the tail state carried
+    between slices IS the cache dict's — no extra bookkeeping.
     """
     from paddle_operator_tpu.infer.speculative import _multi_forward_paged
 
@@ -809,7 +1145,22 @@ def make_paged_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
             limit=jnp.reshape(limit, (1,)), mesh=mesh, head=False)
         return {"k": new["k"], "v": new["v"], "pos": cache["pos"]}
 
-    return jax.jit(chunk, donate_argnums=(1,))
+    def chunk_quant(params, cache, table_row, toks, start, limit, slot):
+        mk, mv = _slice_lane_tails(cache, slot)
+        lane_cache = {"k": cache["k"], "v": cache["v"],
+                      "ks": cache["ks"], "vs": cache["vs"],
+                      "kt": mk, "vt": mv,
+                      "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
+        _, new = _multi_forward_paged(
+            cfg, params, toks, lane_cache, table_row[None, :],
+            limit=jnp.reshape(limit, (1,)), mesh=mesh, head=False,
+            quant=True)
+        kt, vt = _restore_lane_tails(cache, new, slot)
+        return {"k": new["k"], "v": new["v"], "ks": new["ks"],
+                "vs": new["vs"], "kt": kt, "vt": vt,
+                "pos": cache["pos"]}
+
+    return jax.jit(chunk_quant if quant else chunk, donate_argnums=(1,))
 
 
 def make_paged_spec_suffix_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
@@ -817,7 +1168,7 @@ def make_paged_spec_suffix_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
                                   block_size: int,
                                   top_k: Optional[int] = None,
                                   top_p: Optional[float] = None,
-                                  mesh=None):
+                                  mesh=None, quant: bool = False):
     """Final chunked-prefill slice for the SPECULATIVE paged ring: the
     target's remaining suffix rows ride the block table exactly like
     :func:`make_paged_suffix_insert`; the DRAFT prefills its whole
@@ -840,12 +1191,21 @@ def make_paged_spec_suffix_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
                prompt_len, temp_val, seed):
         lane_cache = {"k": cache["k"], "v": cache["v"],
                       "pos": jnp.reshape(hit_len, (1,))}
+        if quant:
+            lane_cache["ks"], lane_cache["vs"] = cache["ks"], cache["vs"]
+            lane_cache["kt"], lane_cache["vt"] = _slice_lane_tails(
+                cache, slot)
         logits, new_lane = _multi_forward_paged(
             cfg, params, suffix, lane_cache, table_row[None, :],
-            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh)
+            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh, quant=quant)
         logits = logits[0, suffix_len - 1]
         new_cache = {"k": new_lane["k"], "v": new_lane["v"],
                      "pos": cache["pos"].at[slot].set(prompt_len)}
+        if quant:
+            new_cache["ks"], new_cache["vs"] = (new_lane["ks"],
+                                                new_lane["vs"])
+            new_cache["kt"], new_cache["vt"] = _restore_lane_tails(
+                cache, new_lane, slot)
         dlane = D.init_cache(dcfg, 1, bucket)
         _, dlane = D._forward(dcfg, dparams, prompt, dlane,
                               last_only=True, mesh=mesh)
@@ -865,7 +1225,7 @@ def make_paged_spec_suffix_insert(cfg: LlamaConfig, dcfg: LlamaConfig,
 
 
 @functools.lru_cache(maxsize=8)
-def make_pool_transfer(max_blocks: int):
+def make_pool_transfer(max_blocks: int, quant: bool = False):
     """The disaggregated HANDOFF op: copy ``max_blocks`` pool blocks
     from the prefill executor's (small, private) pool into the decode
     pool — all layers, K and V, one donated jit.  Block-id vectors are
@@ -877,6 +1237,15 @@ def make_pool_transfer(max_blocks: int):
 
     ``transfer(dst_k, dst_v, src_k, src_v, src_ids [M], dst_ids [M])
     -> (dst_k', dst_v')``
+
+    ``quant=True``: codes, scales AND the prompt's staging tail all
+    cross (the tail is the partial last block the prefill executor
+    could not finalize) — src tail row 0 (the executor pool is one
+    lane wide) lands in decode tail row ``slot``:
+
+    ``transfer(dst_k, dst_v, dst_ks, dst_vs, dst_kt, dst_vt,
+    src_k, src_v, src_ks, src_vs, src_kt, src_vt, src_ids, dst_ids,
+    slot) -> (dst_k', dst_v', dst_ks', dst_vs', dst_kt', dst_vt')``
     """
 
     def transfer(dst_k, dst_v, src_k, src_v, src_ids, dst_ids):
@@ -885,15 +1254,33 @@ def make_pool_transfer(max_blocks: int):
         return (dst_k.at[:, dst_ids].set(gk),
                 dst_v.at[:, dst_ids].set(gv))
 
+    def transfer_quant(dst_k, dst_v, dst_ks, dst_vs, dst_kt, dst_vt,
+                       src_k, src_v, src_ks, src_vs, src_kt, src_vt,
+                       src_ids, dst_ids, slot):
+        dst_k, dst_v = transfer(dst_k, dst_v, src_k, src_v, src_ids,
+                                dst_ids)
+        dst_ks = dst_ks.at[:, dst_ids].set(
+            jnp.take(src_ks, src_ids, axis=1))
+        dst_vs = dst_vs.at[:, dst_ids].set(
+            jnp.take(src_vs, src_ids, axis=1))
+        dst_kt = jax.lax.dynamic_update_slice(
+            dst_kt, src_kt[:, :1], (0, slot, 0, 0, 0))
+        dst_vt = jax.lax.dynamic_update_slice(
+            dst_vt, src_vt[:, :1], (0, slot, 0, 0, 0))
+        return dst_k, dst_v, dst_ks, dst_vs, dst_kt, dst_vt
+
+    if quant:
+        return jax.jit(transfer_quant, donate_argnums=(0, 1, 2, 3, 4, 5))
     return jax.jit(transfer, donate_argnums=(0, 1))
 
 
 @functools.lru_cache(maxsize=4)
-def make_block_copier():
+def make_block_copier(quant: bool = False):
     """The CoW device op: copy pool block ``src`` over block ``dst``
     (all layers, K and V) in one donated jit — dispatched once per
     copy-on-write admission, BEFORE the admission insert, so the
-    insert's gather reads the private copy."""
+    insert's gather reads the private copy.  ``quant=True`` copies
+    codes AND scales: ``cp(k, v, ks, vs, src, dst)``."""
 
     def cp(k, v, src, dst):
         ks = jax.lax.dynamic_slice_in_dim(k, src, 1, axis=1)
@@ -902,4 +1289,46 @@ def make_block_copier():
         v = jax.lax.dynamic_update_slice_in_dim(v, vs, dst, axis=1)
         return k, v
 
+    def cp_quant(k, v, ks, vs, src, dst):
+        k, v = cp(k, v, src, dst)
+        kss = jax.lax.dynamic_slice_in_dim(ks, src, 1, axis=1)
+        vss = jax.lax.dynamic_slice_in_dim(vs, src, 1, axis=1)
+        ks = jax.lax.dynamic_update_slice_in_dim(ks, kss, dst, axis=1)
+        vs = jax.lax.dynamic_update_slice_in_dim(vs, vss, dst, axis=1)
+        return k, v, ks, vs
+
+    if quant:
+        return jax.jit(cp_quant, donate_argnums=(0, 1, 2, 3))
     return jax.jit(cp, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=4)
+def make_tail_init():
+    """Quant-pool admission helper: a lane starting MID-BLOCK (a
+    partial-tail radix hit, or a full hit capped at n-1 tokens) will
+    write into a block that already holds quantized content (its CoW'd
+    private copy) — seed the lane's bf16 staging tail with that block's
+    DEQUANTIZED rows so the suffix forward reads [block_start, hit_len)
+    exactly as every other reader does, then overwrites from hit_len
+    on.  One tiny donated dispatch, scheduler-side, after the CoW copy.
+
+    ``init(kt, vt, k, ks, v, vs, slot, blk) -> (kt', vt')``
+    """
+
+    def init(kt, vt, k, ks, v, vs, slot, blk):
+        lcount, _, h, bs, d = kt.shape
+        ktile = dequantize_kv(
+            jax.lax.dynamic_slice(k, (0, blk, 0, 0, 0),
+                                  (lcount, 1, h, bs, d)),
+            jax.lax.dynamic_slice(ks, (0, blk, 0), (lcount, 1, h)),
+            kt.dtype)
+        vtile = dequantize_kv(
+            jax.lax.dynamic_slice(v, (0, blk, 0, 0, 0),
+                                  (lcount, 1, h, bs, d)),
+            jax.lax.dynamic_slice(vs, (0, blk, 0), (lcount, 1, h)),
+            vt.dtype)
+        kt = jax.lax.dynamic_update_slice(kt, ktile, (0, slot, 0, 0, 0))
+        vt = jax.lax.dynamic_update_slice(vt, vtile, (0, slot, 0, 0, 0))
+        return kt, vt
+
+    return jax.jit(init, donate_argnums=(0, 1))

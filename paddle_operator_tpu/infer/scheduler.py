@@ -220,6 +220,7 @@ class ContinuousBatcher:
                  prefill_mode: str = "inline",
                  prefill_chunk: int = 64,
                  prewarm: bool = False,
+                 kv_quant: str = "none",
                  resilience: Optional[RingResilience] = None) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
@@ -275,9 +276,10 @@ class ContinuousBatcher:
             spec_k=spec_k, paged=paged, block_size=block_size,
             num_blocks=num_blocks, prefix_cache=prefix_cache,
             prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
-            check_finite=self._check_finite)
+            check_finite=self._check_finite, kv_quant=kv_quant)
         self.mesh = mesh
         self.paged = self.executor.paged
+        self.kv_quant = self.executor.kv_quant
         self.spec_k = self.executor.spec_k
         self.draft_cfg = self.executor.draft_cfg
         self._top_k, self._top_p = top_k, top_p
@@ -616,6 +618,12 @@ class ContinuousBatcher:
             # in interleaved chunked slices
             "prefillMode": self.prefill_mode,
             "prefillQueueDepth": self.prefill_queue_depth(),
+            # quantized-pool visibility (SERVE_KV_QUANT): which storage
+            # mode the pool runs and its device bytes (codes + scales +
+            # staging tails, or the bf16 pool/ring) — the capacity an
+            # operator sizes num_blocks against
+            "kvQuantMode": self.kv_quant,
+            "kvPoolBytes": self.executor.pool_bytes(),
             "chunkedPrefillTokenShare": (
                 round(self.stats["chunked_prefill_tokens"] / pf_tok, 4)
                 if pf_tok else 0.0),
@@ -776,6 +784,33 @@ class ContinuousBatcher:
                 return b
         raise ValueError(f"no bucket fits prompt length {n}")
 
+    def _dispatch_cow(self, slot: int, cow, hit_len: int) -> None:
+        """Dispatch the admission's copy-on-write block copies (codes +
+        scales under SERVE_KV_QUANT=int8), then — quant only — seed the
+        lane's bf16 staging tail when the radix hit lands MID-BLOCK:
+        the lane's write-frontier block already holds quantized prefix
+        rows (its CoW'd private copy), and both the suffix forward's
+        tail-substituted read of [block_start, hit_len) and the
+        eventual on-completion requantize of the WHOLE block need those
+        rows present in the tail (paged.make_tail_init)."""
+        ex = self.executor
+        if ex.quant:
+            for src, dst in cow:
+                (ex.cache["k"], ex.cache["v"], ex.cache["ks"],
+                 ex.cache["vs"]) = ex._copy_block(
+                    ex.cache["k"], ex.cache["v"], ex.cache["ks"],
+                    ex.cache["vs"], src, dst)
+        else:
+            for src, dst in cow:
+                ex.cache["k"], ex.cache["v"] = ex._copy_block(
+                    ex.cache["k"], ex.cache["v"], src, dst)
+        self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+        if ex.quant and hit_len % self.block_size:
+            blk = int(self.pool.table[slot][hit_len // self.block_size])
+            ex.cache["kt"], ex.cache["vt"] = ex._tail_init(
+                ex.cache["kt"], ex.cache["vt"], ex.cache["k"],
+                ex.cache["ks"], ex.cache["v"], ex.cache["vs"], slot, blk)
+
     def _activate(self, slot: int, req: _Request, first) -> None:
         """A lane's prefill completed (whatever path delivered it):
         wire up the decode-side bookkeeping so the next chunk dispatch
@@ -866,10 +901,7 @@ class ContinuousBatcher:
         # (never written over) when spec mode is off
         hit_len, cow = self.pool.admit(          # NoFreeBlocks -> req fails
             slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
-        for src, dst in cow:
-            ex.cache["k"], ex.cache["v"] = ex._copy_block(
-                ex.cache["k"], ex.cache["v"], src, dst)
-        self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+        self._dispatch_cow(slot, cow, hit_len)
         tbl_row = jnp.asarray(self.pool.table[slot])
         if self.spec_k:
             (ex.cache, ex.dcache, ex.tok, ex.temp, ex.keys,
@@ -922,10 +954,7 @@ class ContinuousBatcher:
         if self.paged:
             hit_len, cow = self.pool.admit(
                 slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
-            for src, dst in cow:
-                ex.cache["k"], ex.cache["v"] = ex._copy_block(
-                    ex.cache["k"], ex.cache["v"], src, dst)
-            self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+            self._dispatch_cow(slot, cow, hit_len)
             lane_k = lane_v = None
         else:
             lane_k, lane_v = ex.make_staging(req.bucket)
@@ -950,9 +979,11 @@ class ContinuousBatcher:
             toks[0, :] = req.prompt[st.start:st.start + sb]
             if self.paged:
                 tbl_row = jnp.asarray(self.pool.table[slot])
-                ex.cache = ex.chunk_prog(None)(
-                    ex.params, ex.cache, tbl_row, jnp.asarray(toks),
-                    st.start, st.start + sb)
+                args = (ex.params, ex.cache, tbl_row, jnp.asarray(toks),
+                        st.start, st.start + sb)
+                if ex.quant:    # quant slices address the lane's tail
+                    args += (slot,)
+                ex.cache = ex.chunk_prog(None)(*args)
             else:
                 sl = ex.staging_len(req.bucket)
                 st.lane_k, st.lane_v = ex.chunk_prog(sl)(
@@ -1013,11 +1044,7 @@ class ContinuousBatcher:
         hit_len, cow = self.pool.admit(
             slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
         if hit_len and not self.spec_k:
-            ex = self.executor
-            for src, dst in cow:
-                ex.cache["k"], ex.cache["v"] = ex._copy_block(
-                    ex.cache["k"], ex.cache["v"], src, dst)
-            self.stats["cow_copies"] = self.pool.stats["cow_copies"]
+            self._dispatch_cow(slot, cow, hit_len)
             first = self._suffix_admit(
                 slot, req, jnp.asarray(self.pool.table[slot]), hit_len)
             self.pool.publish(slot, req.prompt)
@@ -1051,7 +1078,7 @@ class ContinuousBatcher:
                 self._finish(req, item[2])
                 self._evict(slot)
                 continue
-            _, _, src_k, src_v, n_blocks, first = item
+            _, _, snap, n_blocks, first = item
             n = len(req.prompt)
             # src blocks are the executor's fixed identity row 1..M;
             # dst blocks were mapped at admission.  Both id vectors pad
@@ -1063,9 +1090,22 @@ class ContinuousBatcher:
             dst_ids = np.zeros((m,), np.int32)
             src_ids[:n_blocks] = np.arange(1, n_blocks + 1)
             dst_ids[:n_blocks] = self.pool.table[slot][:n_blocks]
-            ex.cache["k"], ex.cache["v"] = ex._transfer(
-                ex.cache["k"], ex.cache["v"], src_k, src_v,
-                jnp.asarray(src_ids), jnp.asarray(dst_ids))
+            if ex.quant:
+                # codes, scales AND the prompt's partial-block staging
+                # tail cross the handoff (src tail row 0 — the executor
+                # pool is one lane wide — lands in decode tail ``slot``)
+                (ex.cache["k"], ex.cache["v"], ex.cache["ks"],
+                 ex.cache["vs"], ex.cache["kt"],
+                 ex.cache["vt"]) = ex._transfer(
+                    ex.cache["k"], ex.cache["v"], ex.cache["ks"],
+                    ex.cache["vs"], ex.cache["kt"], ex.cache["vt"],
+                    snap["k"], snap["v"], snap["ks"], snap["vs"],
+                    snap["kt"], snap["vt"], jnp.asarray(src_ids),
+                    jnp.asarray(dst_ids), slot)
+            else:
+                ex.cache["k"], ex.cache["v"] = ex._transfer(
+                    ex.cache["k"], ex.cache["v"], snap["k"], snap["v"],
+                    jnp.asarray(src_ids), jnp.asarray(dst_ids))
             if self.spec_k:
                 (ex.dcache, ex.cache["pos"], ex.tok, ex.temp,
                  ex.keys) = ex.spec_attach(req.bucket)(
@@ -1192,6 +1232,18 @@ class ContinuousBatcher:
             idx = jnp.asarray(blks)
             ex.cache["k"] = ex.cache["k"].at[:, idx].set(0)
             ex.cache["v"] = ex.cache["v"].at[:, idx].set(0)
+            if ex.quant:
+                # reset the victims' scale planes to the all-zero-block
+                # sentinel (paged.quantize_kv): zero codes x a stale
+                # (possibly garbage) scale must still dequantize finite
+                ex.cache["ks"] = ex.cache["ks"].at[:, idx].set(1.0)
+                ex.cache["vs"] = ex.cache["vs"].at[:, idx].set(1.0)
+        if ex.quant:
+            # the lane's bf16 staging tail is private write-frontier
+            # state — the poisoned rows may live ONLY there (an
+            # incomplete block never reached the pool)
+            ex.cache["kt"] = ex.cache["kt"].at[:, slot].set(0)
+            ex.cache["vt"] = ex.cache["vt"].at[:, slot].set(0)
 
     def _consume(self, chunk_reqs, toks, counts=None, ok=None) -> None:
         """Apply one finished chunk's tokens ([chunk, slots] on host).

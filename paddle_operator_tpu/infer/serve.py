@@ -463,7 +463,24 @@ def main() -> int:
         # paging; SERVE_NUM_BLOCKS oversizes/undersizes the pool from
         # its contiguous-HBM-parity default.  SERVE_PAGED=0 (default)
         # keeps the contiguous ring — the parity oracle.
-        if os.environ.get("SERVE_PAGED", "0") == "1":
+        # SERVE_KV_QUANT=int8 (docs/serving.md): store paged pool
+        # blocks as int8 codes + per-(block, kv-head) f32 scales with
+        # the dequant fused into the decode kernels — ~2x resident
+        # lanes per HBM byte at a bounded (~17% v5e) per-step cost;
+        # enable when the deployment is CAPACITY-bound (kv_blocks_free
+        # pinned at 0), keep the default bf16 pool when latency-bound.
+        # Requires the paged ring (the pool block is the quantization
+        # unit), so it implies SERVE_PAGED=1 — with the OTHER paged
+        # knobs (SERVE_BLOCK_SIZE / SERVE_PREFIX_CACHE /
+        # SERVE_NUM_BLOCKS) honored exactly as under an explicit
+        # SERVE_PAGED=1.
+        kvq = os.environ.get("SERVE_KV_QUANT", "none")
+        if kvq != "none":
+            ring_kw["kv_quant"] = kvq
+            if os.environ.get("SERVE_PAGED", "0") != "1":
+                print("SERVE_KV_QUANT implies SERVE_PAGED=1 (the pool "
+                      "block is the quantization unit)", flush=True)
+        if os.environ.get("SERVE_PAGED", "0") == "1" or kvq != "none":
             ring_kw["paged"] = True
             ring_kw["block_size"] = int(
                 os.environ.get("SERVE_BLOCK_SIZE", "256"))
@@ -541,6 +558,7 @@ def main() -> int:
           f"quantize={os.environ.get('QUANTIZE', 'off')}, "
           f"tp={tp}, spec_k={spec_k if continuous else 0}, "
           f"prefill={ring_kw.get('prefill_mode', 'inline') if continuous else '-'}, "
+          f"kv_quant={ring_kw.get('kv_quant', 'none') if continuous else '-'}, "
           f"mode={'continuous' if continuous else 'batch'}) on :{env.port}",
           flush=True)
     srv = make_server("0.0.0.0", env.port, params, cfg,

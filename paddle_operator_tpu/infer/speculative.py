@@ -234,11 +234,127 @@ def _layer_multi_paged(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     return D._finish_layer(cfg, lp, x, out), k_pool, v_pool
 
 
+def _layer_multi_paged_quant(cfg: LlamaConfig, lp: Dict[str, Any],
+                             x: jax.Array, cos: jax.Array, sin: jax.Array,
+                             kc: jax.Array, vc: jax.Array, ks: jax.Array,
+                             vs: jax.Array, kt: jax.Array, vt: jax.Array,
+                             li: jax.Array, table: jax.Array,
+                             pos: jax.Array, limit: Optional[jax.Array],
+                             lane_mask: Optional[jax.Array]):
+    """:func:`_layer_multi_paged` over the QUANTIZED pool
+    (SERVE_KV_QUANT=int8): each new row accumulates EXACT in the lane's
+    bf16 staging tail; a row completing its block quantizes the whole
+    tail block into the int8 pool — codes + one scale, computed once
+    from the full block (the reason the tail exists: per-token
+    requantization would re-derive the scale T times and perturb
+    already-written rows every step).  Rows that are pads (``p >=
+    limit``) or belong to masked lanes (``lane_mask``) redirect to the
+    TRASH tail row (index B) — a pad row writing the lane's real tail
+    would clobber live rows when the pad span wraps the block.  The
+    attention reads the dequantizing gather view: full blocks from the
+    pool, the write-frontier block from the tail."""
+    from paddle_operator_tpu.infer.paged import (
+        _gather_lane_view_quant,
+        quantize_kv,
+    )
+
+    b, t, _ = x.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
+    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    abs_pos = pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
+    cos_b = cos[abs_pos][:, :, None, :]
+    sin_b = sin[abs_pos][:, :, None, :]
+
+    def rot(u):
+        u1, u2 = jnp.split(u.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [u1 * cos_b - u2 * sin_b, u2 * cos_b + u1 * sin_b],
+            axis=-1).astype(u.dtype)
+
+    q, k = rot(q), rot(k)
+    bs = kc.shape[3]
+    kh = k.transpose(0, 2, 1, 3)                             # [B, H, T, D]
+    vh = v.transpose(0, 2, 1, 3)
+    trash_row = kt.shape[1] - 1
+    for lane in range(b):
+        for j in range(t):
+            p = pos[lane] + j
+            real = None
+            if limit is not None:
+                real = p < limit[lane]
+            if lane_mask is not None:
+                real = (lane_mask[lane] if real is None
+                        else real & lane_mask[lane])
+            row = (lane if real is None
+                   else jnp.where(real, lane, trash_row))
+            kt = jax.lax.dynamic_update_slice(
+                kt, kh[lane, :, j][None, None, :, None, :],
+                (li, row, 0, p % bs, 0))
+            vt = jax.lax.dynamic_update_slice(
+                vt, vh[lane, :, j][None, None, :, None, :],
+                (li, row, 0, p % bs, 0))
+            complete = (p + 1) % bs == 0
+            if real is not None:
+                complete = complete & real
+            dst = table[lane, p // bs]
+
+            # block-completion commit behind a cond: only the
+            # 1-in-bs completing row pays the two tile quantizes +
+            # pool writes (same rationale as paged._write_token_quant)
+            def _commit(st, row=row, dst=dst, kt=kt, vt=vt):
+                kc, vc, ks, vs = st
+                ktile = jax.lax.dynamic_slice(
+                    kt, (li, row, 0, 0, 0), (1, 1, hkv, bs, d))
+                kcodes, kscale = quantize_kv(ktile)
+                kc = jax.lax.dynamic_update_slice(kc, kcodes,
+                                                  (li, dst, 0, 0, 0))
+                ks = jax.lax.dynamic_update_slice(ks, kscale,
+                                                  (li, dst, 0))
+                vtile = jax.lax.dynamic_slice(
+                    vt, (li, row, 0, 0, 0), (1, 1, hkv, bs, d))
+                vcodes, vscale = quantize_kv(vtile)
+                vc = jax.lax.dynamic_update_slice(vc, vcodes,
+                                                  (li, dst, 0, 0, 0))
+                vs = jax.lax.dynamic_update_slice(vs, vscale,
+                                                  (li, dst, 0))
+                return kc, vc, ks, vs
+
+            kc, vc, ks, vs = jax.lax.cond(complete, _commit,
+                                          lambda st: st,
+                                          (kc, vc, ks, vs))
+
+    # per-lane write-frontier block: the last REAL row written (pads
+    # never advance the tail), floor 0 for fully-masked lanes
+    lim_eff = limit if limit is not None else pos + t
+    wb = jnp.maximum(jnp.minimum(pos + t, lim_eff) - 1, 0) // bs
+    k_view = _gather_lane_view_quant(kc, ks, kt, table, li, wb)
+    v_view = _gather_lane_view_quant(vc, vs, vt, table, li, wb)
+
+    n_rep = hq // hkv
+    s = k_view.shape[2]
+    qg = q.reshape(b, t, hkv, n_rep, d)
+    scores = jnp.einsum("bthrd,bhsd->bthrs", qg, k_view,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(d))
+    mask = jnp.arange(s)[None, None, :] <= abs_pos[:, :, None]  # [B, T, S]
+    scores = jnp.where(mask[:, :, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bthrs,bhsd->bthrd", probs.astype(cfg.dtype),
+                     v_view, preferred_element_type=jnp.float32)
+    out = out.reshape(b, t, hq * d).astype(cfg.dtype)
+    return D._finish_layer(cfg, lp, x, out), kc, vc, ks, vs, kt, vt
+
+
 def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
                          toks: jax.Array, cache: Dict[str, jax.Array],
                          table: jax.Array,
                          limit: Optional[jax.Array] = None,
-                         mesh=None, head: bool = True
+                         mesh=None, head: bool = True,
+                         quant: bool = False,
+                         lane_mask: Optional[jax.Array] = None
                          ) -> Tuple[Optional[jax.Array],
                                     Dict[str, jax.Array]]:
     """:func:`_multi_forward` with the target cache PAGED: the
@@ -248,23 +364,48 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
     write to the trash block.  The pools ride the layer scan as carry
     (block ids are dynamic).  ``head=False``: KV append only, logits
     None (intermediate chunked-prefill slices,
-    paged.make_paged_prefill_chunk)."""
+    paged.make_paged_prefill_chunk).
+
+    ``quant=True``: the cache is the int8 codes+scales+tails dict and
+    the per-lane staging tails ride the carry too; ``lane_mask`` [B]
+    (the spec round's ``active``) additionally redirects masked lanes'
+    writes to the trash tail — their tail rows may be live prefill
+    state (see :func:`_layer_multi_paged_quant`)."""
     pos = cache["pos"]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
 
-    def body(carry, layer_in):
-        x, kc, vc = carry
-        lp, li = layer_in
-        y, kc, vc = _layer_multi_paged(cfg, lp, x, cos, sin, kc, vc, li,
-                                       table, pos, limit)
-        return (y, kc, vc), ()
+    if quant:
+        def body_q(carry, layer_in):
+            x, kc, vc, ks, vs, kt, vt = carry
+            lp, li = layer_in
+            y, kc, vc, ks, vs, kt, vt = _layer_multi_paged_quant(
+                cfg, lp, x, cos, sin, kc, vc, ks, vs, kt, vt, li,
+                table, pos, limit, lane_mask)
+            return (y, kc, vc, ks, vs, kt, vt), ()
 
-    (x, k_new, v_new), _ = jax.lax.scan(
-        body, (x, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(cfg.n_layers)))
-    new_cache = {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
+        (x, k_new, v_new, ks_new, vs_new, kt_new, vt_new), _ = \
+            jax.lax.scan(
+                body_q,
+                (x, cache["k"], cache["v"], cache["ks"], cache["vs"],
+                 cache["kt"], cache["vt"]),
+                (params["layers"], jnp.arange(cfg.n_layers)))
+        new_cache = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new,
+                     "kt": kt_new, "vt": vt_new,
+                     "pos": pos + toks.shape[1]}
+    else:
+        def body(carry, layer_in):
+            x, kc, vc = carry
+            lp, li = layer_in
+            y, kc, vc = _layer_multi_paged(cfg, lp, x, cos, sin, kc, vc,
+                                           li, table, pos, limit)
+            return (y, kc, vc), ()
+
+        (x, k_new, v_new), _ = jax.lax.scan(
+            body, (x, cache["k"], cache["v"]),
+            (params["layers"], jnp.arange(cfg.n_layers)))
+        new_cache = {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
     if not head:
         return None, new_cache
     x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
@@ -281,7 +422,7 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
 def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
                        top_k: Optional[int] = None,
                        top_p: Optional[float] = None, mesh=None,
-                       paged: bool = False):
+                       paged: bool = False, quant: bool = False):
     """One jitted speculative round over ring-style caches (per-lane
     ``pos`` vectors), BOTH caches donated.
 
@@ -302,7 +443,19 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
     the caches (``round(params, dparams, tcache, dcache, table, ...)``)
     and the verify forward walks it (:func:`_multi_forward_paged`).
     The DRAFT cache stays a contiguous ring either way: its propose
-    loop keeps the fast contiguous write path and pays no paging."""
+    loop keeps the fast contiguous write path and pays no paging.
+
+    ``quant=True`` (with ``paged``): the target pool is the int8
+    codes+scales+tails dict.  The one spec-specific wrinkle is the
+    ROLLBACK: the verify wrote K+1 rows through the staging tail, so a
+    rewind that crosses back over a completed block boundary leaves the
+    tail holding a NEWER block than the lane's write frontier — the
+    round re-seeds such lanes' tails by dequantizing the frontier block
+    from the pool (its rows below the rewound pos are exactly the
+    committed ones; rows above sit behind the fill mask and are
+    overwritten before they become attendable, the standard rollback
+    invariant).  Lanes whose frontier block never completed keep their
+    live tail untouched."""
     from paddle_operator_tpu.infer.executor import _ring_forward
 
     kk = spec_k
@@ -340,7 +493,14 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
         q = jnp.transpose(qdists[:kk], (1, 0, 2))            # [B, K, V]
 
         seq = jnp.concatenate([tok[:, None], drafts], axis=1)  # [B, K+1]
-        if paged:
+        if paged and quant:
+            # quantized target pool: masked lanes' verify rows redirect
+            # to the trash tail (their tail rows may be live prefill
+            # state a resident dispatch must not clobber)
+            tlogits, tcache2 = _multi_forward_paged(
+                cfg, params, seq, tcache, table, mesh=mesh, quant=True,
+                lane_mask=active)
+        elif paged:
             # paged target: the verify forward walks the block table —
             # writes land in pool blocks, attention gathers the lane
             # view (or streams table-mapped blocks on the kernel path)
@@ -402,6 +562,43 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
         # the zeroed table row regardless.
         tcache2["pos"] = jnp.where(active, tpos0 + a + 1, 0)
         dcache2["pos"] = jnp.where(active, dpos0 + a + 1, 0)
+        if paged and quant:
+            # tail resync across a block-crossing rewind (docstring):
+            # re-seed the tail from the pool's frontier block for lanes
+            # whose rewound write block was completed+quantized by the
+            # verify; inactive lanes keep their (possibly live-prefill)
+            # tails untouched
+            from paddle_operator_tpu.infer.paged import dequantize_kv
+
+            bs_q = tcache2["k"].shape[3]
+            wb_after = (tpos0 + kk) // bs_q
+            wb_new = tcache2["pos"] // bs_q
+            need = active & (wb_new < wb_after)
+
+            # behind a cond: a rewind crosses a completed block only
+            # ~spec_k/block_size of rounds (and only on partial
+            # accepts) — the two pool gathers + dequants + full-tail
+            # rewrites must not tax every spec round
+            def _resync(tails):
+                kt, vt = tails
+                blks = jnp.take_along_axis(table, wb_new[:, None],
+                                           axis=1)[:, 0]       # [B]
+                deqk = dequantize_kv(
+                    jnp.take(tcache2["k"], blks, axis=1),
+                    jnp.take(tcache2["ks"], blks, axis=1),
+                    kt.dtype)                           # [L, B, H, bs, D]
+                deqv = dequantize_kv(
+                    jnp.take(tcache2["v"], blks, axis=1),
+                    jnp.take(tcache2["vs"], blks, axis=1),
+                    vt.dtype)
+                sel = need[None, :, None, None, None]
+                kt = kt.at[:, :b].set(jnp.where(sel, deqk, kt[:, :b]))
+                vt = vt.at[:, :b].set(jnp.where(sel, deqv, vt[:, :b]))
+                return kt, vt
+
+            tcache2["kt"], tcache2["vt"] = jax.lax.cond(
+                need.any(), _resync, lambda t: t,
+                (tcache2["kt"], tcache2["vt"]))
         return tcache2, dcache2, tok_out, committed.T, n_commit
 
     if paged:

@@ -46,22 +46,40 @@ This kernel makes decode cost proportional to the FILLED context:
   in storage dtype (bf16 native MXU rate); masking folds the causal/
   fill bound AND the head-match predicate into one -inf write.
 
-**Why not an int8 KV cache** (the r4 review's candidate next lever):
-with this kernel at the DMA roofline, a 256-row bf16 block costs ~2.4us
-of HBM time against ~1.7us of cell compute (two MXU passes + masked
-softmax) — the pipeline hides compute under the DMA.  int8 codes halve
-the DMA to ~1.2us but add a dequantize pass (int8->bf16 convert + scale
-multiply) over every cache element: ~0.55us per tensor per block on the
-8x128 VPU, ~1.1us for K+V, pushing cell compute to ~2.8us > the 1.2us
-DMA — the kernel flips from bandwidth- to compute-bound and net wall
-time GROWS ~17%.  Quantized caches pay on hardware where HBM bytes
-cost more than VPU element-ops (higher BW:VPU ratios, or an MXU int8
-path fed by int8 queries); on v5e the bf16 cache IS the fast
-configuration, which is why ``hbm_util`` at serving shapes (0.54-0.83
-measured) is attacked by skipping unfilled blocks rather than by
-shrinking filled ones.  Weight-only int8 (infer/quant.py) is unaffected
-— weights feed large matmuls where XLA folds the dequant into the
-MXU-bound weight stream.
+**When int8 KV pays** (revised from the r4-era "why not" analysis,
+which was right about the kernel and wrong about the system): at the
+DMA roofline a 256-row bf16 block costs ~2.4us of HBM time against
+~1.7us of cell compute — the pipeline hides compute under the DMA.
+int8 codes halve the DMA to ~1.2us but add a dequantize pass
+(int8->bf16 convert + scale multiply) over every cache element:
+~0.55us per tensor per block on the 8x128 VPU, ~1.1us for K+V, pushing
+cell compute to ~2.8us > the 1.2us DMA — on v5e the kernel flips from
+bandwidth- to compute-bound and PER-STEP wall time grows ~17%.  That
+per-kernel regression is real and bounded; what it buys is CAPACITY:
+the paged pool (infer/paged.py) is the HBM ceiling on resident lanes
+(``measure_paged_serving``/``measure_disagg_serving`` saturate on
+``kv_blocks_free``, not compute), and int8 codes + one f32 scale per
+(block, kv-head) cut pool bytes ~2x, so the same HBM holds ~2x the
+lanes.  Under admission-bound load the AGGREGATE ring throughput
+scales with resident lanes, not per-step latency: bench.py
+``measure_quantized_pool`` measures 1.8x resident-lane capacity at
+fixed pool bytes (codes + scale planes + the bf16 staging tails all
+counted against the budget) buying ~2x aggregate tok/s (1.96-2.4x
+across runs) on this box's admission-bound sweep (summary keys
+``kvq_capacity_ratio``/``kvq_tok_s_ratio``), with the per-step cost
+reported alongside
+(``kvq_step_ms_ratio`` — 0.35-0.5x here, i.e. FASTER, but that is CPU
+einsum physics where bf16 is emulated; on v5e budget the ~17% above).
+So: enable ``SERVE_KV_QUANT=int8`` when deployments are
+capacity-bound (queue depth high, ``kv_blocks_free`` pinned at 0);
+keep the bf16 pool — the default and the parity oracle — when they
+are latency-bound (spare blocks, TTFT-sensitive).  Weight-only int8
+(infer/quant.py) is unaffected either way — weights feed large
+matmuls where XLA folds the dequant into the MXU-bound weight stream.
+The quantized-pool kernel variants below keep the dequant INSIDE the
+cell (codes stream from HBM, scales ride the same index map, the
+lane's bf16 staging tail substitutes for the one partial block), so
+the capacity win never re-materializes a bf16 pool anywhere.
 
 Equivalence is pinned against the XLA einsum path by
 tests/test_decode_attention.py (interpret mode on CPU is exact).
@@ -84,6 +102,47 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 DEFAULT_BLOCK_K = 256
+
+
+def _cell_softmax(qt, k2, v2, ik, length, scale, block_k, n_rep,
+                  acc_ref, m_ref, l_ref):
+    """One grid cell's score matmul + masked online-softmax update —
+    the compute shared verbatim by the bf16 and the dequantizing
+    kernels (factored, not changed: the bf16 op sequence is the one the
+    parity tests pin)."""
+    hq = qt.shape[1]
+    rows = k2.shape[0]
+    # every block row against EVERY query head in one MXU pass;
+    # wrong-head products are masked below (flops are free next to
+    # the 2MB HBM stream this cell must wait for anyway)
+    s = jax.lax.dot_general(
+        k2, qt, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale       # [rows, hq]
+    # softmax bookkeeping in the TRANSPOSED [hq, rows] layout: with
+    # hq ~16, [rows, hq] ops fill 16/128 of each vreg's lanes and
+    # the masked softmax became the cell's critical path (measured
+    # ~225 GB/s); transposed, the same ops are 8x fewer vregs and
+    # the kernel sits on the DMA roofline
+    st = s.T                                              # [hq, rows]
+
+    row_h = jax.lax.broadcasted_iota(jnp.int32, (hq, rows), 0) \
+        // n_rep
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (hq, rows), 1)
+    pos = ik * block_k + col_iota % block_k
+    live = (row_h == col_iota // block_k) & (pos < length)
+    st = jnp.where(live, st, NEG_INF)
+
+    m_prev = m_ref[:, 0]                                  # [hq]
+    m_new = jnp.maximum(m_prev, jnp.max(st, axis=1))
+    corr = jnp.exp(m_prev - m_new)                        # [hq]
+    p = jnp.exp(st - m_new[:, None])                      # [hq, rows]
+    l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
+    m_ref[:, 0] = m_new
+    # [hq, rows] @ [rows, d]: zero cols outside each row's head
+    # segment make this exact — one more MXU pass
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+        p.astype(v2.dtype), v2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
 
 
 def _kernel(len_ref, *refs, scale: float, block_k: int, n_rep: int,
@@ -116,38 +175,8 @@ def _kernel(len_ref, *refs, scale: float, block_k: int, n_rep: int,
         k2 = k_ref[0].reshape(rows, -1)              # [hkv*bk, d]
         v2 = v_ref[0].reshape(rows, -1)
         qt = qt_ref[0]                               # [d, hq]
-
-        # every block row against EVERY query head in one MXU pass;
-        # wrong-head products are masked below (flops are free next to
-        # the 2MB HBM stream this cell must wait for anyway)
-        s = jax.lax.dot_general(
-            k2, qt, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [rows, hq]
-        # softmax bookkeeping in the TRANSPOSED [hq, rows] layout: with
-        # hq ~16, [rows, hq] ops fill 16/128 of each vreg's lanes and
-        # the masked softmax became the cell's critical path (measured
-        # ~225 GB/s); transposed, the same ops are 8x fewer vregs and
-        # the kernel sits on the DMA roofline
-        st = s.T                                     # [hq, rows]
-
-        row_h = jax.lax.broadcasted_iota(jnp.int32, (hq, rows), 0) \
-            // n_rep
-        col_iota = jax.lax.broadcasted_iota(jnp.int32, (hq, rows), 1)
-        pos = ik * block_k + col_iota % block_k
-        live = (row_h == col_iota // block_k) & (pos < length)
-        st = jnp.where(live, st, NEG_INF)
-
-        m_prev = m_ref[:, 0]                         # [hq]
-        m_new = jnp.maximum(m_prev, jnp.max(st, axis=1))
-        corr = jnp.exp(m_prev - m_new)               # [hq]
-        p = jnp.exp(st - m_new[:, None])             # [hq, rows]; dead->0
-        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
-        m_ref[:, 0] = m_new
-        # [hq, rows] @ [rows, d]: zero cols outside each row's head
-        # segment make this exact — one more MXU pass
-        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
-            p.astype(v2.dtype), v2, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        _cell_softmax(qt, k2, v2, ik, length, scale, block_k, n_rep,
+                      acc_ref, m_ref, l_ref)
 
     @pl.when(ik == nk - 1)
     def _finish():
@@ -262,12 +291,81 @@ def _paged_kernel(len_ref, tbl_ref, *refs, scale: float, block_k: int,
             stacked=stacked)
 
 
+def _paged_kernel_quant(len_ref, tbl_ref, *refs, scale: float,
+                        block_k: int, n_rep: int, stacked: bool):
+    """Paged kernel over the INT8 pool with the dequant fused into the
+    cell (SERVE_KV_QUANT=int8, infer/paged.py): the K/V tiles stream
+    from HBM as int8 codes (half the bytes of the bf16 kernel — the
+    capacity story in the module header), the per-(block, kv-head) f32
+    scales ride the SAME table-driven index map as their codes, and the
+    lane's bf16 staging tail (the one partial write block, quantized
+    only on completion) substitutes for the cell at the write frontier
+    — so full blocks are read quantized and the in-progress block is
+    read exact, matching the einsum fallback's view
+    (infer/paged.py ``_gather_lane_view_quant``) element for element.
+    Compute after dequant is byte-for-byte :func:`_cell_softmax`."""
+    del tbl_ref
+    if stacked:
+        (_lay, qt_ref, k_ref, v_ref, ks_ref, vs_ref, kt_ref, vt_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+        k_ref, v_ref = k_ref.at[0], v_ref.at[0]
+        ks_ref, vs_ref = ks_ref.at[0], vs_ref.at[0]
+        kt_ref, vt_ref = kt_ref.at[0], vt_ref.at[0]
+    else:
+        (qt_ref, k_ref, v_ref, ks_ref, vs_ref, kt_ref, vt_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+    b = pl.program_id(0)
+    ik, nk = pl.program_id(1), pl.num_programs(1)
+    length = len_ref[b]
+    hkv = k_ref.shape[1]
+    rows = hkv * block_k
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(ik * block_k < length)
+    def _compute():
+        qt = qt_ref[0]                               # [d, hq]
+        dtype = qt.dtype
+        # the lane's write-frontier block: its rows live in the bf16
+        # staging tail (quantize-on-completion), not the int8 pool
+        wb = jnp.maximum(length - 1, 0) // block_k
+        # per-row scale: row r of the collapsed [hkv*bk, d] tile
+        # belongs to head r // block_k
+        sk = jnp.broadcast_to(ks_ref[0].reshape(hkv, 1),
+                              (hkv, block_k)).reshape(rows, 1)
+        sv = jnp.broadcast_to(vs_ref[0].reshape(hkv, 1),
+                              (hkv, block_k)).reshape(rows, 1)
+        kq = k_ref[0].reshape(rows, -1).astype(jnp.float32) * sk
+        vq = v_ref[0].reshape(rows, -1).astype(jnp.float32) * sv
+        ktl = kt_ref[0].reshape(rows, -1).astype(jnp.float32)
+        vtl = vt_ref[0].reshape(rows, -1).astype(jnp.float32)
+        k2 = jnp.where(ik == wb, ktl, kq).astype(dtype)
+        v2 = jnp.where(ik == wb, vtl, vq).astype(dtype)
+        _cell_softmax(qt, k2, v2, ik, length, scale, block_k, n_rep,
+                      acc_ref, m_ref, l_ref)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[:, 0]
+        o = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)[:, None]
+        o_ref[0] = jnp.where(m_ref[:, 0][:, None] <= NEG_INF / 2, 0.0,
+                             o).astype(o_ref.dtype)
+
+
 def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
                            v_pool: jax.Array, block_table: jax.Array,
                            lengths: jax.Array, *,
                            scale: Optional[float] = None,
                            layer: Optional[jax.Array] = None,
-                           interpret: bool = False) -> jax.Array:
+                           interpret: bool = False,
+                           k_scale: Optional[jax.Array] = None,
+                           v_scale: Optional[jax.Array] = None,
+                           k_tail: Optional[jax.Array] = None,
+                           v_tail: Optional[jax.Array] = None) -> jax.Array:
     """:func:`decode_attention` over a PAGED cache: lane b's context
     lives in pool blocks ``block_table[b, 0..ceil(len_b/bs)-1]`` instead
     of one contiguous slab.
@@ -286,8 +384,23 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
     Mosaic skips the DMA, exactly like the contiguous fill clamp).  The
     gather that the XLA fallback must materialize (infer/paged.py
     ``_gather_lane_view``) never exists here: blocks stream straight
-    from their pool rows."""
+    from their pool rows.
+
+    ``k_scale``/``v_scale``/``k_tail``/``v_tail`` (all four together)
+    select the QUANTIZED-pool variant (SERVE_KV_QUANT=int8): pools are
+    int8 codes, scales are f32 ``[N, Hkv]`` (or ``[L, N, Hkv]``
+    stacked) riding the same table-driven index map, and the tails are
+    the per-lane bf16 staging blocks ``[lanes+1, Hkv, bs, D]`` (or
+    stacked with L) whose row ``b`` substitutes for lane b's one
+    partial write block — constant-in-ik index map, so Mosaic fetches
+    each lane's tail once and skips the repeat.  Dequant happens in
+    the cell (:func:`_paged_kernel_quant`); HBM streams half the
+    bytes."""
     b, hq, d = q.shape
+    quant = k_scale is not None
+    if quant and (v_scale is None or k_tail is None or v_tail is None):
+        raise ValueError("quantized paged attention needs k_scale, "
+                         "v_scale, k_tail and v_tail together")
     stacked = layer is not None
     _, hkv, block_k, _ = k_pool.shape[1:] if stacked else k_pool.shape
     if hq % hkv:
@@ -316,6 +429,13 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
             (1, 1, hkv, block_k, d),
             lambda b, ik, lens, tbl, lay: (lay[0], blk(ik, lens, tbl, b),
                                            0, 0, 0))
+        scale_spec = pl.BlockSpec(
+            (1, 1, hkv),
+            lambda b, ik, lens, tbl, lay: (lay[0], blk(ik, lens, tbl, b),
+                                           0))
+        tail_spec = pl.BlockSpec(
+            (1, 1, hkv, block_k, d),
+            lambda b, ik, lens, tbl, lay: (lay[0], b, 0, 0, 0))
         q_spec = pl.BlockSpec((1, d, hq),
                               lambda b, ik, lens, tbl, lay: (b, 0, 0))
         out_spec = pl.BlockSpec((1, hq, d),
@@ -325,15 +445,27 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
         cache_spec = pl.BlockSpec(
             (1, hkv, block_k, d),
             lambda b, ik, lens, tbl: (blk(ik, lens, tbl, b), 0, 0, 0))
+        scale_spec = pl.BlockSpec(
+            (1, hkv), lambda b, ik, lens, tbl: (blk(ik, lens, tbl, b), 0))
+        tail_spec = pl.BlockSpec(
+            (1, hkv, block_k, d), lambda b, ik, lens, tbl: (b, 0, 0, 0))
         q_spec = pl.BlockSpec((1, d, hq), lambda b, ik, lens, tbl: (b, 0, 0))
         out_spec = pl.BlockSpec((1, hq, d),
                                 lambda b, ik, lens, tbl: (b, 0, 0))
         num_prefetch, extra = 2, ()
 
+    in_specs = [q_spec, cache_spec, cache_spec]
+    quant_operands = ()
+    kernel_body = _paged_kernel
+    if quant:
+        in_specs += [scale_spec, scale_spec, tail_spec, tail_spec]
+        quant_operands = (k_scale.astype(jnp.float32),
+                          v_scale.astype(jnp.float32), k_tail, v_tail)
+        kernel_body = _paged_kernel_quant
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=num_prefetch,
         grid=(b, nk),
-        in_specs=[q_spec, cache_spec, cache_spec],
+        in_specs=in_specs,
         out_specs=out_spec,
         scratch_shapes=[
             pltpu.VMEM((hq, d), jnp.float32),        # acc
@@ -342,12 +474,12 @@ def paged_decode_attention(q: jax.Array, k_pool: jax.Array,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, scale=scale, block_k=block_k,
+        functools.partial(kernel_body, scale=scale, block_k=block_k,
                           n_rep=n_rep, stacked=stacked),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hq, d), q.dtype),
         interpret=interpret,
-    )(lengths, block_table, *extra, qt, k_pool, v_pool)
+    )(lengths, block_table, *extra, qt, k_pool, v_pool, *quant_operands)
     return out
 
 
@@ -358,12 +490,22 @@ def sharded_paged_decode_attention(mesh, q: jax.Array, k_pool: jax.Array,
                                    layer: Optional[jax.Array] = None,
                                    axis_name: str = "tp",
                                    interpret: bool = False,
-                                   compute_dtype=None) -> jax.Array:
+                                   compute_dtype=None,
+                                   k_scale: Optional[jax.Array] = None,
+                                   v_scale: Optional[jax.Array] = None,
+                                   k_tail: Optional[jax.Array] = None,
+                                   v_tail: Optional[jax.Array] = None
+                                   ) -> jax.Array:
     """:func:`sharded_decode_attention` for the paged pool: the pool
     shards over its kv-head axis exactly like the ring cache (block ids
     are position-like, replicated), so each shard runs the paged kernel
     on its own whole GQA groups and the wo psum completes the Megatron
-    row-parallel projection — block table and lengths replicate."""
+    row-parallel projection — block table and lengths replicate.
+
+    The quantized-pool operands (``k_scale``/``v_scale`` per-block
+    scales, ``k_tail``/``v_tail`` per-lane staging blocks) shard over
+    the SAME kv-head axis as their codes — every shard dequantizes
+    purely locally, and the psum is unchanged."""
     from paddle_operator_tpu.parallel.mesh import (
         compat_shard_map,
         resolve_shard_map_mesh,
@@ -383,14 +525,25 @@ def sharded_paged_decode_attention(mesh, q: jax.Array, k_pool: jax.Array,
     head_spec = P(None, axis_name, None)
     pool_spec = (P(None, None, axis_name, None, None)
                  if layer is not None else P(None, axis_name, None, None))
+    scale_spec = (P(None, None, axis_name)
+                  if layer is not None else P(None, axis_name))
     wo_spec = ({"q": P(axis_name, None), "s": P(None, None)}
                if isinstance(wo, dict) else P(axis_name, None))
     stacked = layer is not None
+    quant = k_scale is not None
 
-    def body(q, kc, vc, tbl, lens, wo, *lay):
+    def body(q, kc, vc, tbl, lens, wo, *rest):
+        if quant:
+            ks, vs, kt, vt = rest[:4]
+            rest = rest[4:]
+            qkw = {"k_scale": ks, "v_scale": vs,
+                   "k_tail": kt, "v_tail": vt}
+        else:
+            qkw = {}
         out = paged_decode_attention(q, kc, vc, tbl, lens,
-                                     layer=lay[0] if stacked else None,
-                                     interpret=interpret)   # [B, Hq/tp, D]
+                                     layer=rest[0] if stacked else None,
+                                     interpret=interpret,
+                                     **qkw)                 # [B, Hq/tp, D]
         o = out.reshape(b, -1)
         if isinstance(wo, dict):
             o = (o @ wo["q"].astype(dtype)) * wo["s"][..., 0, :].astype(dtype)
@@ -398,16 +551,20 @@ def sharded_paged_decode_attention(mesh, q: jax.Array, k_pool: jax.Array,
             o = o @ wo.astype(dtype)
         return jax.lax.psum(o, axis_name)                   # [B, E]
 
-    fn = compat_shard_map(
-        body, mesh=use_mesh,
-        in_specs=(head_spec, pool_spec, pool_spec, P(), P(), wo_spec)
-        + ((P(),) if stacked else ()),
-        out_specs=P(None, None),
-        axis_names=frozenset({axis_name}), check_vma=False)
+    in_specs = (head_spec, pool_spec, pool_spec, P(), P(), wo_spec)
     args = (q, k_pool, v_pool, block_table.astype(jnp.int32),
             lengths.astype(jnp.int32), wo)
+    if quant:
+        in_specs += (scale_spec, scale_spec, pool_spec, pool_spec)
+        args += (k_scale, v_scale, k_tail, v_tail)
     if stacked:
+        in_specs += (P(),)
         args += (layer,)
+    fn = compat_shard_map(
+        body, mesh=use_mesh,
+        in_specs=in_specs,
+        out_specs=P(None, None),
+        axis_names=frozenset({axis_name}), check_vma=False)
     return fn(*args)
 
 
@@ -511,6 +668,32 @@ def scatter_prefill_blocks(pool: jax.Array, rows: jax.Array,
         pool = jax.lax.dynamic_update_slice(
             pool, blk, (0, table_row[start_block + j], 0, 0, 0))
     return pool
+
+
+def scatter_prefill_blocks_quant(pool: jax.Array, scales: jax.Array,
+                                 rows: jax.Array, table_row: jax.Array,
+                                 block_size: int, start_block: int = 0):
+    """:func:`scatter_prefill_blocks` for the INT8 pool: each whole
+    block quantizes ONCE on the way in — per-(layer, kv-head) absmax
+    scale over the block's rows (infer/paged.py ``quantize_kv``), codes
+    to the pool, scale to the scale plane, same table-driven write
+    targets.  The prompt's partial last block is ALSO scattered (its
+    pad rows make the scale garbage) but is never read quantized: the
+    lane's bf16 staging tail serves every read of the write-frontier
+    block until decode truly completes it, which requantizes it whole.
+    Returns ``(pool', scales')``."""
+    from paddle_operator_tpu.infer.paged import quantize_kv
+
+    t = rows.shape[3]
+    for j in range(t // block_size):
+        blk = jax.lax.slice_in_dim(rows, j * block_size,
+                                   (j + 1) * block_size, axis=3)
+        codes, scale = quantize_kv(blk)       # [L,1,H,bs,D], [L,1,H]
+        pool = jax.lax.dynamic_update_slice(
+            pool, codes, (0, table_row[start_block + j], 0, 0, 0))
+        scales = jax.lax.dynamic_update_slice(
+            scales, scale, (0, table_row[start_block + j], 0))
+    return pool, scales
 
 
 def decode_attention_reference(q: jax.Array, k_cache: jax.Array,

@@ -109,6 +109,14 @@ def serving_gauges(status_serving: dict, job: str) -> dict:
             float(status_serving.get("prefillQueueDepth", 0.0)),
         f"tpujob_serve_chunked_prefill_token_share{lbl}":
             float(status_serving.get("chunkedPrefillTokenShare", 0.0)),
+        # quantized-pool serving (SERVE_KV_QUANT): device bytes held by
+        # the KV pool (int8 codes + scale planes + staging tails, or
+        # the bf16 pool/ring), labeled with the storage mode so
+        # capacity dashboards can split int8 and bf16 fleets on one
+        # metric name
+        ("tpujob_serve_kv_pool_bytes"
+         f'{{job="{job}",mode="{status_serving.get("kvQuantMode", "none")}"}}'):
+            float(status_serving.get("kvPoolBytes", 0.0)),
         # serving fault tolerance (infer/resilience.py): deadline
         # partials served, self-healing ring rebuilds, NaN-quarantined
         # lanes, and the drain flag (1 while the pod sheds admissions)

@@ -24,7 +24,8 @@ TMPL = {"spec": {"containers": [{"name": "m", "image": "jax:latest"}]}}
 SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "tokensTotal": 9000, "prefixHitRate": 0.31, "kvBlocksFree": 17,
            "prefillMode": "chunked", "prefillQueueDepth": 2,
-           "chunkedPrefillTokenShare": 0.85}
+           "chunkedPrefillTokenShare": 0.85,
+           "kvQuantMode": "int8", "kvPoolBytes": 4096}
 
 
 class TestGaugeNaming:
@@ -42,11 +43,17 @@ class TestGaugeNaming:
                  '{job="default/j",mode="chunked"}'] == 2.0
         assert g['tpujob_serve_chunked_prefill_token_share'
                  '{job="default/j"}'] == 0.85
+        # quantized-pool gauge (ISSUE 7): pool bytes labeled with the
+        # storage mode, mirroring the prefill queue-depth label scheme
+        assert g['tpujob_serve_kv_pool_bytes'
+                 '{job="default/j",mode="int8"}'] == 4096.0
 
     def test_prefill_mode_label_defaults_inline(self):
         g = serving_gauges({}, "ns/x")
         assert ('tpujob_serve_prefill_queue_depth'
                 '{job="ns/x",mode="inline"}') in g
+        assert ('tpujob_serve_kv_pool_bytes'
+                '{job="ns/x",mode="none"}') in g
 
     def test_missing_keys_default_zero(self):
         g = serving_gauges({}, "ns/x")
@@ -160,11 +167,15 @@ class TestBatcherServingStatus:
                            # prefill-path block (ISSUE 6 split)
                            "prefillMode", "prefillQueueDepth",
                            "chunkedPrefillTokenShare",
+                           # quantized-pool block (ISSUE 7)
+                           "kvQuantMode", "kvPoolBytes",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
         assert st["prefillMode"] == "inline"
         assert st["prefillQueueDepth"] == 0
+        assert st["kvQuantMode"] == "none"     # bf16 default
+        assert st["kvPoolBytes"] > 0
         assert st["tokensTotal"] == 4
         assert st["tokensPerSec"] > 0
         assert st["acceptRate"] == 0.0         # non-speculative ring
