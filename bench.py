@@ -820,6 +820,119 @@ def train_spec_pair(cfg, dcfg, *, steps: int = 60, batch: int = 16,
     return trained["target"], trained["draft"]
 
 
+def measure_hierarchical_cache(cfg, params, *, n_prompts: int = 8,
+                               prompt_len: int = 64,
+                               new_tokens: int = 8, block_size: int = 8,
+                               chunk: int = 4, rounds: int = 2,
+                               max_len: int = None) -> list:
+    """Hierarchical-cache sweep (ISSUE 8, docs/serving.md): TTFT
+    p50/p95 split COLD / HOST-hit / HBM-hit for a tenant working set
+    ~4x the HBM pool, with the host tier OFF (the evict-and-discard
+    baseline) and ON.
+
+    Per tier config a fresh one-lane ring is built over a pool sized to
+    ~25% of the working set (``n_prompts`` distinct prompts of
+    ``prompt_len``), the working set is seeded once (cold round), then
+    ``rounds`` revisit passes probe submit -> first-token per prompt.
+    With the tier OFF every revisit of an evicted prefix re-prefills
+    (cold); with it ON the revisit promotes host payloads (the TTFT the
+    tier buys).  Each probe is classified by the allocator's own
+    counters (promotions fired -> host; hit tokens without promotions
+    -> hbm; else cold), so the split can never mislabel a cold prefill
+    as a hit.  ``hier_hit_rate`` is the allocator's prefix token hit
+    rate over the probe rounds (HBM + host combined) — the >= 3x-
+    over-baseline acceptance bar; ``hier_promote_mb_s`` is promoted
+    host bytes over host-hit admission seconds."""
+    import numpy as np
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+    from paddle_operator_tpu.infer.paged import host_block_bytes
+
+    max_len = max_len or (prompt_len + new_tokens)
+    bpp = -(-prompt_len // block_size)          # blocks per prompt
+    lane_blocks = -(-max_len // block_size)
+    # pool ~25% of the working set, never below one lane's worst case
+    pool_blocks = max(lane_blocks, (n_prompts * bpp) // 4)
+    host_blocks = 2 * n_prompts * bpp           # tier fits the set
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+               for _ in range(n_prompts)]
+    out = []
+    for tier_on in (False, True):
+        b = ContinuousBatcher(
+            params, cfg, slots=1, max_len=max_len, chunk_tokens=chunk,
+            prefill_buckets=(prompt_len, max_len), paged=True,
+            block_size=block_size, num_blocks=pool_blocks,
+            host_cache_blocks=host_blocks if tier_on else 0,
+            prewarm=True)
+        try:
+            # the full insert/suffix ladder compiles off-thread
+            # (tier-off revisits land on varied partial-hit suffix
+            # buckets — an unwarmed one would charge a probe an XLA
+            # compile)
+            b.prewarmed.wait(timeout=600)
+            for p in prompts:                   # seed round (untimed)
+                b.submit(p, max_new_tokens=new_tokens).result(timeout=600)
+            # warm the revisit compile set (promote upload, CoW, suffix
+            # insert) outside the timed probes — the paged bench's
+            # convention, so p95 measures the path, not one XLA compile
+            b.submit(prompts[0],
+                     max_new_tokens=new_tokens).result(timeout=600)
+            b.pool.stats.update(prefix_lookup_tokens=0,
+                                prefix_hit_tokens=0, prefix_lookups=0,
+                                prefix_full_hits=0, host_hit_tokens=0)
+            # promote-bandwidth accounting covers the TIMED probes only
+            # (seed + warm rounds promote too, but their seconds are
+            # not in host_s)
+            promoted0 = b.stats["promoted_blocks"]
+            t_cold, t_host, t_hbm = [], [], []
+            host_s = 0.0
+            for _ in range(rounds):
+                for p in prompts:
+                    promos0 = b.pool.stats["host_promotions"]
+                    hits0 = b.pool.stats["prefix_hit_tokens"]
+                    t1 = time.perf_counter()
+                    probe = b.submit(p, max_new_tokens=new_tokens,
+                                     stream=True)
+                    next(probe.stream(timeout=600))
+                    dt = (time.perf_counter() - t1) * 1000
+                    probe.result(timeout=600)
+                    if b.pool.stats["host_promotions"] > promos0:
+                        t_host.append(dt)
+                        host_s += dt / 1000
+                    elif b.pool.stats["prefix_hit_tokens"] > hits0:
+                        t_hbm.append(dt)
+                    else:
+                        t_cold.append(dt)
+            row = {
+                "hier_tier": "on" if tier_on else "off",
+                "hier_pool_blocks": pool_blocks,
+                "hier_working_set_blocks": n_prompts * bpp,
+                "hier_hit_rate": b.pool.hit_rate(),
+                "hier_host_hit_rate": b.pool.host_hit_rate(),
+                "hier_promoted_blocks": b.stats["promoted_blocks"],
+                "hier_host_demotions": b.pool.stats["host_demotions"],
+            }
+            for name, ts in (("cold", t_cold), ("host", t_host),
+                             ("hbm", t_hbm)):
+                if ts:
+                    row[f"hier_ttft_{name}_p50_ms"] = round(
+                        _pctl(ts, 0.5), 1)
+                    row[f"hier_ttft_{name}_p95_ms"] = round(
+                        _pctl(ts, 0.95), 1)
+                    row[f"hier_{name}_probes"] = len(ts)
+            if host_s > 0:
+                promoted_mb = ((b.stats["promoted_blocks"] - promoted0)
+                               * host_block_bytes(cfg, block_size)
+                               / 1e6)
+                row["hier_promote_mb_s"] = round(promoted_mb / host_s, 2)
+            b.pool.check_invariant()
+        finally:
+            b.close()
+        out.append(row)
+    return out
+
+
 def measure_speculative(cfg, dcfg, params, dparams, *,
                         spec_ks=(2, 4, 8), batches=(1, 8),
                         prompt_len: int = 128, new_tokens: int = 192,
@@ -1534,6 +1647,52 @@ def main() -> int:
                 "kvq_step_ms_ratio")
         else:
             emit("kvquant_sweep", kvq)
+
+        # hierarchical-cache sweep on CPU, in the >=512-token-prefix
+        # regime the acceptance bar names: a working set ~4x the pool,
+        # tier off (evict-and-discard baseline) vs on.  The hit-rate
+        # recovery (~0.08 -> ~1.0 measured here, >=3x bar) and the
+        # cold/host/hbm TTFT split are REAL allocator behavior; the
+        # TTFT ratio is CPU-einsum physics (~2x on this box, where a
+        # tiny-model 512-token prefill is only ~70ms so per-dispatch
+        # overhead dilutes the win) — the >=5x bar is the TPU regime,
+        # where re-prefilling a 512+-token prefix costs real FLOPs
+        # against a host copy that is one PCIe-rate DMA
+        def cpu_hier():
+            from paddle_operator_tpu.infer.quant import serving_params
+
+            tcfg = dataclasses.replace(L.CONFIGS["tiny"],
+                                       max_seq_len=640)
+            tparams = serving_params(L.Llama(tcfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"], tcfg.dtype)
+            return measure_hierarchical_cache(
+                tcfg, tparams, n_prompts=6, prompt_len=512,
+                new_tokens=8, block_size=64, chunk=4, rounds=2,
+                max_len=576)
+
+        hier = guarded("hier", cpu_hier)
+        if isinstance(hier, list):
+            for entry in hier:
+                emit("hier_sweep", entry)
+            on = [e for e in hier if e.get("hier_tier") == "on"]
+            off = [e for e in hier if e.get("hier_tier") == "off"]
+            if on:
+                top = on[-1]
+                summary["host_hit_ttft_ms"] = top.get(
+                    "hier_ttft_host_p50_ms")
+                summary["host_hit_rate"] = top.get("hier_host_hit_rate")
+                summary["host_promote_mb_s"] = top.get(
+                    "hier_promote_mb_s")
+                cold = (top.get("hier_ttft_cold_p95_ms")
+                        or (off[-1].get("hier_ttft_cold_p95_ms")
+                            if off else None))
+                host = top.get("hier_ttft_host_p95_ms")
+                if cold and host:
+                    summary["hier_ttft_cold_ratio"] = round(
+                        cold / host, 2)
+        else:
+            emit("hier_sweep", hier)
 
         # speculative sweep on CPU: tiny pattern-trained pair — speeds
         # are meaningless but accept-rate and the greedy-parity path run

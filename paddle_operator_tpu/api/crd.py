@@ -292,8 +292,10 @@ def _status_schema() -> Dict[str, Any]:
             # (infer/resilience.py): draining, deadlineExceeded,
             # watchdogRestarts, quarantinedLanes — the prefill-path
             # keys (ISSUE 6): prefillMode, prefillQueueDepth,
-            # chunkedPrefillTokenShare — and the quantized-pool keys
-            # (ISSUE 7): kvQuantMode, kvPoolBytes — schemaless on purpose
+            # chunkedPrefillTokenShare — the quantized-pool keys
+            # (ISSUE 7): kvQuantMode, kvPoolBytes — and the
+            # hierarchical-cache keys (ISSUE 8): hostCacheBlocks,
+            # hostHitRate, promotedBlocks — schemaless on purpose
             # (preserve-unknown-fields) so the workload can grow
             # telemetry without a CRD rev.
             "serving": {

@@ -412,10 +412,11 @@ class TPUJobStatus:
     # (ISSUE 6 scheduler/executor split) — prefillMode (inline|chunked|
     # disagg), prefillQueueDepth, chunkedPrefillTokenShare — the
     # quantized-pool block (ISSUE 7) — kvQuantMode (none|int8),
-    # kvPoolBytes — plus the fault-tolerance block
-    # (infer/resilience.py) — draining, deadlineExceeded,
-    # watchdogRestarts, quarantinedLanes.  The manager exports it as
-    # tpujob_serve_* gauges on /metrics.
+    # kvPoolBytes — the hierarchical-cache block (ISSUE 8) —
+    # hostCacheBlocks, hostHitRate, promotedBlocks — plus the
+    # fault-tolerance block (infer/resilience.py) — draining,
+    # deadlineExceeded, watchdogRestarts, quarantinedLanes.  The
+    # manager exports it as tpujob_serve_* gauges on /metrics.
     serving: Dict[str, Any] = field(default_factory=dict)
     # k8s-style status conditions; the reconciler maintains a "Goodput"
     # condition from the published block.

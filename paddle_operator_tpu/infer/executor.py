@@ -807,7 +807,8 @@ class RingExecutor:
                  prefill_mode: str = "inline",
                  prefill_chunk: int = 64,
                  check_finite: bool = False,
-                 kv_quant: str = "none") -> None:
+                 kv_quant: str = "none",
+                 host_cache_blocks: int = 0) -> None:
         self.mesh = mesh
         if mesh is not None and D.mesh_tp(mesh) > 1:
             params = D.shard_params_for_serving(params, cfg, mesh)
@@ -849,9 +850,23 @@ class RingExecutor:
             self.block_size = int(block_size)
             self._num_blocks = num_blocks
             self.prefix_cache = prefix_cache and not spec_k
+            # ISSUE 8 host spill tier: demoted radix blocks live in
+            # host RAM and promote back on hit — only meaningful with
+            # the prefix cache on (a spec ring turns both off)
+            self.host_cache_blocks = (int(host_cache_blocks)
+                                      if self.prefix_cache else 0)
             self.pool = PG.PagedCacheManager(
                 slots, max_len, self.block_size, num_blocks,
-                prefix_cache=self.prefix_cache)
+                prefix_cache=self.prefix_cache,
+                host_cache_blocks=self.host_cache_blocks)
+            # demote/promote programs exist whenever the ring is paged:
+            # the host tier uses them on evict/hit, and spill_lane /
+            # restore_lane (the preemption primitive) reuse the same
+            # byte-copy path with the tier off (both lru_cached)
+            self._fetch_prog = PG.make_block_fetch(
+                quant=(kv_quant == "int8"))
+            self._promote_prog = PG.make_promote_blocks(
+                self.block_size, quant=(kv_quant == "int8"))
             # prefill buckets scatter whole blocks: round each up to a
             # block multiple, capped at the lane view
             self.buckets = tuple(sorted(
@@ -862,6 +877,7 @@ class RingExecutor:
         else:
             self.block_size = int(block_size)
             self.prefix_cache = False
+            self.host_cache_blocks = 0
         self._suffix_inserts: Dict[int, Any] = {}
         # chunked-prefill compile caches: intermediate slice + final
         # insert programs, keyed by staging length (contiguous) or just
@@ -871,6 +887,9 @@ class RingExecutor:
         self._attach = None
         self._spec_attach: Dict[int, Any] = {}
         self._transfer = None
+        # demoted payloads whose device->host copy is still settling
+        # (_demote_fetch): materialized to numpy on the next tier touch
+        self._demote_lazy: List[Dict[str, Any]] = []
 
         self.spec_k = int(spec_k)
         self.draft_cfg = draft_cfg
@@ -955,10 +974,18 @@ class RingExecutor:
         if self.paged:
             # ALWAYS a fresh allocator: the radix cache keys blocks of
             # the about-to-be-replaced device arrays — carrying it over
-            # would map zeroed blocks as a "cached" prefix
+            # would map zeroed blocks as a "cached" prefix.  The host
+            # tier resets WITH it: in-flight promotions are dropped and
+            # a rebuilt ring re-walks the radix from cold (host payloads
+            # keyed against the dead allocator's chain state must never
+            # promote into the fresh pool)
             self.pool = self._pg.PagedCacheManager(
                 self.slots, self.max_len, self.block_size,
-                self._num_blocks, prefix_cache=self.prefix_cache)
+                self._num_blocks, prefix_cache=self.prefix_cache,
+                host_cache_blocks=self.host_cache_blocks)
+            if self.host_cache_blocks:
+                self.pool.demote_fetch = self._demote_fetch
+            self._demote_lazy.clear()   # payloads of the dead tier
             self.cache = self._pg.init_paged_cache(
                 self.cfg, self.slots, self.pool.total, self.block_size,
                 mesh=self.mesh, quant=self.kv_quant)
@@ -1013,6 +1040,161 @@ class RingExecutor:
             if buf is not None:
                 total += int(np.prod(buf.shape)) * buf.dtype.itemsize
         return total
+
+    # -- host spill tier: demote fetch + batched promote (ISSUE 8) --------
+
+    def _demote_fetch(self, blk: int) -> Dict[str, Any]:
+        """PagedCacheManager.demote_fetch hook: one block's exact device
+        bytes, captured WITHOUT blocking the ring thread.  The slice is
+        an async dispatch (stream-ordered after every write to the
+        block, so it reads final content) and the device->host copy is
+        kicked with ``copy_to_host_async`` — no sync here, residents
+        never stall on a demotion.  The payload dict initially holds
+        the small sliced device arrays; the NEXT tier touch (another
+        demotion, or nothing — a promote reads them as-is) materializes
+        the PREVIOUS payloads to numpy in place, releasing their device
+        buffers, so at most one admission's worth of demoted slices is
+        ever device-resident."""
+        # materialize earlier payloads first: their D2H copies have
+        # long completed, so the asarray is a cheap buffer read
+        for d in self._demote_lazy:
+            for key, val in d.items():
+                if not isinstance(val, np.ndarray):
+                    d[key] = np.asarray(val)
+        self._demote_lazy.clear()
+        c = self.cache
+        if self.quant:
+            kb, vb, ksb, vsb = self._fetch_prog(c["k"], c["v"], c["ks"],
+                                                c["vs"], blk)
+            payload = {"k": kb, "v": vb, "ks": ksb, "vs": vsb}
+        else:
+            kb, vb = self._fetch_prog(c["k"], c["v"], blk)
+            payload = {"k": kb, "v": vb}
+        for val in payload.values():
+            try:
+                val.copy_to_host_async()
+            except AttributeError:      # interpret-mode ndarray
+                pass
+        self._demote_lazy.append(payload)
+        return payload
+
+    @staticmethod
+    def _promote_pad(n: int) -> int:
+        """Pad a promote batch to a power of two so a handful of
+        compiles serves every batch size (the ids pad with the trash
+        block — garbage written there is its job)."""
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
+    def dispatch_promotions(self, promotes) -> None:
+        """Upload a batch of host-tier payloads into their RESERVED
+        pool blocks in one donated jit (``promotes``:
+        pool.take_promotions() output).  The host->device transfer and
+        the scatter are both ASYNC dispatches: they overlap the decode
+        chunk already in flight on the device, and the runtime orders
+        them before the admission insert / CoW dispatched next — the
+        prefetch never stalls resident lanes and activation naturally
+        waits on transfer completion."""
+        n = len(promotes)
+        pad = self._promote_pad(n)
+        bs = self.block_size
+        p0 = promotes[0][1]
+        lcount, _, h, _, d = p0["k"].shape
+        slab_k = np.zeros((lcount, 1, h, pad * bs, d), p0["k"].dtype)
+        slab_v = np.zeros_like(slab_k)
+        ids = np.full((pad,), self._pg.TRASH_BLOCK, np.int32)
+        for j, (dst, payload, _key) in enumerate(promotes):
+            ids[j] = dst
+            slab_k[:, 0, :, j * bs:(j + 1) * bs] = payload["k"][:, 0]
+            slab_v[:, 0, :, j * bs:(j + 1) * bs] = payload["v"][:, 0]
+        c = self.cache
+        if self.quant:
+            # pad scale rows hold the all-zero-block sentinel 1.0 so a
+            # (never-read) trash write still dequantizes finite
+            srow_k = np.ones((lcount, pad, h), np.float32)
+            srow_v = np.ones_like(srow_k)
+            for j, (dst, payload, _key) in enumerate(promotes):
+                srow_k[:, j] = payload["ks"][:, 0]
+                srow_v[:, j] = payload["vs"][:, 0]
+            c["k"], c["v"], c["ks"], c["vs"] = self._promote_prog(
+                c["k"], c["v"], c["ks"], c["vs"], jnp.asarray(slab_k),
+                jnp.asarray(slab_v), jnp.asarray(srow_k),
+                jnp.asarray(srow_v), jnp.asarray(ids))
+        else:
+            c["k"], c["v"] = self._promote_prog(
+                c["k"], c["v"], jnp.asarray(slab_k), jnp.asarray(slab_v),
+                jnp.asarray(ids))
+
+    # -- lane spill/restore: the preemption primitive (ISSUE 8) -----------
+
+    def spill_lane(self, slot: int) -> Dict[str, Any]:
+        """Capture a LIVE lane to host: its mapped blocks' exact pool
+        bytes (codes + scales under int8, plus the bf16 staging tail),
+        its fill position and its carry token / temperature / sampling
+        key — everything :meth:`restore_lane` needs to resume the lane
+        bit-identically.  The caller retires the lane afterwards
+        (freeing its blocks for the preempting request); this method
+        only reads.  This is the generic preemption/handoff primitive
+        ROADMAP items 4 (priority preemption) and 5 (hot swap via lane
+        handoff) consume — tested for exactness in
+        tests/test_hostcache.py."""
+        pm = self.pool
+        m = pm.mapped_count[slot]
+        ids = jnp.asarray([int(pm.table[slot][j]) for j in range(m)],
+                          jnp.int32)
+        c = self.cache
+        spill: Dict[str, Any] = {
+            "n_blocks": m,
+            "pos": int(np.asarray(c["pos"])[slot]),
+            "tok": int(np.asarray(self.tok)[slot]),
+            "temp": float(np.asarray(self.temp)[slot]),
+            "key": np.asarray(self.keys)[slot].copy(),
+            "k": np.asarray(jnp.take(c["k"], ids, axis=1)),
+            "v": np.asarray(jnp.take(c["v"], ids, axis=1)),
+        }
+        if self.quant:
+            spill["ks"] = np.asarray(jnp.take(c["ks"], ids, axis=1))
+            spill["vs"] = np.asarray(jnp.take(c["vs"], ids, axis=1))
+            spill["kt"] = np.asarray(c["kt"][:, slot])
+            spill["vt"] = np.asarray(c["vt"][:, slot])
+        return spill
+
+    def restore_lane(self, slot: int, spill: Dict[str, Any]) -> None:
+        """Re-admit a spilled lane into (empty) ``slot``: map fresh
+        pool blocks, upload the spilled bytes through the same promote
+        scatter a host hit uses, restore the staging tail, and attach
+        the lane state (pos/tok/temp/keys) — the resumed decode stream
+        is bit-identical to the uninterrupted one because every byte
+        the forward reads is a copy of what was captured.  The re-admit
+        rides the same suffix-insert-shaped contract as admission: the
+        restored rows play the role of a full prefix hit, so no forward
+        runs here at all."""
+        pm = self.pool
+        if pm.mapped_count[slot]:
+            raise AssertionError(f"slot {slot} still holds blocks")
+        m = spill["n_blocks"]
+        pm.ensure(slot, m * self.block_size)
+        promotes = []
+        for j in range(m):
+            payload = {"k": spill["k"][:, j:j + 1],
+                       "v": spill["v"][:, j:j + 1]}
+            if self.quant:
+                payload["ks"] = spill["ks"][:, j:j + 1]
+                payload["vs"] = spill["vs"][:, j:j + 1]
+            promotes.append((int(pm.table[slot][j]), payload, None))
+        if promotes:
+            self.dispatch_promotions(promotes)
+        if self.quant:
+            self.cache["kt"] = self.cache["kt"].at[:, slot].set(
+                jnp.asarray(spill["kt"]))
+            self.cache["vt"] = self.cache["vt"].at[:, slot].set(
+                jnp.asarray(spill["vt"]))
+        self.cache["pos"] = self.cache["pos"].at[slot].set(spill["pos"])
+        self.tok = self.tok.at[slot].set(spill["tok"])
+        self.temp = self.temp.at[slot].set(spill["temp"])
+        self.keys = self.keys.at[slot].set(jnp.asarray(spill["key"]))
 
     def chunk_prog(self, staging_len: Optional[int]):
         """Intermediate chunked-prefill slice program: paged (keyed by
@@ -1194,6 +1376,39 @@ class RingExecutor:
             else:
                 k = jnp.zeros_like(cache["k"])
                 self._copy_block(k, jnp.zeros_like(cache["v"]), 0, 0)
+            if self.host_cache_blocks:
+                # host-tier programs: the demote fetch and the promote
+                # upload at the small pad ladder rungs a typical
+                # admission batches into — otherwise the FIRST host hit
+                # pays the promote compile inside its TTFT
+                lc, _, h, bsz, dd = cache["k"].shape
+                if self.quant:
+                    self._fetch_prog(cache["k"], cache["v"],
+                                     cache["ks"], cache["vs"], 0)
+                else:
+                    self._fetch_prog(cache["k"], cache["v"], 0)
+                pad = 1
+                # inclusive of _promote_pad(max_blocks): a 9-block
+                # table pads its largest batch to 16, which must be in
+                # the warmed set too
+                while pad <= self._promote_pad(self.pool.max_blocks):
+                    ids = jnp.zeros((pad,), jnp.int32)
+                    slab = jnp.zeros((lc, 1, h, pad * bsz, dd),
+                                     cache["k"].dtype)
+                    if self.quant:
+                        srow = jnp.ones((lc, pad, h), jnp.float32)
+                        out = self._promote_prog(
+                            jnp.zeros_like(cache["k"]),
+                            jnp.zeros_like(cache["v"]),
+                            jnp.zeros_like(cache["ks"]),
+                            jnp.zeros_like(cache["vs"]),
+                            slab, slab, srow, srow, ids)
+                    else:
+                        out = self._promote_prog(
+                            jnp.zeros_like(cache["k"]),
+                            jnp.zeros_like(cache["v"]), slab, slab, ids)
+                    del out
+                    pad *= 2
         if self.prefill_exec is not None:
             # the disagg engine's whole-prompt programs compile on the
             # PREFILL thread (they never stall decode), but the first

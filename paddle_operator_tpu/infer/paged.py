@@ -42,12 +42,33 @@ Zheng et al. 2024) in this codebase's TPU-native terms:
 Mesh/TP: the pool shards over its kv-head axis exactly like the ring
 cache (parallel/sharding.py kv_cache_sharding — the pool's axis 2);
 tables and lengths replicate.
+
+**Hierarchical cache (ISSUE 8)**: with ``host_cache_blocks > 0`` the
+radix cache gains a HOST-RAM spill tier (:class:`HostCacheTier`,
+SGLang-HiCache / CachedAttention style).  Eviction DEMOTES a
+refcount-0 cached block — its exact device bytes (bf16 rows, or int8
+codes + scales under SERVE_KV_QUANT=int8) fetched to pinned numpy —
+instead of discarding it, keeping the radix node alive with a host
+location (``_CacheEntry.block is None``).  Admission's radix walk then
+classifies hits three ways: **HBM** (map read-only, as today),
+**host** (reserve a device block at admission and upload the payload
+via one batched donated promote jit — :func:`make_promote_blocks`,
+whose bf16 path reuses the same ``scatter_prefill_blocks`` whole-block
+writes the prefill path uses), or **cold** (prefill the suffix).
+Demote/promote is a byte COPY, never a re-quantize, so a host hit is
+bit-identical to an HBM hit; host RAM holds 10-100x more prefix blocks
+than the pool at a transfer cost far below re-prefill.  The same
+fetch/upload primitive backs :meth:`RingExecutor.spill_lane` /
+``restore_lane`` — the lane-preemption building block ROADMAP items
+4/5 consume.  ``host_cache_blocks=0`` (the default) leaves every code
+path byte-identical to the pre-tier behavior.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional, Tuple
+import heapq
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -108,10 +129,98 @@ class _CacheEntry:
 
     def __init__(self, key, block, chunk, parent):
         self.key = key
-        self.block = block
+        # device pool block id, or None while the entry's content lives
+        # in the host tier (demoted — the radix node stays alive and a
+        # later hit promotes it back into a fresh device block)
+        self.block: Optional[int] = block
         self.chunk = chunk        # the bs tokens this block's KV encodes
         self.parent = parent      # chain key of the preceding block
         self.freed_at: Optional[int] = None   # LRU clock at refcount 0
+
+
+def host_block_bytes(cfg: LlamaConfig, block_size: int,
+                     quant: str = "none") -> int:
+    """Host bytes one demoted block costs in the spill tier: K + V rows
+    ([L, H_kv, bs, D] each — bf16 2 bytes/elem, or int8 codes plus the
+    per-(layer, kv-head) f32 scale planes).  serve.py divides
+    ``SERVE_HOST_CACHE_MB`` by this to size ``host_cache_blocks``."""
+    rows = cfg.n_layers * cfg.n_kv_heads * block_size * cfg.head_dim
+    if quant == "int8":
+        return 2 * rows + 2 * cfg.n_layers * cfg.n_kv_heads * 4
+    return 2 * rows * 2
+
+
+class HostCacheTier:
+    """The bounded host-RAM ring behind the radix cache: demoted block
+    payloads (numpy dicts — ``k``/``v`` rows, plus ``ks``/``vs`` scale
+    rows under int8), keyed by the entry's chain key, LRU within the
+    tier.  ``put`` on a full tier drops the oldest payloads and returns
+    their keys so the manager can retire the orphaned radix nodes; a
+    promote ``pop`` moves the payload back out (demote/promote is a
+    move, never a copy-with-two-owners — one canonical location per
+    block keeps the accounting exact)."""
+
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"host tier capacity must be >= 1 "
+                             f"(got {capacity}); use host_cache_blocks=0 "
+                             "to disable the tier")
+        self.capacity = int(capacity)
+        self._data: "Dict[Any, Dict[str, Any]]" = {}   # insertion = LRU age
+        self.stats = {"demoted": 0, "promoted": 0, "overflow_drops": 0}
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def keys(self):
+        return self._data.keys()
+
+    def put(self, key, payload: Dict[str, Any],
+            pinned: frozenset = frozenset()) -> List[Any]:
+        """Store one demoted payload; returns the keys LRU-dropped to
+        make room (the caller must drop their radix entries).
+
+        ``pinned``: keys that must NOT be overflow-dropped — the
+        current admission's host-hit chain (an eviction-triggered
+        demotion mid-admit could otherwise drop the very payload the
+        promotion is about to pop).  With every resident key pinned the
+        tier temporarily exceeds its bound by at most the chain length;
+        the manager trims back once the admission releases its pins."""
+        dropped: List[Any] = []
+        self._data.pop(key, None)
+        while len(self._data) >= self.capacity:
+            old = next((k for k in self._data if k not in pinned), None)
+            if old is None:
+                break                   # all pinned: exceed, trim later
+            del self._data[old]
+            dropped.append(old)
+            self.stats["overflow_drops"] += 1
+        self._data[key] = payload
+        self.stats["demoted"] += 1
+        return dropped
+
+    def trim(self) -> List[Any]:
+        """Drop oldest payloads until back within the bound (after an
+        admission that pinned its chain released the pins)."""
+        dropped: List[Any] = []
+        while len(self._data) > self.capacity:
+            old = next(iter(self._data))
+            del self._data[old]
+            dropped.append(old)
+            self.stats["overflow_drops"] += 1
+        return dropped
+
+    def pop(self, key) -> Dict[str, Any]:
+        """Remove + return a payload for promotion back to the pool."""
+        payload = self._data.pop(key)
+        self.stats["promoted"] += 1
+        return payload
+
+    def drop(self, key) -> None:
+        self._data.pop(key, None)
 
 
 class PagedCacheManager:
@@ -136,7 +245,8 @@ class PagedCacheManager:
 
     def __init__(self, slots: int, max_len: int, block_size: int,
                  num_blocks: Optional[int] = None,
-                 prefix_cache: bool = True) -> None:
+                 prefix_cache: bool = True,
+                 host_cache_blocks: int = 0) -> None:
         alloc = D.cache_alloc_len(max_len)
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1 (got {block_size})")
@@ -161,6 +271,30 @@ class PagedCacheManager:
         self.by_block: Dict[int, Any] = {}              # block -> chain key
         self.children: Dict[Any, set] = {}              # parent key -> keys
         self._tick = 0
+        # age-ordered refcount-0 index (the satellite O(log n) eviction
+        # fix): a lazy-deletion min-heap of (freed_at, seq, key) pushed
+        # at every ref -> 0 transition; pop-time validation discards
+        # items whose entry was since re-mapped, dropped, or demoted.
+        # Selection semantics are IDENTICAL to the old full scan
+        # (:meth:`_select_victim_scan`, kept as the regression oracle).
+        self._ref0_heap: List[Tuple[int, int, Any]] = []
+        self._heap_seq = 0
+        # host spill tier (ISSUE 8): demoted refcount-0 cached blocks
+        # keep their radix node alive with their bytes in host RAM; the
+        # executor wires ``demote_fetch`` (block id -> numpy payload)
+        # after construction.  0 blocks = tier off = pre-tier behavior.
+        self.host = (HostCacheTier(host_cache_blocks)
+                     if host_cache_blocks else None)
+        self.demote_fetch: Optional[Callable[[int], Dict[str, Any]]] = None
+        # the in-flight admission's host-hit chain keys: shielded from
+        # tier overflow drops while the admit that will pop them runs
+        # (HostCacheTier.put pinned=)
+        self._pinned_host_keys: frozenset = frozenset()
+        # promotions ALLOCATED by the current admit() and not yet
+        # uploaded: [(dst_block, payload, key)] — the scheduler drains
+        # them (take_promotions) into ONE batched donated device upload
+        # BEFORE the CoW copies / admission insert it dispatches next
+        self._pending_promotes: List[Tuple[int, Dict[str, Any], Any]] = []
         # chaos hook (infer/chaos.py pool_oom): the next N allocations
         # raise NoFreeBlocks regardless of free-list state, so the
         # starvation/eviction paths are exercisable deterministically
@@ -170,6 +304,11 @@ class PagedCacheManager:
             "prefix_lookup_tokens": 0, "prefix_hit_tokens": 0,
             "prefix_lookups": 0, "prefix_full_hits": 0,
             "cow_copies": 0, "cache_evictions": 0, "blocks_hwm": 0,
+            # host-tier accounting: blocks demoted to / promoted from
+            # host RAM, and the prefix-hit tokens served out of host
+            # payloads (the hostHitRate numerator)
+            "host_demotions": 0, "host_promotions": 0,
+            "host_hit_tokens": 0,
         }
 
     # -- allocation --------------------------------------------------------
@@ -178,9 +317,21 @@ class PagedCacheManager:
         return len(self.free)
 
     def blocks_cached(self) -> int:
-        """Cached blocks currently reclaimable (refcount 0)."""
+        """DEVICE-resident cached blocks currently reclaimable
+        (refcount 0); host-demoted entries hold no pool block."""
         return sum(1 for e in self.entries.values()
-                   if self.ref[e.block] == 0)
+                   if e.block is not None and self.ref[e.block] == 0)
+
+    def host_blocks(self) -> int:
+        """Blocks currently resident in the host spill tier."""
+        return len(self.host) if self.host is not None else 0
+
+    def host_hit_rate(self) -> float:
+        """Share of looked-up prefix tokens served from HOST payloads
+        (the promote path) — the ``hostHitRate`` status key."""
+        lk = self.stats["prefix_lookup_tokens"]
+        return (round(self.stats["host_hit_tokens"] / lk, 4)
+                if lk else 0.0)
 
     def _alloc_one(self) -> int:
         if self.chaos_fail_allocs > 0:
@@ -193,24 +344,110 @@ class PagedCacheManager:
         self.stats["blocks_hwm"] = max(self.stats["blocks_hwm"], used)
         return blk
 
-    def _evict_lru(self) -> None:
-        """Reclaim ONE cached refcount-0 block, preferring leaves (no
-        cached children — evicting an inner node only strands its
-        subtree for later aging) and oldest refcount-0 age among them."""
+    def _promoting_blocks(self) -> set:
+        """Blocks reserved by the CURRENT admission's promotions whose
+        uploads have not dispatched yet.  They must never be eviction
+        victims: a CoW in the same admit can drop such a block to
+        refcount 0, and demoting it would fetch device bytes the
+        pending upload has not written (garbage host payload) while the
+        upload later scatters into whoever re-allocated the block."""
+        return {dst for dst, _, _ in self._pending_promotes}
+
+    def _select_victim_scan(self) -> Optional[_CacheEntry]:
+        """The ORIGINAL O(n·children) victim scan, kept verbatim as the
+        regression oracle for :meth:`_select_victim`: prefer leaves (no
+        children — evicting an inner node only strands its subtree for
+        later aging), oldest refcount-0 age among them."""
+        promoting = self._promoting_blocks()
         victims = [e for e in self.entries.values()
-                   if self.ref[e.block] == 0]
+                   if e.block is not None and self.ref[e.block] == 0
+                   and e.block not in promoting]
         if not victims:
-            raise NoFreeBlocks(
-                f"all {self.num_blocks} pool blocks are lane-mapped; "
-                "grow num_blocks or retire lanes first")
+            return None
         leaves = [e for e in victims
                   if not self.children.get(e.key)]
         pool = leaves or victims
-        victim = min(pool, key=lambda e: (e.freed_at
-                                          if e.freed_at is not None else 0))
-        self._drop_entry(victim)
-        self.free.append(victim.block)
+        return min(pool, key=lambda e: (e.freed_at
+                                        if e.freed_at is not None else 0))
+
+    def _heap_push(self, e: _CacheEntry) -> None:
+        self._heap_seq += 1
+        heapq.heappush(self._ref0_heap,
+                       (e.freed_at if e.freed_at is not None else 0,
+                        self._heap_seq, e.key))
+
+    def _select_victim(self) -> Optional[_CacheEntry]:
+        """Heap-backed victim selection, O(log n) amortized: pop the
+        refcount-0 index in age order, discarding stale items (entry
+        re-mapped, dropped, or demoted since push — ``freed_at`` is the
+        version stamp) and setting valid NON-leaves aside; the first
+        valid leaf wins (it is the min-age leaf, since the heap orders
+        ALL ref-0 entries by age).  A treeful of inner nodes with no
+        leaf at all falls back to the oldest set-aside entry — exactly
+        the scan's semantics, pinned by the victim-parity regression
+        test."""
+        promoting = self._promoting_blocks()
+        stash: List[Tuple[int, int, Any]] = []
+        defer: List[Tuple[int, int, Any]] = []
+        victim: Optional[_CacheEntry] = None
+        while self._ref0_heap:
+            fa, seq, key = heapq.heappop(self._ref0_heap)
+            e = self.entries.get(key)
+            if (e is None or e.block is None
+                    or self.ref[e.block] != 0
+                    or (e.freed_at if e.freed_at is not None else 0) != fa):
+                continue                     # stale: lazily deleted
+            if e.block in promoting:
+                defer.append((fa, seq, key))  # NOT selectable this round
+                continue
+            if self.children.get(key):
+                stash.append((fa, seq, key))  # valid, but not a leaf
+                continue
+            victim = e
+            break
+        if victim is None and stash:
+            fa, seq, key = stash.pop(0)       # oldest valid non-leaf
+            victim = self.entries[key]
+        for item in stash:                    # survivors stay indexed
+            heapq.heappush(self._ref0_heap, item)
+        for item in defer:                    # evictable once uploaded
+            heapq.heappush(self._ref0_heap, item)
+        return victim
+
+    def _evict_lru(self) -> None:
+        """Reclaim ONE cached refcount-0 block.  With the host tier
+        enabled the victim DEMOTES — its exact device bytes move to
+        host RAM and the radix node stays alive at a host location
+        (``block = None``), so a later admission promotes it back
+        instead of re-prefilling; without the tier (the default) the
+        entry is discarded exactly as before."""
+        victim = self._select_victim()
+        if victim is None:
+            raise NoFreeBlocks(
+                f"all {self.num_blocks} pool blocks are lane-mapped; "
+                "grow num_blocks or retire lanes first")
+        blk = victim.block
+        if self.host is not None and self.demote_fetch is not None:
+            payload = self.demote_fetch(blk)
+            self.by_block.pop(blk, None)
+            victim.block = None
+            for key in self.host.put(victim.key, payload,
+                                     pinned=self._pinned_host_keys):
+                self._drop_host_entry(key)
+            self.stats["host_demotions"] += 1
+        else:
+            self._drop_entry(victim)
+        self.free.append(blk)
         self.stats["cache_evictions"] += 1
+
+    def _drop_host_entry(self, key) -> None:
+        """A host-tier payload aged out (LRU overflow): retire its
+        radix node — the prefix is now truly cold again.  Same unlink
+        as a device drop (``by_block.pop(None)`` is a no-op for host
+        entries, whose keys there are block ints)."""
+        e = self.entries.get(key)
+        if e is not None:
+            self._drop_entry(e)
 
     def _drop_entry(self, e: _CacheEntry) -> None:
         del self.entries[e.key]
@@ -234,7 +471,9 @@ class PagedCacheManager:
             key = self.by_block.get(blk)
             if key is not None:
                 self._tick += 1
-                self.entries[key].freed_at = self._tick
+                e = self.entries[key]
+                e.freed_at = self._tick
+                self._heap_push(e)      # enters the ref-0 age index
             else:
                 self.free.append(blk)
 
@@ -253,9 +492,12 @@ class PagedCacheManager:
         partial-tail hit (a cached child block whose chunk STARTS with
         the remaining < bs tokens — mappable read-only, CoW'd before
         the lane's first write into it).  Returns
-        (blocks, full_hit_tokens, used_partial)."""
+        (entries, full_hit_tokens, used_partial) — each entry either
+        DEVICE-resident (``block`` set: map read-only, as always) or
+        HOST-resident (``block is None``: admit promotes it into a
+        fresh device block before mapping)."""
         bs = self.bs
-        blocks: List[int] = []
+        hits: List[_CacheEntry] = []
         key = None
         j = 0
         n = len(tokens)
@@ -265,7 +507,7 @@ class PagedCacheManager:
             e = self.entries.get(k2)
             if e is None or e.chunk != chunk:
                 break
-            blocks.append(e.block)
+            hits.append(e)
             key = k2
             j += 1
         hit = j * bs
@@ -275,11 +517,21 @@ class PagedCacheManager:
             for ck in self.children.get(key, ()):
                 e = self.entries[ck]
                 if e.chunk[:len(rem)] == rem:
-                    blocks.append(e.block)
+                    hits.append(e)
                     hit += len(rem)
                     partial = True
                     break
-        return blocks, hit, partial
+        return hits, hit, partial
+
+    def take_promotions(self) -> List[Tuple[int, Dict[str, Any], Any]]:
+        """Drain the promotions the last ``admit`` allocated:
+        [(dst_block, host_payload, chain_key)].  The scheduler turns
+        the batch into ONE donated device upload
+        (RingExecutor.dispatch_promotions) dispatched BEFORE the CoW
+        copies and the admission insert, so every later read on the
+        stream observes the promoted bytes."""
+        out, self._pending_promotes = self._pending_promotes, []
+        return out
 
     # -- lane lifecycle ----------------------------------------------------
 
@@ -306,22 +558,48 @@ class PagedCacheManager:
         if self.mapped_count[slot]:
             raise AssertionError(f"slot {slot} still holds blocks")
         if self.prefix_cache:
-            hit_blocks, hit_full, _partial = self._lookup(tokens)
+            hit_entries, hit_full, _partial = self._lookup(tokens)
             self.stats["prefix_lookups"] += 1
             self.stats["prefix_lookup_tokens"] += n
             if (max_suffix is not None
                     and n - min(hit_full, n - 1) > max_suffix):
-                hit_blocks, hit_full = [], 0
+                hit_entries, hit_full = [], 0
         else:
-            hit_blocks, hit_full = [], 0
+            hit_entries, hit_full = [], 0
         hit_len = min(hit_full, n - 1)
         self.stats["prefix_hit_tokens"] += hit_len
         if hit_len and hit_len == n - 1 and hit_full >= n:
             self.stats["prefix_full_hits"] += 1
 
         row = self.table[slot]
+        host_tokens_this_admit = 0
+        # pin this admission's WHOLE hit chain: a demotion fired by one
+        # of our own allocations must never overflow-drop a payload we
+        # are about to pop (the tier may exceed its bound by the chain
+        # length until the finally trims it back).  Device-resident hit
+        # entries pin too — an entry not yet mapped by this loop is
+        # refcount-0 and can itself be demoted mid-admit, at which
+        # point its turn takes the promote branch and pops its payload.
+        self._pinned_host_keys = frozenset(e.key for e in hit_entries)
         try:
-            for j, blk in enumerate(hit_blocks):
+            for j, e in enumerate(hit_entries):
+                if e.block is None:
+                    # HOST hit: reserve a device block NOW (so the
+                    # whole admission either fits or fails up front)
+                    # and queue the byte-exact upload — the scheduler
+                    # dispatches the batch before the insert.  The
+                    # entry re-anchors device-side (promote-on-hit):
+                    # later admissions hit it in HBM again.
+                    dst = self._alloc_one()
+                    payload = self.host.pop(e.key)
+                    e.block = dst
+                    self.by_block[dst] = e.key
+                    self._pending_promotes.append((dst, payload, e.key))
+                    self.stats["host_promotions"] += 1
+                    tok_inc = min(bs, max(0, hit_len - j * bs))
+                    self.stats["host_hit_tokens"] += tok_inc
+                    host_tokens_this_admit += tok_inc
+                blk = e.block
                 row[j] = blk
                 self.ref[blk] += 1
                 self.mapped_count[slot] = j + 1
@@ -330,7 +608,7 @@ class PagedCacheManager:
             # construction that is at most the last hit block
             cow: List[Tuple[int, int]] = []
             first_write_blk = hit_len // bs
-            for j in range(first_write_blk, len(hit_blocks)):
+            for j in range(first_write_blk, len(hit_entries)):
                 src = int(row[j])
                 dst = self._alloc_one()
                 self.ref[dst] += 1
@@ -346,8 +624,39 @@ class PagedCacheManager:
                 row[self.mapped_count[slot]] = blk
                 self.mapped_count[slot] += 1
         except NoFreeBlocks:
+            # roll back promotions this admit allocated: their uploads
+            # never dispatched, so the re-anchored entries would map
+            # GARBAGE device blocks as cached prefix — move each back
+            # to the host tier (there is room: we just popped them) and
+            # let retire() below free the reserved dst blocks
+            for dst, payload, key in self._pending_promotes:
+                e = self.entries.get(key)
+                if e is not None:
+                    for k2 in self.host.put(key, payload,
+                                            pinned=self._pinned_host_keys):
+                        self._drop_host_entry(k2)
+                    e.block = None
+                self.by_block.pop(dst, None)
+                # a promoted block the CoW already released sits at
+                # refcount 0 with no radix anchor left — retire() below
+                # can't reach it (the lane maps its CoW copy instead),
+                # so return it to the free list here or it leaks out of
+                # the free/mapped/cached partition entirely
+                if self.ref[dst] == 0 and dst not in self.free:
+                    self.free.append(dst)
+                self.stats["host_promotions"] -= 1
+            self._pending_promotes = []
+            # the host-served token accounting rolls back with them: a
+            # failed admission served nothing, and hostHitRate must not
+            # drift upward on NoFreeBlocks churn
+            self.stats["host_hit_tokens"] -= host_tokens_this_admit
             self.retire(slot)
             raise
+        finally:
+            if self.host is not None:
+                self._pinned_host_keys = frozenset()
+                for key in self.host.trim():    # back within the bound
+                    self._drop_host_entry(key)
         return hit_len, cow
 
     def publish(self, slot: int, prompt) -> None:
@@ -400,6 +709,32 @@ class PagedCacheManager:
         row[:] = TRASH_BLOCK
         self.mapped_count[slot] = 0
 
+    def scrub_host_chain(self, prompt) -> int:
+        """Quarantine hygiene (ISSUE 8): drop every HOST-tier payload
+        on ``prompt``'s radix chain.  Device-side the quarantine scrub
+        can prove published blocks clean (the lane only ever writes
+        private CoW'd copies), but a demoted payload is an opaque host
+        byte blob that can no longer be re-verified against the pool —
+        after a NaN quarantine the conservative move is to forget the
+        lane's chain from the tier and let the prefix re-prefill.
+        Returns the number of payloads dropped."""
+        if self.host is None:
+            return 0
+        tokens = tuple(int(t) for t in prompt)
+        key = None
+        dropped = 0
+        for j in range(len(tokens) // self.bs):
+            chunk = tokens[j * self.bs:(j + 1) * self.bs]
+            key = self._chain_key(key, chunk)
+            e = self.entries.get(key)
+            if e is None:
+                continue    # gap in the chain: deeper entries may remain
+            if e.block is None:
+                self.host.drop(key)
+                self._drop_host_entry(key)
+                dropped += 1
+        return dropped
+
     def device_table(self) -> jax.Array:
         return jnp.asarray(self.table)
 
@@ -429,12 +764,33 @@ class PagedCacheManager:
             if self.ref[blk] and blk not in mapped:
                 raise AssertionError(f"block {blk} refcounted but unmapped")
         cached_only = {e.block for e in self.entries.values()
-                       if self.ref[e.block] == 0}
+                       if e.block is not None and self.ref[e.block] == 0}
         assert not (cached_only & free), "cached block on the free list"
         assert len(free) + len(mapped) + len(cached_only) \
             == self.num_blocks, (
             f"pool partition broken: {len(free)} free + {len(mapped)} "
             f"mapped + {len(cached_only)} cached != {self.num_blocks}")
+        # host-tier accounting (ISSUE 8): every demoted entry's payload
+        # is in the tier, every tier payload has a live radix node, the
+        # tier respects its bound, and nothing is promoting outside an
+        # admission (take_promotions drains before the dispatch) — so
+        # free + mapped + cached + promoting == num_blocks holds with
+        # promoting == len(_pending_promotes) counted inside `mapped`
+        # (promoted blocks are lane-refcounted the moment they are
+        # reserved)
+        demoted = {e.key for e in self.entries.values() if e.block is None}
+        if self.host is not None:
+            host_keys = set(self.host.keys())
+            assert demoted == host_keys, (
+                f"host tier desync: {len(demoted)} demoted entries vs "
+                f"{len(host_keys)} host payloads")
+            assert len(self.host) <= self.host.capacity, \
+                "host tier exceeded its bound"
+            promoting = {dst for dst, _, _ in self._pending_promotes}
+            assert promoting <= mapped, \
+                "in-flight promotion targets an unmapped block"
+        else:
+            assert not demoted, "demoted entry without a host tier"
 
 
 # ---------------------------------------------------------------------------
@@ -1272,6 +1628,74 @@ def make_pool_transfer(max_blocks: int, quant: bool = False):
     if quant:
         return jax.jit(transfer_quant, donate_argnums=(0, 1, 2, 3, 4, 5))
     return jax.jit(transfer, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=4)
+def make_block_fetch(quant: bool = False):
+    """The DEMOTE read: slice ONE pool block's exact device bytes (all
+    layers, K and V — plus its scale rows under int8) for the host
+    fetch the spill tier stores.  Not donated: the pool stays live.
+    ``fetch(k, v, blk) -> (kb [L,1,H,bs,D], vb)``; quant adds
+    ``ks``/``vs`` -> ``(kb, vb, ksb [L,1,H], vsb)``."""
+
+    def fetch(k, v, blk):
+        lcount, _, h, bs, d = k.shape
+        kb = jax.lax.dynamic_slice(k, (0, blk, 0, 0, 0),
+                                   (lcount, 1, h, bs, d))
+        vb = jax.lax.dynamic_slice(v, (0, blk, 0, 0, 0),
+                                   (lcount, 1, h, bs, d))
+        return kb, vb
+
+    def fetch_quant(k, v, ks, vs, blk):
+        lcount = k.shape[0]
+        h = k.shape[2]
+        kb, vb = fetch(k, v, blk)
+        ksb = jax.lax.dynamic_slice(ks, (0, blk, 0), (lcount, 1, h))
+        vsb = jax.lax.dynamic_slice(vs, (0, blk, 0), (lcount, 1, h))
+        return kb, vb, ksb, vsb
+
+    return jax.jit(fetch_quant if quant else fetch)
+
+
+@functools.lru_cache(maxsize=4)
+def make_promote_blocks(block_size: int, quant: bool = False):
+    """The PROMOTE upload: scatter a batch of host payloads into their
+    reserved pool blocks in ONE donated jit — the bf16 path is exactly
+    the whole-block ``scatter_prefill_blocks`` write the prefill path
+    uses (the payload batch rides as one contiguous
+    ``[L, 1, H, n*bs, D]`` slab, block j landing at ``ids[j]``); the
+    int8 path copies codes AND scale rows verbatim
+    (ops/decode_attention.py ``scatter_promote_blocks_quant``) — a
+    promote never re-quantizes, which is what makes a host hit
+    bit-identical to the HBM hit it demoted from.  Callers pad ``ids``
+    with the trash block (and the slab with zeros) to a small shape
+    ladder so a handful of compiles serves every batch size.
+
+    ``up(pool_k, pool_v, rows_k, rows_v, ids) -> (pool_k', pool_v')``;
+    quant: ``up(pool_k, pool_v, ks, vs, rows_k, rows_v, srow_k,
+    srow_v, ids) -> (pool_k', pool_v', ks', vs')`` with ``srow_*``
+    [L, n, H] scale rows."""
+    from paddle_operator_tpu.ops.decode_attention import (
+        scatter_prefill_blocks,
+        scatter_promote_blocks_quant,
+    )
+
+    def up(pool_k, pool_v, rows_k, rows_v, ids):
+        pool_k = scatter_prefill_blocks(pool_k, rows_k, ids, block_size)
+        pool_v = scatter_prefill_blocks(pool_v, rows_v, ids, block_size)
+        return pool_k, pool_v
+
+    def up_quant(pool_k, pool_v, ks, vs, rows_k, rows_v, srow_k, srow_v,
+                 ids):
+        pool_k, ks = scatter_promote_blocks_quant(
+            pool_k, ks, rows_k, srow_k, ids, block_size)
+        pool_v, vs = scatter_promote_blocks_quant(
+            pool_v, vs, rows_v, srow_v, ids, block_size)
+        return pool_k, pool_v, ks, vs
+
+    if quant:
+        return jax.jit(up_quant, donate_argnums=(0, 1, 2, 3))
+    return jax.jit(up, donate_argnums=(0, 1))
 
 
 @functools.lru_cache(maxsize=4)

@@ -221,6 +221,7 @@ class ContinuousBatcher:
                  prefill_chunk: int = 64,
                  prewarm: bool = False,
                  kv_quant: str = "none",
+                 host_cache_blocks: int = 0,
                  resilience: Optional[RingResilience] = None) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
@@ -276,7 +277,8 @@ class ContinuousBatcher:
             spec_k=spec_k, paged=paged, block_size=block_size,
             num_blocks=num_blocks, prefix_cache=prefix_cache,
             prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
-            check_finite=self._check_finite, kv_quant=kv_quant)
+            check_finite=self._check_finite, kv_quant=kv_quant,
+            host_cache_blocks=host_cache_blocks)
         self.mesh = mesh
         self.paged = self.executor.paged
         self.kv_quant = self.executor.kv_quant
@@ -323,6 +325,11 @@ class ContinuousBatcher:
                       "prefill_calls": 0, "prefill_tokens": 0,
                       "chunked_prefill_tokens": 0, "disagg_prefills": 0,
                       "cow_copies": 0,
+                      # hierarchical-cache accounting (ISSUE 8): blocks
+                      # uploaded back from the host tier — cumulative
+                      # across watchdog rebuilds (the pool's own stats
+                      # reset with the allocator)
+                      "promoted_blocks": 0,
                       # fault-tolerance accounting (infer/resilience.py):
                       # deadline partials delivered, self-healing ring
                       # rebuilds, and NaN-quarantined lanes — surfaced
@@ -612,6 +619,15 @@ class ContinuousBatcher:
                              if self.pool is not None else 0),
             "kvBlocksHwm": (self.pool.stats["blocks_hwm"]
                             if self.pool is not None else 0),
+            # hierarchical cache (ISSUE 8): blocks resident in the host
+            # spill tier, the share of looked-up prefix tokens served
+            # from host payloads, and cumulative promotions — the
+            # tpujob_serve_host_* gauges (all 0 with the tier off)
+            "hostCacheBlocks": (self.pool.host_blocks()
+                                if self.pool is not None else 0),
+            "hostHitRate": (self.pool.host_hit_rate()
+                            if self.pool is not None else 0.0),
+            "promotedBlocks": self.stats["promoted_blocks"],
             # prefill-path visibility (ISSUE 6): which admission path
             # this ring runs, how many admitted requests are still
             # prefilling, and the share of prefill tokens that arrived
@@ -792,8 +808,21 @@ class ContinuousBatcher:
         rows (its CoW'd private copy), and both the suffix forward's
         tail-substituted read of [block_start, hit_len) and the
         eventual on-completion requantize of the WHOLE block need those
-        rows present in the tail (paged.make_tail_init)."""
+        rows present in the tail (paged.make_tail_init).
+
+        Runs the admission's host-tier PROMOTIONS first (ISSUE 8): any
+        radix hit the walk classified as host-resident reserved its
+        device block inside pool.admit — the batched donated upload
+        must reach the stream BEFORE a CoW that may copy a promoted
+        block and before the insert that reads it.  All dispatches are
+        async, so the transfer overlaps whatever chunk is already
+        decoding; activation (the insert) is stream-ordered behind the
+        transfer's completion."""
         ex = self.executor
+        promotes = self.pool.take_promotions()
+        if promotes:
+            ex.dispatch_promotions(promotes)
+            self.stats["promoted_blocks"] += len(promotes)
         if ex.quant:
             for src, dst in cow:
                 (ex.cache["k"], ex.cache["v"], ex.cache["ks"],
@@ -1051,7 +1080,13 @@ class ContinuousBatcher:
             self._activate(slot, req, first)
             return
         # cold: fresh blocks are already mapped by admit (hit_len == 0
-        # here unless spec, whose prefix cache is off -> also 0)
+        # here unless spec, whose prefix cache is off -> also 0).  The
+        # post-admit hook still runs: a hit_len-0 PARTIAL-tail hit can
+        # map (and host-promote) one block whose upload/CoW must not
+        # stay pending — the handoff overwrites the lane's view, but
+        # the promoted entry re-anchored in the radix cache and a later
+        # hit on it must read real bytes
+        self._dispatch_cow(slot, cow, hit_len)
         self._disagg_waiting[slot] = req
         self.executor.prefill_exec.submit(req, slot)
 
@@ -1207,7 +1242,7 @@ class ContinuousBatcher:
                 self.lane[i] = None
         self._shed_queue(ShuttingDown("batcher closed"))
 
-    def _scrub_lane_blocks(self, slot: int) -> None:
+    def _scrub_lane_blocks(self, slot: int, req=None) -> None:
         """Zero lane ``slot``'s PRIVATE pool blocks before they return
         to the free list: a NaN row in a re-mapped block would poison
         the next lane through the masked-tail contraction (softmax
@@ -1244,6 +1279,11 @@ class ContinuousBatcher:
             # incomplete block never reached the pool)
             ex.cache["kt"] = ex.cache["kt"].at[:, slot].set(0)
             ex.cache["vt"] = ex.cache["vt"].at[:, slot].set(0)
+        if req is not None:
+            # host tier (ISSUE 8): demoted payloads on the quarantined
+            # lane's prompt chain are opaque host bytes that cannot be
+            # re-verified — drop them so the prefix re-prefills clean
+            self.pool.scrub_host_chain(req.prompt)
 
     def _consume(self, chunk_reqs, toks, counts=None, ok=None) -> None:
         """Apply one finished chunk's tokens ([chunk, slots] on host).
@@ -1273,7 +1313,7 @@ class ContinuousBatcher:
             if ok is not None and not bool(ok[i]):
                 self.stats["quarantined_lanes"] += 1
                 if self.pool is not None:
-                    self._scrub_lane_blocks(i)
+                    self._scrub_lane_blocks(i, req)
                 self._finish(req, LaneQuarantined(
                     f"lane {i} produced non-finite logits; request "
                     "failed, lane quarantined (ring unaffected)"))

@@ -488,6 +488,30 @@ def main() -> int:
                 "SERVE_PREFIX_CACHE", "1") == "1"
             if os.environ.get("SERVE_NUM_BLOCKS"):
                 ring_kw["num_blocks"] = int(os.environ["SERVE_NUM_BLOCKS"])
+            # Hierarchical cache (docs/serving.md): a host-RAM spill
+            # tier behind the radix cache — eviction DEMOTES refcount-0
+            # cached blocks to pinned host memory instead of discarding
+            # them, and a later hit promotes them back byte-exactly
+            # (host RAM holds 10-100x more prefix blocks than the pool
+            # at a transfer cost far below re-prefill).  Size it with
+            # SERVE_HOST_CACHE_BLOCKS (blocks) or SERVE_HOST_CACHE_MB
+            # (megabytes, converted at the pool's per-block host cost);
+            # 0/unset (default) keeps behavior byte-identical to the
+            # tier-less ring.  Pays when the tenant working set exceeds
+            # the HBM pool; skip it for latency-bound single-tenant
+            # rings whose working set already fits.
+            host_blocks = int(os.environ.get("SERVE_HOST_CACHE_BLOCKS",
+                                             "0"))
+            host_mb = float(os.environ.get("SERVE_HOST_CACHE_MB", "0"))
+            if not host_blocks and host_mb > 0:
+                from paddle_operator_tpu.infer.paged import (
+                    host_block_bytes,
+                )
+
+                host_blocks = int(host_mb * 1e6 // host_block_bytes(
+                    cfg, ring_kw["block_size"], kvq))
+            if host_blocks > 0:
+                ring_kw["host_cache_blocks"] = host_blocks
         # SERVE_PREFILL=inline|chunked|disagg (docs/serving.md): how
         # admission prefill reaches the device.  ``chunked`` interleaves
         # SERVE_PREFILL_CHUNK-token slices into ring iterations so a

@@ -696,6 +696,31 @@ def scatter_prefill_blocks_quant(pool: jax.Array, scales: jax.Array,
     return pool, scales
 
 
+def scatter_promote_blocks_quant(pool: jax.Array, scales: jax.Array,
+                                 rows: jax.Array, scale_rows: jax.Array,
+                                 table_row: jax.Array, block_size: int):
+    """:func:`scatter_prefill_blocks` for PROMOTING already-quantized
+    blocks back from the host tier (infer/paged.py HostCacheTier): the
+    payload's int8 codes (``rows`` [L, 1, H, T, D], T a block multiple)
+    and its per-block scale rows (``scale_rows`` [L, T//bs, H]) are
+    copied VERBATIM to the pool at the reserved table entries — unlike
+    ``scatter_prefill_blocks_quant`` there is no quantize on the way
+    in, because a demoted block's scale was computed exactly once at
+    its original completion and re-deriving it from dequantized rows
+    would break the promote-is-a-byte-copy guarantee the host-hit
+    bit-exactness rests on.  Returns ``(pool', scales')``."""
+    t = rows.shape[3]
+    for j in range(t // block_size):
+        blk = jax.lax.slice_in_dim(rows, j * block_size,
+                                   (j + 1) * block_size, axis=3)
+        pool = jax.lax.dynamic_update_slice(
+            pool, blk, (0, table_row[j], 0, 0, 0))
+        scales = jax.lax.dynamic_update_slice(
+            scales, jax.lax.slice_in_dim(scale_rows, j, j + 1, axis=1),
+            (0, table_row[j], 0))
+    return pool, scales
+
+
 def decode_attention_reference(q: jax.Array, k_cache: jax.Array,
                                v_cache: jax.Array,
                                lengths: jax.Array) -> jax.Array:

@@ -117,6 +117,17 @@ def serving_gauges(status_serving: dict, job: str) -> dict:
         ("tpujob_serve_kv_pool_bytes"
          f'{{job="{job}",mode="{status_serving.get("kvQuantMode", "none")}"}}'):
             float(status_serving.get("kvPoolBytes", 0.0)),
+        # hierarchical KV cache (SERVE_HOST_CACHE_MB/_BLOCKS): blocks
+        # resident in the host spill tier, the share of looked-up
+        # prefix tokens served from host payloads (promote path), and
+        # cumulative blocks promoted host->device — all 0 when the
+        # tier is off
+        f"tpujob_serve_host_cache_blocks{lbl}":
+            float(status_serving.get("hostCacheBlocks", 0.0)),
+        f"tpujob_serve_host_hit_rate{lbl}":
+            float(status_serving.get("hostHitRate", 0.0)),
+        f"tpujob_serve_promoted_blocks_total{lbl}":
+            float(status_serving.get("promotedBlocks", 0.0)),
         # serving fault tolerance (infer/resilience.py): deadline
         # partials served, self-healing ring rebuilds, NaN-quarantined
         # lanes, and the drain flag (1 while the pod sheds admissions)

@@ -145,6 +145,60 @@ class TestAllocator:
         assert mgr.stats["cache_evictions"] == 8
         mgr.retire(0)
 
+    def test_heap_eviction_matches_scan_on_seeded_sequence(self):
+        """Satellite regression (ISSUE 8): `_evict_lru`'s victim
+        selection moved from an O(n·children) full scan to a lazy
+        refcount-0 heap — the SEEDED lifecycle below must reclaim the
+        SAME victims in the SAME order (and the same eviction count)
+        under both selectors, or LRU behavior silently drifted."""
+        import random
+
+        def drive(mgr):
+            rng = random.Random(42)
+            prompts = [[rng.randrange(50) for _ in range(rng.choice(
+                (8, 16, 17, 24, 33)))] for _ in range(12)]
+            for it in range(40):
+                p = prompts[rng.randrange(len(prompts))]
+                slot = rng.randrange(2)
+                if mgr.mapped_count[slot]:
+                    mgr.retire(slot)
+                try:
+                    mgr.admit(slot, p)
+                    mgr.publish(slot, p)
+                except NoFreeBlocks:
+                    pass
+                mgr.check_invariant()
+            for slot in range(2):
+                if mgr.mapped_count[slot]:
+                    mgr.retire(slot)
+
+        def instrument(mgr, log):
+            sel = mgr._select_victim
+
+            def wrapped():
+                v = sel()
+                if v is not None:
+                    log.append((v.key, tuple(v.chunk)))
+                return v
+            mgr._select_victim = wrapped
+
+        fast_log, scan_log = [], []
+        fast = PagedCacheManager(slots=2, max_len=64, block_size=8,
+                                 num_blocks=10)
+        instrument(fast, fast_log)
+        drive(fast)
+
+        scan = PagedCacheManager(slots=2, max_len=64, block_size=8,
+                                 num_blocks=10)
+        scan._select_victim = scan._select_victim_scan  # the old path
+        instrument(scan, scan_log)
+        drive(scan)
+
+        assert fast_log, "seeded sequence never evicted — test is dead"
+        assert fast_log == scan_log, "heap selector picked different victims"
+        assert (fast.stats["cache_evictions"]
+                == scan.stats["cache_evictions"])
+
     def test_no_free_blocks_raises_and_rolls_back(self):
         mgr = PagedCacheManager(slots=2, max_len=64, block_size=8,
                                 num_blocks=8)

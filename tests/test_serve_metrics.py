@@ -25,7 +25,9 @@ SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "tokensTotal": 9000, "prefixHitRate": 0.31, "kvBlocksFree": 17,
            "prefillMode": "chunked", "prefillQueueDepth": 2,
            "chunkedPrefillTokenShare": 0.85,
-           "kvQuantMode": "int8", "kvPoolBytes": 4096}
+           "kvQuantMode": "int8", "kvPoolBytes": 4096,
+           "hostCacheBlocks": 5, "hostHitRate": 0.12,
+           "promotedBlocks": 42}
 
 
 class TestGaugeNaming:
@@ -47,6 +49,13 @@ class TestGaugeNaming:
         # storage mode, mirroring the prefill queue-depth label scheme
         assert g['tpujob_serve_kv_pool_bytes'
                  '{job="default/j",mode="int8"}'] == 4096.0
+        # hierarchical-cache gauges (ISSUE 8): host-tier residency,
+        # host-served prefix-token share, cumulative promotions
+        assert g['tpujob_serve_host_cache_blocks'
+                 '{job="default/j"}'] == 5.0
+        assert g['tpujob_serve_host_hit_rate{job="default/j"}'] == 0.12
+        assert g['tpujob_serve_promoted_blocks_total'
+                 '{job="default/j"}'] == 42.0
 
     def test_prefill_mode_label_defaults_inline(self):
         g = serving_gauges({}, "ns/x")
@@ -169,12 +178,18 @@ class TestBatcherServingStatus:
                            "chunkedPrefillTokenShare",
                            # quantized-pool block (ISSUE 7)
                            "kvQuantMode", "kvPoolBytes",
+                           # hierarchical-cache block (ISSUE 8)
+                           "hostCacheBlocks", "hostHitRate",
+                           "promotedBlocks",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
         assert st["prefillMode"] == "inline"
         assert st["prefillQueueDepth"] == 0
         assert st["kvQuantMode"] == "none"     # bf16 default
+        assert st["hostCacheBlocks"] == 0      # tier off by default
+        assert st["hostHitRate"] == 0.0
+        assert st["promotedBlocks"] == 0
         assert st["kvPoolBytes"] > 0
         assert st["tokensTotal"] == 4
         assert st["tokensPerSec"] > 0
