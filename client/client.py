@@ -60,11 +60,21 @@ def post_generate(base_url, payload, *, deadline_s=None, max_retries=4,
       one draining pod must not re-dogpile its replacement in sync;
     - the request ``deadline_s`` caps everything: it is sent to the
       server (``X-Request-Deadline``) AND no retry is attempted that
-      could not complete before the deadline.
+      could not complete before the deadline;
+    - every attempt carries the SAME idempotent ``request_id`` (the
+      caller's, or a uuid minted once before the first attempt).
+      Behind the fleet router this is what makes retries exactly-once:
+      a 503 that raced the original's completion (the replica drained
+      AFTER finishing the work, or the connection died on the response
+      path) replays the recorded result instead of generating twice.
 
     ``rng``/``sleep`` are injectable for deterministic tests.  Returns
     ``(status_code, response_dict)``."""
     rng = rng if rng is not None else random.Random()
+    if "request_id" not in payload:
+        import uuid
+
+        payload = dict(payload, request_id=uuid.uuid4().hex)
     deadline = (time.monotonic() + deadline_s
                 if deadline_s is not None else None)
     attempt = 0

@@ -9,6 +9,7 @@ from paddle_operator_tpu.api.types import (  # noqa: F401
     Phase,
     ResourceSpec,
     ResourceStatus,
+    ServingSpec,
     TPUJob,
     TPUJobSpec,
     TPUJobStatus,

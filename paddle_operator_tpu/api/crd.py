@@ -219,6 +219,20 @@ def _spec_schema() -> Dict[str, Any]:
             "ps": _resource_spec_schema(),
             "worker": _resource_spec_schema(),
             "heter": _resource_spec_schema(),
+            # serving fleet (ISSUE 9): replica ring pods behind the
+            # prefix-affinity router — see api/types.py ServingSpec
+            "serving": {
+                "type": "object",
+                "required": ["replicas"],
+                "properties": {
+                    "replicas": _int(0),
+                    "port": _int(1),
+                    "template": _pod_template_schema(),
+                    "router": _pod_template_schema(),
+                    "affinityBlocks": _int(0),
+                    "blockSize": _int(1),
+                },
+            },
             "tpu": {
                 "type": "object",
                 "properties": {
@@ -270,6 +284,9 @@ def _status_schema() -> Dict[str, Any]:
             "ps": _resource_status_schema(),
             "worker": _resource_status_schema(),
             "heter": _resource_status_schema(),
+            # serving-fleet pod counters (replica + router pods);
+            # excluded from gang phase derivation — see types.py
+            "serve": _resource_status_schema(),
             "elastic": {"type": "string"},
             "startTime": {"type": "string", "format": "date-time"},
             "completionTime": {"type": "string", "format": "date-time"},
@@ -295,7 +312,11 @@ def _status_schema() -> Dict[str, Any]:
             # chunkedPrefillTokenShare — the quantized-pool keys
             # (ISSUE 7): kvQuantMode, kvPoolBytes — and the
             # hierarchical-cache keys (ISSUE 8): hostCacheBlocks,
-            # hostHitRate, promotedBlocks — schemaless on purpose
+            # hostHitRate, promotedBlocks — and the fleet keys
+            # (ISSUE 9): per-replica blocks under ``replicas`` plus
+            # the reconciler-owned ``fleet`` sub-block
+            # (replicasDesired/replicasReady/routerReady/
+            # drainedReplicas/replicaRestarts) — schemaless on purpose
             # (preserve-unknown-fields) so the workload can grow
             # telemetry without a CRD rev.
             "serving": {

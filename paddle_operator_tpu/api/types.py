@@ -28,10 +28,19 @@ from typing import Any, Dict, List, Optional
 # ---------------------------------------------------------------------------
 
 # Resource (role) types.  The reference has ps/worker/heter
-# (api/v1/paddlejob_types.go:33-38).
+# (api/v1/paddlejob_types.go:33-38); serve/router are the serving-fleet
+# roles (ISSUE 9) — N inference ring replicas behind a prefix-affinity
+# router, reconciled by their own drain-aware path (never the training
+# gang machinery).
 RESOURCE_PS = "ps"
 RESOURCE_WORKER = "worker"
 RESOURCE_HETER = "heter"
+RESOURCE_SERVE = "serve"
+RESOURCE_ROUTER = "router"
+
+# Default port serving replicas bind (/v1/generate + /readyz +
+# /metrics) and the router fronts; per-job override in ServingSpec.
+SERVE_PORT = 8700
 
 # Label / annotation keys stamped on child resources
 # (reference: api/v1/paddlejob_types.go:27-31 -> "paddle-res-name" etc.)
@@ -261,6 +270,68 @@ class ResourceSpec:
 
 
 @dataclass
+class ServingSpec:
+    """The serving fleet (ISSUE 9): N inference ring replicas
+    (infer/serve.py pods) behind one prefix-affinity router
+    (paddle_operator_tpu/router).  Unlike the training roles, replicas
+    are independent processes — no XLA world spans them — so scale
+    up/down is per-replica (drain the victim, admit the newcomer on
+    /readyz) and NEVER a gang teardown.
+
+    - ``replicas``         desired ring replicas; scaling down drains
+      victims one at a time (503 + Retry-After -> exit 83 -> counted
+      preempted, not failed);
+    - ``port``             the port each replica serves on and the
+      router listens on;
+    - ``template``         replica pod template (the serving
+      container: image + SERVE_* env; the operator injects identity,
+      port and the rendezvous ConfigMap);
+    - ``router``           optional router pod template — when empty
+      the router container is derived from the replica template's
+      image running ``python -m paddle_operator_tpu.router``;
+    - ``affinity_blocks``  prefix blocks in the router's affinity key
+      (0 = pure least-loaded routing);
+    - ``block_size``       must match the replicas' SERVE_BLOCK_SIZE —
+      the radix chain the affinity key reuses is block-granular.
+    """
+
+    replicas: int = 1
+    port: int = SERVE_PORT
+    template: Dict[str, Any] = field(default_factory=dict)
+    router: Dict[str, Any] = field(default_factory=dict)
+    affinity_blocks: int = 2
+    block_size: int = 256
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"replicas": self.replicas}
+        if self.port != SERVE_PORT:
+            d["port"] = self.port
+        if self.template:
+            d["template"] = self.template
+        if self.router:
+            d["router"] = self.router
+        if self.affinity_blocks != 2:
+            d["affinityBlocks"] = self.affinity_blocks
+        if self.block_size != 256:
+            d["blockSize"] = self.block_size
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["ServingSpec"]:
+        if d is None:
+            return None
+        return cls(
+            replicas=int(d.get("replicas", 1)),
+            port=int(d.get("port", SERVE_PORT)),
+            template=d.get("template", {}) or {},
+            router=d.get("router", {}) or {},
+            affinity_blocks=int(d.get("affinityBlocks", 2)),
+            block_size=int(d.get("blockSize", 256)),
+        )
+
+
+@dataclass
 class TPUJobSpec:
     """Desired state (reference: PaddleJobSpec api/v1/paddlejob_types.go:110-131).
 
@@ -275,6 +346,9 @@ class TPUJobSpec:
     ps: Optional[ResourceSpec] = None
     worker: Optional[ResourceSpec] = None
     heter: Optional[ResourceSpec] = None
+    # Serving fleet (ISSUE 9): replica pods + router, reconciled by the
+    # drain-aware fleet path — orthogonal to the training roles above.
+    serving: Optional[ServingSpec] = None
     tpu: Optional[TPUSpec] = None
     mesh: Optional[MeshSpec] = None
     # Fault tolerance: how many whole-job restarts are allowed before Failed.
@@ -293,6 +367,8 @@ class TPUJobSpec:
         for k, v in (("ps", self.ps), ("worker", self.worker), ("heter", self.heter)):
             if v is not None:
                 d[k] = v.to_dict()
+        if self.serving is not None:
+            d["serving"] = self.serving.to_dict()
         if self.tpu is not None:
             d["tpu"] = self.tpu.to_dict()
         if self.mesh is not None:
@@ -314,6 +390,7 @@ class TPUJobSpec:
             ps=ResourceSpec.from_dict(d.get("ps")),
             worker=ResourceSpec.from_dict(d.get("worker")),
             heter=ResourceSpec.from_dict(d.get("heter")),
+            serving=ServingSpec.from_dict(d.get("serving")),
             tpu=TPUSpec.from_dict(d.get("tpu")),
             mesh=MeshSpec.from_dict(d.get("mesh")),
             max_restarts=int(d.get("maxRestarts", 0)),
@@ -387,6 +464,12 @@ class TPUJobStatus:
     # The reference defines heter in the spec but never reconciles it (dead
     # scaffolding, SURVEY.md §2 C2); here heter is a first-class role.
     heter: ResourceStatus = field(default_factory=ResourceStatus)
+    # Serving-fleet pod counters (replica + router pods, ISSUE 9).
+    # Deliberately EXCLUDED from the gang phase/restart derivation
+    # (builders.get_job_phase reads ps/worker/heter only): a serving
+    # replica exiting 83 is a completed drain handled by the fleet
+    # path, never a reason to tear the training gang down.
+    serve: ResourceStatus = field(default_factory=ResourceStatus)
     elastic: str = ""
     start_time: Optional[str] = None          # RFC3339
     completion_time: Optional[str] = None
@@ -450,6 +533,9 @@ class TPUJobStatus:
         heter = self.heter.to_dict()
         if heter:
             d["heter"] = heter
+        serve = self.serve.to_dict()
+        if serve:
+            d["serve"] = serve
         if self.elastic:
             d["elastic"] = self.elastic
         if self.start_time:
@@ -481,6 +567,7 @@ class TPUJobStatus:
             ps=ResourceStatus.from_dict(d.get("ps")),
             worker=ResourceStatus.from_dict(d.get("worker")),
             heter=ResourceStatus.from_dict(d.get("heter")),
+            serve=ResourceStatus.from_dict(d.get("serve")),
             elastic=d.get("elastic", ""),
             start_time=d.get("startTime"),
             completion_time=d.get("completionTime"),
@@ -530,6 +617,18 @@ class TPUJob:
                 if role.requests is not None and role.limits is not None \
                         and role.requests > role.limits:
                     errs.append(f"{role_name}: requests > limits")
+        if self.spec.serving is not None:
+            sv = self.spec.serving
+            if sv.replicas < 0:
+                errs.append("serving.replicas must be >= 0")
+            if sv.replicas > 0 and not (
+                    (sv.template.get("spec") or {}).get("containers")):
+                errs.append("serving.template must carry at least one "
+                            "container")
+            if sv.block_size < 1:
+                errs.append("serving.blockSize must be >= 1")
+            if sv.affinity_blocks < 0:
+                errs.append("serving.affinityBlocks must be >= 0")
         if self.spec.tpu is not None:
             try:
                 self.spec.tpu.chips_per_slice()

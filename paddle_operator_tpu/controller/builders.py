@@ -31,6 +31,8 @@ from paddle_operator_tpu.api.types import (
     RESOURCE_HETER,
     RESOURCE_NAME_LABEL,
     RESOURCE_PS,
+    RESOURCE_ROUTER,
+    RESOURCE_SERVE,
     RESOURCE_TYPE_LABEL,
     RESOURCE_WORKER,
     TRAINING_ROLE,
@@ -140,7 +142,13 @@ def get_job_phase(job: TPUJob) -> str:
     getPaddleJobPhase helper.go:32-49, with the restart path added —
     the reference marks any pod failure as terminal Failed; we allow
     ``spec.maxRestarts`` whole-job restarts first, realizing what
-    docs/design-fault-tolerant.md only sketches)."""
+    docs/design-fault-tolerant.md only sketches).
+
+    Serving-fleet pods (``status.serve``) never feed the failure /
+    restart logic: a replica exiting 83 is a completed drain the fleet
+    path absorbs (replace or scale-down), not a gang fault.  A
+    serving-ONLY job derives its phase from the fleet instead — it is
+    long-running, so it never completes from pod success."""
     st = job.status
     if st.phase in (Phase.COMPLETED, Phase.SUCCEED):
         return Phase.COMPLETED
@@ -153,6 +161,13 @@ def get_job_phase(job: TPUJob) -> str:
     if st.phase == Phase.SCALING:
         # Same stickiness for the gang-rescale cycle (reconciler._rescale).
         return Phase.SCALING
+    if (job.spec.serving is not None and job.spec.ps is None
+            and job.spec.worker is None and job.spec.heter is None):
+        if st.serve.running > 0:
+            return Phase.RUNNING
+        if st.serve.pending > 0 or st.serve.starting > 0:
+            return Phase.PENDING
+        return Phase.STARTING
     failed = st.ps.failed + st.worker.failed + st.heter.failed
     if failed > 0:
         preempted = (st.ps.preempted + st.worker.preempted
@@ -265,9 +280,19 @@ def construct_configmap(job: TPUJob, child_pods: List[Dict[str, Any]]) -> Option
         [None] * job.spec.heter.replicas if job.spec.heter else []
     )
 
+    serve_hosts: Dict[int, str] = {}
     for pod in child_pods:
-        host = _pod_host(job, pod)
         res_type, idx = extract_name_index(pod["metadata"]["name"])
+        if res_type in (RESOURCE_SERVE, RESOURCE_ROUTER):
+            # fleet pods never gate the TRAINING rendezvous barrier;
+            # their endpoint list below is partial-tolerant (it
+            # regenerates as addresses appear, and the router re-reads
+            # it live via the mounted ConfigMap volume)
+            host = _pod_host(job, pod)
+            if res_type == RESOURCE_SERVE and host is not None:
+                serve_hosts[idx] = host
+            continue
+        host = _pod_host(job, pod)
         if host is None:
             return None
         if res_type == RESOURCE_PS and idx < len(ps_hosts):
@@ -350,6 +375,17 @@ def construct_configmap(job: TPUJob, child_pods: List[Dict[str, Any]]) -> Option
         data["TPUJOB_CHECKPOINT_PATH"] = job.spec.checkpoint_path
     if job.spec.max_restarts:
         data["TPUJOB_MAX_RESTARTS"] = str(job.spec.max_restarts)
+
+    if job.spec.serving is not None:
+        # Serving fleet (ISSUE 9): the replica endpoint list the router
+        # consumes.  Env at router start AND re-read live from the
+        # ConfigMap volume mount (ROUTER_ENDPOINTS_FILE) so scale
+        # up/down reaches a RUNNING router — env vars cannot.  Ordered
+        # by replica index; only address-bearing replicas appear.
+        port = job.spec.serving.port
+        data["TPUJOB_SERVE_REPLICAS"] = ",".join(
+            f"{serve_hosts[i]}:{port}" for i in sorted(serve_hosts))
+        data["TPUJOB_SERVE_FLEET_SIZE"] = str(job.spec.serving.replicas)
 
     return {
         "apiVersion": "v1",
@@ -500,17 +536,212 @@ def construct_pod(job: TPUJob, res_type: str, idx: int) -> Dict[str, Any]:
 
 
 # ---------------------------------------------------------------------------
+# Serving fleet (ISSUE 9): replica + router pods and the fleet service
+# ---------------------------------------------------------------------------
+
+
+def _env_setdefault(env: List[Dict[str, Any]], name: str,
+                    value: str) -> None:
+    """Inject env only when the template did not set it — the user's
+    SERVE_* knobs always win over operator defaults."""
+    if not any(e.get("name") == name for e in env):
+        env.append({"name": name, "value": value})
+
+
+def _stamp_fleet_child(job: TPUJob, template: Dict[str, Any],
+                       res_type: str, name: str,
+                       port: int) -> Tuple[Dict[str, Any],
+                                           Dict[str, Any],
+                                           Dict[str, Any]]:
+    """The child-pod identity contract, once: deepcopy the template,
+    stamp the labels/annotations every fleet consumer keys on
+    (extract_name_index, _is_fleet_child, per-pod service selectors),
+    wire the rendezvous ConfigMap via envFrom, and declare ``port`` on
+    the first container.  Returns (meta, spec, first_container) for
+    the role-specific stamping.  A labeling-contract change edits THIS
+    function, not each builder."""
+    import copy as _copy
+
+    template = _copy.deepcopy(template) if template else {}
+    meta = template.get("metadata", {}) or {}
+    spec = template.get("spec", {}) or {}
+    labels = meta.setdefault("labels", {})
+    labels[RESOURCE_NAME_LABEL] = name
+    labels[RESOURCE_TYPE_LABEL] = res_type
+    labels[GANG_LABEL] = job.name
+    meta.setdefault("annotations", {})[RESOURCE_ANNOTATION] = res_type
+    meta["name"] = name
+    meta["namespace"] = job.namespace
+    containers = spec.setdefault("containers", [])
+    if not containers:
+        raise ValueError(f"{res_type} template has no containers")
+    c0 = containers[0]
+    c0.setdefault("envFrom", []).append(
+        {"configMapRef": {"name": job.name}})
+    ports = c0.setdefault("ports", [])
+    if not any(p.get("containerPort") == port for p in ports):
+        ports.append({"name": res_type[:5], "containerPort": port})
+    return meta, spec, c0
+
+
+def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
+    """One serving-ring replica pod from ``spec.serving.template``.
+
+    Injected contract (on top of the user's template): fleet identity
+    (``TPUJOB_REPLICA_ID``/``TPUJOB_NAME``), the serving port, the
+    paged-ring defaults affinity routing relies on (``SERVE_PAGED=1``
+    and a ``SERVE_BLOCK_SIZE`` matching the router's affinity key
+    granularity — both user-overridable), the rendezvous ConfigMap via
+    envFrom, and the worker-style TPU placement.  restartPolicy is
+    forced ``Never`` so a drain's exit 83 is observable as
+    Failed+preempted — the reconciler, not kubelet, replaces replicas
+    (kubelet restarting in place would sidestep the drain-aware
+    accounting)."""
+    sv = job.spec.serving
+    name = gen_res_name(job.name, RESOURCE_SERVE, idx)
+    meta, spec, c0 = _stamp_fleet_child(job, sv.template,
+                                        RESOURCE_SERVE, name, sv.port)
+    env = c0.setdefault("env", [])
+    if job.spec.intranet == Intranet.SERVICE:
+        env.append({"name": "POD_IP", "value": name})
+    else:
+        env.append({
+            "name": "POD_IP",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+        })
+    env.append({"name": "TPUJOB_REPLICA_ID", "value": str(idx)})
+    env.append({"name": "TPUJOB_RES_TYPE", "value": RESOURCE_SERVE})
+    env.append({"name": "TPUJOB_NAME", "value": job.name})
+    env.append({"name": "TPUJOB_PORT", "value": str(sv.port)})
+    _env_setdefault(env, "SERVE_CONTINUOUS", "1")
+    _env_setdefault(env, "SERVE_PAGED", "1")
+    _env_setdefault(env, "SERVE_BLOCK_SIZE", str(sv.block_size))
+    if job.spec.checkpoint_path:
+        _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
+                        job.spec.checkpoint_path)
+
+    tpu = job.spec.tpu
+    if tpu is not None:
+        chips = tpu.effective_chips_per_worker()
+        resources = c0.setdefault("resources", {})
+        resources.setdefault("limits", {})["google.com/tpu"] = chips
+        resources.setdefault("requests", {})["google.com/tpu"] = chips
+        sel = spec.setdefault("nodeSelector", {})
+        sel.setdefault("cloud.google.com/gke-tpu-accelerator",
+                       tpu.accelerator)
+        sel.setdefault("cloud.google.com/gke-tpu-topology",
+                       tpu.topology)
+    if job.spec.scheduler_name and not spec.get("schedulerName"):
+        spec["schedulerName"] = job.spec.scheduler_name
+    spec["restartPolicy"] = "Never"
+    # the drain budget must fit inside kubelet's SIGTERM->SIGKILL
+    # window, or a busy replica gets killed mid-flush (exit 137, a
+    # budget-burning failure instead of a preemption)
+    spec.setdefault("terminationGracePeriodSeconds", 60)
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": spec}
+
+
+ROUTER_ENDPOINTS_MOUNT = "/etc/tpujob/fleet"
+
+
+def construct_router_pod(job: TPUJob) -> Dict[str, Any]:
+    """The fleet router pod (``python -m paddle_operator_tpu.router``,
+    jax-free).  Template from ``spec.serving.router`` when given,
+    otherwise derived from the replica template's image.  The
+    rendezvous ConfigMap rides in twice: envFrom for boot, and a
+    volume mount whose ``TPUJOB_SERVE_REPLICAS`` file kubelet rewrites
+    on ConfigMap update — how a scale reaches the running router.
+    restartPolicy ``Always``: the router is stateless (affinity is
+    pure hashing; the dedupe window is best-effort), so kubelet may
+    restart it in place."""
+    sv = job.spec.serving
+    name = gen_res_name(job.name, RESOURCE_ROUTER, 0)
+    template = sv.router
+    if not (template.get("spec") or {}).get("containers"):
+        # no router template: derive a jax-free container from the
+        # replica image running the router module
+        image = ""
+        if sv.template:
+            tcs = (sv.template.get("spec") or {}).get("containers") or []
+            image = tcs[0].get("image", "") if tcs else ""
+        template = {"spec": {"containers": [{
+            "name": "router",
+            "image": image,
+            "command": ["python", "-m", "paddle_operator_tpu.router"],
+        }]}}
+    meta, spec, c0 = _stamp_fleet_child(job, template,
+                                        RESOURCE_ROUTER, name, sv.port)
+    env = c0.setdefault("env", [])
+    env.append({"name": "TPUJOB_NAME", "value": job.name})
+    _env_setdefault(env, "ROUTER_PORT", str(sv.port))
+    _env_setdefault(env, "ROUTER_BLOCK_SIZE", str(sv.block_size))
+    _env_setdefault(env, "ROUTER_AFFINITY_BLOCKS",
+                    str(sv.affinity_blocks))
+    _env_setdefault(
+        env, "ROUTER_ENDPOINTS_FILE",
+        f"{ROUTER_ENDPOINTS_MOUNT}/TPUJOB_SERVE_REPLICAS")
+    mounts = c0.setdefault("volumeMounts", [])
+    if not any(m.get("name") == "fleet-endpoints" for m in mounts):
+        mounts.append({"name": "fleet-endpoints",
+                       "mountPath": ROUTER_ENDPOINTS_MOUNT,
+                       "readOnly": True})
+    vols = spec.setdefault("volumes", [])
+    if not any(v.get("name") == "fleet-endpoints" for v in vols):
+        vols.append({"name": "fleet-endpoints",
+                     "configMap": {"name": job.name}})
+    spec.setdefault("restartPolicy", "Always")
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": spec}
+
+
+def construct_fleet_service(job: TPUJob) -> Dict[str, Any]:
+    """``{job}-serve``: the stable client-facing Service in front of
+    the router pod — what tenants point client/client.py at.  Clients
+    never address replicas directly; affinity lives in the router."""
+    sv = job.spec.serving
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": f"{job.name}-{RESOURCE_SERVE}",
+            "namespace": job.namespace,
+            "labels": {
+                RESOURCE_NAME_LABEL: f"{job.name}-{RESOURCE_SERVE}",
+                GANG_LABEL: job.name,
+            },
+        },
+        "spec": {
+            "ports": [{"name": "serve", "port": sv.port}],
+            "selector": {RESOURCE_NAME_LABEL:
+                         gen_res_name(job.name, RESOURCE_ROUTER, 0)},
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # Services (reference: constructService4Pod helper.go:302-325)
 # ---------------------------------------------------------------------------
 
 
 def construct_service_for_pod(pod: Dict[str, Any]) -> Dict[str, Any]:
-    """Per-pod headless Service exposing the coordinator port block, selected
-    by the pod's unique name label."""
+    """Per-pod headless Service exposing the coordinator port block,
+    selected by the pod's unique name label.  Ports the pod's
+    containers declare OUTSIDE the block ride along (the serving
+    fleet's replica port — the router addresses replicas by these
+    stable per-pod service names in Service intranet mode)."""
     ports = [
         {"name": f"p-{i}", "port": COORDINATOR_PORT + i}
         for i in range(PORT_NUM)
     ]
+    have = {p["port"] for p in ports}
+    for c in pod.get("spec", {}).get("containers", []):
+        for cp in c.get("ports", []):
+            n = cp.get("containerPort")
+            if n and n not in have:
+                have.add(n)
+                ports.append({"name": cp.get("name") or f"c-{n}",
+                              "port": n})
     return {
         "apiVersion": "v1",
         "kind": "Service",

@@ -167,13 +167,28 @@ class FakeAPI(APIClient):
             del self.store[key]
             self._bump(obj)   # watch DELETED events carry a fresh rv (k8s)
             self._notify(kind, "DELETED", obj)
-            self._cascade(namespace, name)
+            self._cascade(kind, namespace, name)
 
-    def _cascade(self, namespace: str, owner_name: str) -> None:
+    def _controller_ref_matches(self, obj: Dict[str, Any],
+                                kind: str, name: str) -> bool:
+        """Real GC matches the ownerReference's identity, not just its
+        name — deleting a ConfigMap that happens to share the job's
+        name must not reap the job's pods."""
+        for ref in (obj.get("metadata", {})
+                    .get("ownerReferences", []) or []):
+            if ref.get("controller"):
+                return (ref.get("name") == name
+                        and ref.get("kind", kind) == kind)
+        return False
+
+    def _cascade(self, kind: str, namespace: str,
+                 owner_name: str) -> None:
         """Garbage-collect owned objects (apiserver GC behavior the
         reference relies on for Owns() cleanup)."""
         for key in [k for k, o in list(self.store.items())
-                    if k[1] == namespace and self.controller_of(o) == owner_name]:
+                    if k[1] == namespace
+                    and self._controller_ref_matches(o, kind,
+                                                     owner_name)]:
             obj = self.store[key]
             if not obj["metadata"].get("finalizers"):
                 del self.store[key]
@@ -199,7 +214,7 @@ class FakeAPI(APIClient):
                     del self.store[key]
                     self._bump(obj)
                     self._notify(kind, "DELETED", obj)
-                    self._cascade(key[1], key[2])
+                    self._cascade(kind, key[1], key[2])
                     return obj
             self._bump(obj)
             self.store[key] = obj

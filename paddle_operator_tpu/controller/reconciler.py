@@ -35,6 +35,8 @@ from paddle_operator_tpu.api.types import (
     HOSTPORT_ANNOTATION,
     RESOURCE_HETER,
     RESOURCE_PS,
+    RESOURCE_ROUTER,
+    RESOURCE_SERVE,
     RESOURCE_WORKER,
     CleanPodPolicy,
     ElasticStatus,
@@ -265,6 +267,25 @@ class TPUJobReconciler:
         if job.status.phase in (Phase.FAILED, Phase.COMPLETED):
             return Result()
 
+        # -- serving fleet (ISSUE 9): replica pods + router + fleet
+        #    service, with drain-aware scale up/down.  Runs its own
+        #    path — replicas are independent processes, so a replica
+        #    change is NEVER a gang teardown, and a replica exiting 83
+        #    is a completed drain (preempted), not a job failure.
+        #    Also entered when the spec block was REMOVED but fleet
+        #    children still exist: deleting `spec.serving` must drain
+        #    the fleet away (as replicas: 0 would), not orphan
+        #    chip-holding pods forever. -------------------------------
+        if (job.spec.serving is not None
+                or any(self._is_fleet_child(job, p["metadata"]["name"])
+                       for p in child_pods)
+                # spec removed AND pods gone: one more pass retires
+                # the stale operator-owned fleet telemetry
+                or "fleet" in job.status.serving):
+            res = self._reconcile_serving(job, raw, child_pods)
+            if res is not None:
+                return res
+
         # -- parked elastic job: create neither pods nor the rendezvous
         #    ConfigMap.  Sealing an empty world would force a spurious
         #    SCALING teardown cycle on un-park, and PS/heter pods for a
@@ -404,10 +425,24 @@ class TPUJobReconciler:
                 sync(status.worker, pod)
             elif res_type == RESOURCE_HETER:
                 sync(status.heter, pod)
+            elif res_type == RESOURCE_SERVE:
+                # replica pods: counted for visibility (kubectl ready
+                # column, refs) but NEVER fed to the gang failure /
+                # restart derivation — a drained replica's exit 83 is
+                # the fleet path's business (types.py rationale).  The
+                # ROUTER pod is deliberately excluded too: a serving-
+                # only job's phase keys on serve.running, and a live
+                # router in front of zero ready replicas is an outage,
+                # not RUNNING (fleet.routerReady carries the router).
+                sync(status.serve, pod)
 
         status.ps.refs.sort(key=lambda r: r["name"])
         status.worker.refs.sort(key=lambda r: r["name"])
         status.heter.refs.sort(key=lambda r: r["name"])
+        status.serve.refs.sort(key=lambda r: r["name"])
+        if job.spec.serving:
+            status.serve.ready = (
+                f"{status.serve.running}/{job.spec.serving.replicas}")
         if job.spec.ps:
             status.ps.ready = f"{status.ps.running}/{job.spec.ps.replicas}"
         if job.spec.worker:
@@ -479,6 +514,18 @@ class TPUJobReconciler:
             status.set_condition(goodput_condition(status.goodput, now))
         return status
 
+    @staticmethod
+    def _is_fleet_child(job: TPUJob, name: str) -> bool:
+        """Serving-fleet children (replica/router pods, their per-pod
+        services, the ``{job}-serve`` fleet service) are excluded from
+        gang teardown: no XLA world spans them, so a training restart
+        or rescale must not cold-restart the serving fleet's radix
+        caches alongside."""
+        if name == f"{job.name}-{RESOURCE_SERVE}":
+            return True
+        res_type, _ = builders.extract_name_index(name)
+        return res_type in (RESOURCE_SERVE, RESOURCE_ROUTER)
+
     def _teardown_gang(self, job: TPUJob,
                        child_pods: List[Dict[str, Any]]) -> bool:
         """Delete the gang's pods, per-pod services, and the rendezvous
@@ -488,11 +535,17 @@ class TPUJobReconciler:
         deleted every pod object): recreated pods would otherwise resolve
         ``envFrom`` against the OLD world's endpoints the instant kubelet
         starts them — the data update alone can't reach started containers.
+        Serving-fleet children survive (:meth:`_is_fleet_child`).
         """
+        child_pods = [p for p in child_pods
+                      if not self._is_fleet_child(
+                          job, p["metadata"]["name"])]
         deleted = bool(child_pods)
         for pod in child_pods:
             self._delete_child(job, KIND_POD, pod)
         for svc in self.api.list_owned(KIND_SVC, job.namespace, job.name):
+            if self._is_fleet_child(job, svc["metadata"]["name"]):
+                continue
             try:
                 self.api.delete(KIND_SVC, job.namespace,
                                 svc["metadata"]["name"])
@@ -592,7 +645,8 @@ class TPUJobReconciler:
         drains one pass later via its signal handler."""
         undrained = [
             p for p in child_pods
-            if not p["metadata"].get("deletionTimestamp")
+            if not self._is_fleet_child(job, p["metadata"]["name"])
+            and not p["metadata"].get("deletionTimestamp")
             and DRAIN_ANNOTATION not in (p["metadata"].get("annotations")
                                          or {})
         ]
@@ -619,6 +673,315 @@ class TPUJobReconciler:
         except (Conflict, NotFound):
             pass
         return Result(requeue_after=1.0)
+
+    # ------------------------------------------------- serving fleet
+
+    def _reconcile_serving(self, job: TPUJob, raw: Dict[str, Any],
+                           child_pods: List[Dict[str, Any]]
+                           ) -> Optional[Result]:
+        """One pass of the serving-fleet state machine (ISSUE 9).
+        Returns a Result to stop the pass (work was done / is
+        pending), or None when the fleet is settled and the pass may
+        continue to the ConfigMap barrier.
+
+        Scale-down is drain-first and one-replica-at-a-time: the
+        highest-index victim gets the ``tpujob-drain`` annotation
+        (advance notice — the node agent mirrors it into the
+        preemption-notice file, ft/preemption.py), then the pod is
+        deleted (kubelet's SIGTERM starts resilience.ServingDrain: the
+        router's scrape sees /readyz drop and stops routing, residents
+        finish, exit 83).  A victim observed Failed+preempted (the
+        drain completed before we deleted) is counted in
+        ``status.preemptedCount`` — capacity change, not job fault —
+        exactly the PR 2/5 accounting.  Scale-up just creates the
+        pod: traffic admission is the ROUTER's readyz gate, not ours.
+        Rolling updates ride the same path: kill one replica, wait for
+        its replacement to be Running again before the next (the
+        replace path below handles one failure per pass)."""
+        from paddle_operator_tpu.api.types import ServingSpec
+
+        ns, name = job.namespace, job.name
+        # spec block removed with fleet children still present: run
+        # the same machinery at replicas=0 — drain victims one at a
+        # time, then delete the router and the fleet Service
+        sv = job.spec.serving or ServingSpec(replicas=0, template={})
+        serve_pods: Dict[int, Dict[str, Any]] = {}
+        router_pods: List[Dict[str, Any]] = []
+        for pod in child_pods:
+            res_type, idx = builders.extract_name_index(
+                pod["metadata"]["name"])
+            if res_type == RESOURCE_SERVE:
+                serve_pods[idx] = pod
+            elif res_type == RESOURCE_ROUTER:
+                router_pods.append(pod)
+
+        # -- fleet service + router pod (want exactly one of each
+        #    while any replica is desired, none otherwise) ------------
+        fleet_svc_name = f"{name}-{RESOURCE_SERVE}"
+        if sv.replicas > 0:
+            try:
+                self.api.get(KIND_SVC, ns, fleet_svc_name)
+            except NotFound:
+                svc = builders.construct_fleet_service(job)
+                self.api.set_controller_reference(raw, svc)
+                self._create_child(job, KIND_SVC, svc)
+                return Result(requeue_after=1.0)
+            # a dead router takes the WHOLE fleet's ingress down (the
+            # fleet Service selects only it, and restartPolicy Always
+            # does not survive eviction/node loss, which leaves the
+            # pod object in phase Failed) — delete it so the next
+            # pass recreates
+            dead = [p for p in router_pods
+                    if p.get("status", {}).get("phase")
+                    in ("Failed", "Succeeded")
+                    and not p["metadata"].get("deletionTimestamp")]
+            if dead:
+                for pod in dead:
+                    self._delete_child(job, KIND_POD, pod)
+                self.api.record_event(
+                    raw, "Warning", "RouterReplaced",
+                    f"router pod {dead[0]['metadata']['name']} dead; "
+                    f"recreating")
+                return Result(requeue_after=1.0)
+            if not router_pods:
+                pod = builders.construct_router_pod(job)
+                self.api.set_controller_reference(raw, pod)
+                self._create_child(job, KIND_POD, pod)
+                return Result(requeue_after=1.0)
+        else:
+            did = False
+            for pod in router_pods:
+                self._delete_child(job, KIND_POD, pod)
+                did = True
+            try:
+                self.api.delete(KIND_SVC, ns, fleet_svc_name)
+                did = True
+            except NotFound:
+                pass
+            if did:
+                return Result(requeue_after=1.0)
+
+        # -- scale-down: drain ONE victim at a time, highest index
+        #    first, so the fleet loses capacity gradually and the
+        #    router re-homes each victim's prefixes once -------------
+        victims = sorted((i for i in serve_pods if i >= sv.replicas),
+                         reverse=True)
+        if victims:
+            pod = serve_pods[victims[0]]
+            return self._drain_serve_victim(job, raw, pod)
+
+        # -- replace failed in-range replicas (one per pass): a
+        #    preempted exit (83 — node preemption, or a drain we did
+        #    not ask for) is absorbed without burning anything;
+        #    anything else bumps the fleet's replicaRestarts counter
+        #    (visible, but never the gang's maxRestarts budget) -------
+        for idx in sorted(serve_pods):
+            pod = serve_pods[idx]
+            phase = pod.get("status", {}).get("phase", "")
+            if phase not in ("Failed", "Succeeded"):
+                continue
+            if pod["metadata"].get("deletionTimestamp"):
+                continue   # already accounted; kubelet is terminating
+            if builders.is_pod_preempted(pod):
+                def bump(j):
+                    j.status.preempted_count += 1
+                self.api.record_event(
+                    raw, "Normal", "ReplicaPreempted",
+                    f"serving replica {pod['metadata']['name']} "
+                    f"drained (exit 83); replacing without burning "
+                    f"the restart budget")
+            else:
+                def bump(j):
+                    self._bump_fleet_counter(j, "replicaRestarts")
+                self.api.record_event(
+                    raw, "Warning", "ReplicaFailed",
+                    f"serving replica {pod['metadata']['name']} "
+                    f"{phase.lower()}; replacing")
+            # account BEFORE deleting (once the pod object is gone the
+            # exit code is unobservable), exactly once per pod uid
+            if not self._account_replica_exit(job, pod, bump):
+                return Result(requeue_after=1.0)
+            self._delete_serve_pod(job, pod)
+            return Result(requeue_after=1.0)
+
+        # -- scale-up / create missing replicas.  All missing pods are
+        #    created in one pass (replicas are independent — there is
+        #    no gang atomicity to preserve); the router admits each
+        #    only once its /readyz goes true. -------------------------
+        created = 0
+        for idx in range(sv.replicas):
+            if idx in serve_pods:
+                continue
+            pod = builders.construct_serve_pod(job, idx)
+            self.api.set_controller_reference(raw, pod)
+            self._create_child(job, KIND_POD, pod)
+            created += 1
+        if created:
+            return Result(requeue_after=1.0)
+
+        if self._update_serving_status(job, serve_pods, router_pods):
+            return Result(requeue_after=1.0)
+        return None
+
+    def _drain_serve_victim(self, job: TPUJob, raw: Dict[str, Any],
+                            pod: Dict[str, Any]) -> Result:
+        """One step of the scale-down drain for a single victim pod."""
+        meta = pod["metadata"]
+        phase = pod.get("status", {}).get("phase", "")
+        if meta.get("deletionTimestamp"):
+            # we already deleted (and accounted) this victim; kubelet
+            # is terminating it — re-observing its eventual Failed(83)
+            # state must not count the drain twice
+            return Result(requeue_after=1.0)
+        if phase in ("Failed", "Succeeded"):
+            # drain observed complete (notice-file path: the workload
+            # exited on its own) — account it, then collect the corpse
+            if builders.is_pod_preempted(pod):
+                def bump(j):
+                    j.status.preempted_count += 1
+                    self._bump_fleet_counter(j, "drainedReplicas")
+                self.api.record_event(
+                    raw, "Normal", "ReplicaDrained",
+                    f"scale-down: {meta['name']} drained cleanly "
+                    f"(exit 83, counted preempted — not failed)")
+                # account BEFORE deleting, exactly once per pod uid
+                if not self._account_replica_exit(job, pod, bump):
+                    return Result(requeue_after=1.0)
+            else:
+                self.api.record_event(
+                    raw, "Warning", "ReplicaFailed",
+                    f"scale-down victim {meta['name']} exited "
+                    f"uncleanly")
+            self._delete_serve_pod(job, pod)
+            return Result(requeue_after=1.0)
+        if DRAIN_ANNOTATION not in (meta.get("annotations") or {}):
+            # pass 1: advance notice (the node agent mirrors this into
+            # the preemption-notice file; the replica may finish its
+            # drain before we ever deliver SIGTERM)
+            meta.setdefault("annotations", {})[DRAIN_ANNOTATION] = \
+                "scale-down"
+            try:
+                self.api.update(KIND_POD, pod)
+            except (Conflict, NotFound):
+                pass
+            self.api.record_event(
+                raw, "Normal", "DrainRequested",
+                f"scale-down: asked {meta['name']} to drain "
+                f"(stop admissions, finish residents, exit 83)")
+            return Result(requeue_after=1.0)
+        # pass 2+: deliver the SIGTERM by deleting the pod — kubelet's
+        # grace period covers SERVE_DRAIN_BUDGET_S, ServingDrain exits
+        # 83 inside it.  Counted as a drain here because the object
+        # will be gone before we could observe the exit code.
+        def bump(j):
+            j.status.preempted_count += 1
+            self._bump_fleet_counter(j, "drainedReplicas")
+        self.api.record_event(
+            raw, "Normal", "ReplicaDrained",
+            f"scale-down: deleting {meta['name']} (SIGTERM drain; "
+            f"counted preempted — not failed)")
+        # account BEFORE deleting, exactly once per pod uid
+        if not self._account_replica_exit(job, pod, bump):
+            return Result(requeue_after=1.0)
+        self._delete_serve_pod(job, pod)
+        return Result(requeue_after=1.0)
+
+    def _delete_serve_pod(self, job: TPUJob,
+                          pod: Dict[str, Any]) -> None:
+        """Delete a replica pod and its per-pod service (Service
+        intranet mode creates one per pod; leaking it would leave a
+        stale DNS name in the endpoint list)."""
+        self._delete_child(job, KIND_POD, pod)
+        try:
+            self.api.delete(KIND_SVC, job.namespace,
+                            pod["metadata"]["name"])
+        except NotFound:
+            pass
+
+    def _bump_fleet_counter(self, job: TPUJob, key: str) -> None:
+        fleet = job.status.serving.setdefault("fleet", {})
+        fleet[key] = int(fleet.get(key, 0)) + 1
+
+    def _account_replica_exit(self, job: TPUJob, pod: Dict[str, Any],
+                              bump) -> bool:
+        """Apply ``bump(job)`` (the counter increments for one replica
+        exit) EXACTLY ONCE per pod, surviving a crash between the
+        status write and the pod delete: the pod's uid rides the SAME
+        status write as the counters, so a re-entered pass sees the
+        uid and skips the re-increment.  Returns False when the write
+        lost a race (caller requeues without deleting)."""
+        fleet = job.status.serving.setdefault("fleet", {})
+        uid = pod["metadata"].get("uid") or pod["metadata"]["name"]
+        acct = fleet.setdefault("accountedUids", [])
+        if uid in acct:
+            return True      # counters already persisted; just delete
+        bump(job)
+        acct.append(uid)
+        del acct[:-8]        # bounded; uids never recur
+        return self._persist_status(job)
+
+    def _update_serving_status(self, job: TPUJob,
+                               serve_pods: Dict[int, Dict[str, Any]],
+                               router_pods: List[Dict[str, Any]]
+                               ) -> bool:
+        """Refresh the operator-owned ``status.serving.fleet`` block
+        and (when the replicas publish per-replica telemetry under
+        ``status.serving.replicas``) the aggregated top-level keys.
+        Returns True when the status changed and was written."""
+        from paddle_operator_tpu.router.router import (
+            aggregate_fleet_serving,
+        )
+
+        import copy as _copy
+
+        sv = job.spec.serving
+        # deep copy: the fleet sub-dict is mutated in place below, and
+        # a shallow snapshot would alias it — every change would then
+        # compare equal and never persist
+        before = _copy.deepcopy(job.status.serving)
+        serving = job.status.serving
+        if sv is None:
+            # spec block removed and (caller guarantees) the fleet is
+            # fully drained: retire the operator-owned telemetry
+            # instead of publishing a desired-0 fleet forever
+            for key in ("fleet", "replicas", "replicasReporting"):
+                serving.pop(key, None)
+            if serving != before:
+                self._persist_status(job)
+                return True
+            return False
+        per_replica = serving.get("replicas")
+        if isinstance(per_replica, dict) and per_replica:
+            # aggregate rides ON TOP of whatever single-pod keys were
+            # there: the fleet numbers are what dashboards should read
+            serving.update(aggregate_fleet_serving(per_replica))
+        ready = sum(
+            1 for i, p in serve_pods.items()
+            if i < sv.replicas and builders.is_pod_real_running(p))
+        fleet = serving.setdefault("fleet", {})
+        fleet["replicasDesired"] = sv.replicas
+        fleet["replicasReady"] = ready
+        fleet["routerReady"] = any(
+            builders.is_pod_real_running(p) for p in router_pods)
+        fleet.setdefault("drainedReplicas", 0)
+        fleet.setdefault("replicaRestarts", 0)
+        if serving != before:
+            self._persist_status(job)
+            return True
+        return False
+
+    def _persist_status(self, job: TPUJob) -> bool:
+        """Write job.status; returns False on a lost race (the caller
+        should requeue WITHOUT taking the irreversible action the
+        status write accounts for — e.g. deleting a drained victim
+        before its preempted credit landed)."""
+        try:
+            updated = self.api.update_status(KIND_JOB, job.to_dict())
+            job.resource_version = int(
+                updated["metadata"].get("resourceVersion", 0) or 0)
+            return True
+        except (Conflict, NotFound):
+            return False
 
     def _clamp_elastic(self, job: TPUJob) -> tuple:
         """Clamp each role's replicas into [requests, limits] on the

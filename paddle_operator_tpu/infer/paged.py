@@ -77,6 +77,7 @@ import jax.numpy as jnp
 
 from paddle_operator_tpu.infer import decode as D
 from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
+from paddle_operator_tpu.utils.radixkey import chain_key as _radix_chain_key
 
 TRASH_BLOCK = 0
 
@@ -484,8 +485,13 @@ class PagedCacheManager:
         """Rolling key for one full block: hash-chained on the parent
         key so equal chunks under different prefixes never collide; the
         stored entry keeps the raw chunk, so a (vanishingly unlikely)
-        hash collision is caught by the equality check in lookup."""
-        return hash((parent, chunk))
+        hash collision is caught by the equality check in lookup.
+
+        The definition lives in utils/radixkey.py (jax-free) because
+        the fleet router keys its consistent-hash affinity on the SAME
+        chain — one function, so router placement and replica radix
+        hits cannot drift apart."""
+        return _radix_chain_key(parent, chunk)
 
     def _lookup(self, tokens: Tuple[int, ...]):
         """Walk the cached chain: full-block hits, then at most one

@@ -169,6 +169,10 @@ class ContinuousGenerator:
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator  # injected
     state = None          # injected resilience.ServerState
+    # fleet identity (make_server job=/replica=): labels the per-pod
+    # /metrics gauges the fleet router scrapes for load scoring
+    job_key = "local"
+    replica_id = ""
     # chunked transfer (the streaming path) requires HTTP/1.1; plain
     # responses carry Content-Length so keep-alive stays correct, and
     # the socket timeout reaps idle/half-dead keep-alive connections
@@ -218,6 +222,36 @@ class _Handler(BaseHTTPRequestHandler):
                     "reason": ("draining" if draining else "ring"),
                 }, headers={"Retry-After":
                             self.state.retry_after_s if self.state else 5})
+        elif self.path == "/statusz":
+            # the serving_status block as JSON — what a fleet replica
+            # publishes toward status.serving, self-served for
+            # debugging and for harnesses that want the raw block
+            b = self._batcher()
+            st = b.serving_status() if b is not None else {}
+            if self.replica_id:
+                st["replica"] = self.replica_id
+            self._send(200, st)
+        elif self.path == "/metrics":
+            # per-pod prometheus gauges (the SAME names the manager
+            # exports fleet-wide): the router scrapes
+            # tpujob_serve_queue_depth / kv_blocks_free /
+            # tokens_per_sec from here to score replica load
+            from paddle_operator_tpu.utils.observability import (
+                serving_gauges,
+            )
+
+            b = self._batcher()
+            st = b.serving_status() if b is not None else {}
+            gauges = serving_gauges(st, self.job_key,
+                                    replica=self.replica_id or None)
+            body = "".join(f"{k} {v}\n"
+                           for k, v in sorted(gauges.items())).encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._send(404, {})
 
@@ -377,6 +411,7 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(host: str, port: int, params: Any, cfg: LlamaConfig,
                 *, continuous: bool = False, mesh=None,
+                job: str = "local", replica: str = "",
                 **ring_kw) -> ThreadingHTTPServer:
     """``continuous=True`` serves through the decode ring
     (infer/batcher.py; ``ring_kw``: slots, max_len, chunk_tokens,
@@ -392,7 +427,8 @@ def make_server(host: str, port: int, params: Any, cfg: LlamaConfig,
            if continuous else Generator(params, cfg, mesh=mesh))
     state = ServerState()
     handler = type("Handler", (_Handler,),
-                   {"generator": gen, "state": state})
+                   {"generator": gen, "state": state,
+                    "job_key": job, "replica_id": replica})
     srv = ThreadingHTTPServer((host, port), handler)
     srv.generator = gen
     # readiness/drain flags shared with the handler threads; a
@@ -586,7 +622,13 @@ def main() -> int:
           f"mode={'continuous' if continuous else 'batch'}) on :{env.port}",
           flush=True)
     srv = make_server("0.0.0.0", env.port, params, cfg,
-                      continuous=continuous, mesh=mesh, **ring_kw)
+                      continuous=continuous, mesh=mesh,
+                      # fleet identity (operator-injected): labels this
+                      # replica's /metrics gauges so the router and the
+                      # fleet status block can tell replicas apart
+                      job=os.environ.get("TPUJOB_NAME", "local"),
+                      replica=os.environ.get("TPUJOB_REPLICA_ID", ""),
+                      **ring_kw)
     # SIGTERM drain (docs/fault-tolerance.md, serving pods): the SAME
     # PreemptionWatcher contract the trainer uses — stop admissions
     # (503 + Retry-After), finish in-flight lanes within the drain

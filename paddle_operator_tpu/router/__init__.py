@@ -1,0 +1,31 @@
+"""Fleet router — the operator-managed serving fleet's traffic tier.
+
+A jax-free process that fronts N serving replicas (infer/serve.py pods):
+
+- ``hashring``  — consistent-hash ring over replica endpoints, keyed by
+                  the radix prefix chain key (utils/radixkey.py — the
+                  SAME chain the replicas' paged KV cache uses, so
+                  affinity routing and radix hits agree by construction);
+- ``router``    — the HTTP proxy: streaming-aware ``/v1/generate``
+                  forwarding, drain-aware replica selection from scraped
+                  ``tpujob_serve_*`` gauges, idempotent request-id dedupe
+                  (exactly-once at the fleet level), and the fleet's own
+                  ``/metrics``/``/readyz``/``/statusz``;
+- ``simfleet``  — the simulated-fleet harness (N in-process or
+                  subprocess rings behind the real router) tests, the
+                  dryrun ``serve-fleet`` gate, and ``bench.py
+                  measure_fleet`` all drive.  The only module here that
+                  may touch jax — ``python -m paddle_operator_tpu.router``
+                  never imports it.
+
+Run the router: ``python -m paddle_operator_tpu.router`` (see
+``router.main`` for the ROUTER_* env surface).
+"""
+
+from paddle_operator_tpu.router.hashring import HashRing  # noqa: F401
+from paddle_operator_tpu.router.router import (  # noqa: F401
+    FleetRouter,
+    aggregate_fleet_serving,
+    make_router_server,
+    parse_serve_gauges,
+)

@@ -1,0 +1,6 @@
+"""``python -m paddle_operator_tpu.router`` — run the fleet router."""
+
+from paddle_operator_tpu.router.router import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

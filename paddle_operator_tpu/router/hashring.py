@@ -1,0 +1,120 @@
+"""Consistent-hash ring over replica endpoints.
+
+Classic Karger ring with virtual nodes: each endpoint owns ``vnodes``
+points on a 64-bit circle; a request key maps to the first endpoint
+point clockwise from the key's point.  Properties the fleet relies on:
+
+- **stability** — adding/removing one endpoint remaps ~1/N of the key
+  population (only the keys whose clockwise walk crossed the changed
+  endpoint's points move); every other prefix keeps hitting the replica
+  whose radix cache already holds it.  Pinned by tests/test_fleet.py.
+- **drain awareness without remapping** — selection takes a ``ready``
+  set and walks PAST not-ready endpoints instead of rebuilding the
+  ring.  A draining replica (readyz false) sheds its keys to its ring
+  successors while it finishes residents; when it comes back the same
+  keys return to it, radix cache intact.
+
+Hashing is deliberately process-independent: endpoint points come from
+blake2b (str hashing is PYTHONHASHSEED-salted; hashlib is not), and the
+request key — the radix prefix chain key, an int — is spread over the
+circle with a splitmix64 finalizer (chain keys are well-distributed but
+ints must not map to themselves, or small keys would all land at the
+circle's origin).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_MASK = (1 << 64) - 1
+
+
+def _splitmix64(x: int) -> int:
+    """Finalizer of the splitmix64 PRNG — a cheap, well-mixed 64-bit
+    int->int hash (same recipe infer/scheduler.py uses for seed
+    folding)."""
+    x = (x + 0x9E3779B97F4A7C15) & _MASK
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK
+    return (x ^ (x >> 31)) & _MASK
+
+
+def _endpoint_point(endpoint: str, vnode: int) -> int:
+    h = hashlib.blake2b(f"{endpoint}#{vnode}".encode(), digest_size=8)
+    return int.from_bytes(h.digest(), "big")
+
+
+class HashRing:
+    """``pick(key, ready)`` -> endpoint, or None when nothing is ready."""
+
+    def __init__(self, endpoints: Iterable[str] = (),
+                 vnodes: int = 64) -> None:
+        self.vnodes = vnodes
+        self._points: List[Tuple[int, str]] = []   # sorted (point, ep)
+        self._keys: List[int] = []                 # points only (bisect)
+        self._endpoints: Dict[str, List[int]] = {}
+        for ep in endpoints:
+            self.add(ep)
+
+    @property
+    def endpoints(self) -> List[str]:
+        return sorted(self._endpoints)
+
+    def __contains__(self, endpoint: str) -> bool:
+        return endpoint in self._endpoints
+
+    def __len__(self) -> int:
+        return len(self._endpoints)
+
+    def add(self, endpoint: str) -> None:
+        if endpoint in self._endpoints:
+            return
+        pts = [_endpoint_point(endpoint, i) for i in range(self.vnodes)]
+        self._endpoints[endpoint] = pts
+        for p in pts:
+            i = bisect.bisect_left(self._keys, p)
+            self._keys.insert(i, p)
+            self._points.insert(i, (p, endpoint))
+
+    def remove(self, endpoint: str) -> None:
+        pts = self._endpoints.pop(endpoint, None)
+        if pts is None:
+            return
+        self._points = [(p, e) for (p, e) in self._points
+                        if e != endpoint]
+        self._keys = [p for (p, _) in self._points]
+
+    def set_endpoints(self, endpoints: Sequence[str]) -> None:
+        """Converge membership to ``endpoints`` (scale up/down): only
+        the changed endpoints' points move — survivors keep theirs, so
+        the ≤1/N remap bound holds across a whole set update."""
+        want = set(endpoints)
+        for ep in [e for e in self._endpoints if e not in want]:
+            self.remove(ep)
+        for ep in endpoints:
+            self.add(ep)
+
+    def pick(self, key: int,
+             ready: Optional[Iterable[str]] = None) -> Optional[str]:
+        """The endpoint owning ``key``: first ring point clockwise from
+        the key's circle position whose endpoint is in ``ready``
+        (``None`` = every member is eligible).  Walking past not-ready
+        members — instead of removing them — keeps the key->endpoint
+        map stable across a drain."""
+        if not self._points:
+            return None
+        eligible = set(ready) if ready is not None else None
+        if eligible is not None:
+            eligible &= set(self._endpoints)
+            if not eligible:
+                return None
+        point = _splitmix64(key & _MASK)
+        start = bisect.bisect_right(self._keys, point)
+        n = len(self._points)
+        for off in range(n):
+            _, ep = self._points[(start + off) % n]
+            if eligible is None or ep in eligible:
+                return ep
+        return None

@@ -1,0 +1,745 @@
+"""The fleet router process: prefix-affinity routing + streaming proxy.
+
+Jax-free (it runs in its own pod next to the replicas — the operator's
+``construct_router_pod``).  One process fronts N serving replicas
+(infer/serve.py), each of which already exports everything the router
+needs:
+
+- ``/readyz``            — drain-aware readiness (PR 5): false while the
+  replica is draining or self-healing, so the router stops routing to a
+  scale-down victim the moment its SIGTERM lands, while the victim
+  finishes its residents and exits 83;
+- ``/metrics``           — the per-pod ``tpujob_serve_*`` gauges
+  (utils/observability.serving_gauges); the router scrapes
+  ``tpujob_serve_queue_depth`` / ``tpujob_serve_kv_blocks_free`` /
+  ``tpujob_serve_tokens_per_sec`` and scores load from them;
+- ``/v1/generate``       — the proxied work, streaming or not.
+
+Routing policy (Llumnix / SGLang cache-aware router lineage):
+
+1. **Affinity**: the request's first prefix blocks hash to a radix
+   chain key (utils/radixkey.py — the SAME chain the replicas' paged
+   cache keys on), and the consistent-hash ring (hashring.py) maps the
+   key to a replica.  Requests sharing a system prompt therefore land
+   on the replica that already caches its blocks — prefill skipped.
+2. **Spillover**: when the affinity target is HOT (scraped queue depth
+   at/over ``hot_queue_depth``, or free KV blocks at/under
+   ``low_blocks``) the request spills to the least-loaded ready
+   replica — ordered by (queue depth, fewest free blocks, slowest
+   tok/s) so all three scraped gauges participate.  Cache misses on
+   spill are the price of not queueing behind a hot replica.
+3. **Drain/scale**: a not-ready replica is walked PAST on the ring
+   (keys do not remap); a new replica takes traffic only once its
+   ``/readyz`` goes true (scale-up admission gating).
+
+Exactly-once at the fleet level: a replica drain 503s requests it
+sheds; the client retries (client/client.py).  The retry carries the
+same idempotent ``request_id``, and the router remembers completed
+results (bounded LRU) — a retry that raced the original's completion
+replays the recorded response instead of generating twice.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from http.client import HTTPConnection
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_operator_tpu.utils.radixkey import prefix_chain_key
+from paddle_operator_tpu.router.hashring import HashRing
+
+# gauge name -> camelCase serving-block key (the inverse of
+# utils/observability.serving_gauges for the fields the router uses)
+_GAUGE_KEYS = {
+    "tpujob_serve_queue_depth": "queueDepth",
+    "tpujob_serve_kv_blocks_free": "kvBlocksFree",
+    "tpujob_serve_tokens_per_sec": "tokensPerSec",
+    "tpujob_serve_prefix_hit_rate": "prefixHitRate",
+    "tpujob_serve_accept_rate": "acceptRate",
+    "tpujob_serve_draining": "draining",
+}
+
+_GAUGE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+"
+    r"(?P<value>[-+0-9.eEnaif]+)\s*$")
+
+
+def parse_serve_gauges(text: str) -> Dict[str, float]:
+    """Parse prometheus exposition text into {camelCase key: value}
+    for the ``tpujob_serve_*`` gauges the router scores on (labels are
+    per-pod constant, so they are dropped)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _GAUGE_RE.match(line)
+        if not m:
+            continue
+        key = _GAUGE_KEYS.get(m.group("name"))
+        if key is None:
+            continue
+        try:
+            out[key] = float(m.group("value"))
+        except ValueError:
+            continue
+    return out
+
+
+def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+    """Fold per-replica ``status.serving`` blocks into one fleet block
+    (the top-level shape dashboards already read): capacities and
+    throughputs SUM; rates average weighted by each replica's served
+    tokens (a fresh replica's 0.0 hit rate must not drag the fleet
+    number below what the traffic actually experienced); liveness
+    folds conservatively (draining if ANY, healthy only if ALL).
+    Shared by the router's ``/statusz`` and the reconciler's fleet
+    status aggregation — one definition, no drift."""
+    blocks = [b for b in replicas.values() if isinstance(b, dict)]
+    agg: Dict[str, Any] = {"replicasReporting": len(blocks)}
+    if not blocks:
+        return agg
+    for key in ("tokensPerSec", "queueDepth", "kvBlocksFree",
+                "tokensTotal", "activeLanes", "kvPoolBytes",
+                "hostCacheBlocks", "promotedBlocks", "deadlineExceeded",
+                "watchdogRestarts", "quarantinedLanes",
+                "prefillQueueDepth"):
+        vals = [b.get(key) for b in blocks if b.get(key) is not None]
+        if vals:
+            total = sum(float(v) for v in vals)
+            agg[key] = round(total, 2) if total % 1 else int(total)
+    weights = [max(float(b.get("tokensTotal", 0) or 0), 0.0)
+               for b in blocks]
+    if not sum(weights):
+        weights = [1.0] * len(blocks)   # no traffic yet: plain mean
+    for key in ("prefixHitRate", "acceptRate", "hostHitRate",
+                "chunkedPrefillTokenShare"):
+        vals = [(float(b.get(key, 0.0) or 0.0), w)
+                for b, w in zip(blocks, weights) if key in b]
+        if vals:
+            agg[key] = round(sum(v * w for v, w in vals)
+                             / (sum(w for _, w in vals) or 1.0), 4)
+    if any("draining" in b for b in blocks):
+        agg["draining"] = any(bool(b.get("draining")) for b in blocks)
+    if any("healthy" in b for b in blocks):
+        agg["healthy"] = all(bool(b.get("healthy", True))
+                             for b in blocks)
+    return agg
+
+
+@dataclass
+class ReplicaState:
+    """What the scrape loop knows about one replica."""
+
+    endpoint: str                       # "host:port"
+    ready: bool = False
+    gauges: Dict[str, float] = field(default_factory=dict)
+    last_ok: float = 0.0                # monotonic time of last scrape
+    consecutive_failures: int = 0
+
+    @property
+    def queue_depth(self) -> float:
+        return self.gauges.get("queueDepth", 0.0)
+
+    @property
+    def kv_blocks_free(self) -> float:
+        return self.gauges.get("kvBlocksFree", 0.0)
+
+    @property
+    def tokens_per_sec(self) -> float:
+        return self.gauges.get("tokensPerSec", 0.0)
+
+    def load_rank(self) -> Tuple[float, float, float]:
+        """Least-loaded ordering: shortest queue first, then the most
+        free KV blocks, then the highest recent throughput (a replica
+        already moving tokens clears its queue fastest)."""
+        return (self.queue_depth, -self.kv_blocks_free,
+                -self.tokens_per_sec)
+
+
+class FleetRouter:
+    """Replica selection + scrape state + dedupe.  The HTTP handler
+    (make_router_server) is a thin shell over this object, so tests
+    can drive policy without sockets."""
+
+    def __init__(self, endpoints: Optional[List[str]] = None, *,
+                 block_size: int = 256, affinity_blocks: int = 2,
+                 hot_queue_depth: int = 4, low_blocks: int = 0,
+                 scrape_interval: float = 1.0, dedupe_cap: int = 1024,
+                 endpoints_file: Optional[str] = None,
+                 vnodes: int = 64, retry_after_s: int = 1,
+                 upstream_timeout: float = 600.0) -> None:
+        self.block_size = block_size
+        self.affinity_blocks = affinity_blocks
+        self.hot_queue_depth = hot_queue_depth
+        self.low_blocks = low_blocks
+        self.scrape_interval = scrape_interval
+        self.retry_after_s = retry_after_s
+        self.upstream_timeout = upstream_timeout
+        self.endpoints_file = endpoints_file
+        self._lock = threading.RLock()
+        self.ring = HashRing(vnodes=vnodes)
+        self.replicas: Dict[str, ReplicaState] = {}
+        self.draining = False
+        self.inflight_proxies = 0
+        # exactly-once dedupe: request_id -> recorded (status, body) for
+        # COMPLETED results; _inflight holds ids being proxied right now
+        self._results: "OrderedDict[str, Tuple[int, bytes]]" = \
+            OrderedDict()
+        self._dedupe_cap = dedupe_cap
+        self._inflight: set = set()
+        self.counters: Dict[str, float] = {
+            "routed_affinity": 0, "routed_spill": 0,
+            "routed_least_loaded": 0, "dedupe_replays": 0,
+            "upstream_errors": 0, "no_ready_replica": 0,
+        }
+        self._stop = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
+        self._scrape_pool = None        # lazy ThreadPoolExecutor
+        if endpoints:
+            self.set_endpoints(endpoints)
+
+    # -- membership --------------------------------------------------------
+
+    @staticmethod
+    def _norm(endpoint: str) -> str:
+        return endpoint.split("://", 1)[-1].strip().rstrip("/")
+
+    def set_endpoints(self, endpoints: List[str]) -> None:
+        eps = [self._norm(e) for e in endpoints if e.strip()]
+        with self._lock:
+            self.ring.set_endpoints(eps)
+            for ep in eps:
+                self.replicas.setdefault(ep, ReplicaState(ep))
+            for ep in [e for e in self.replicas if e not in set(eps)]:
+                del self.replicas[ep]
+
+    def endpoints(self) -> List[str]:
+        with self._lock:
+            return self.ring.endpoints
+
+    def _reload_endpoints_file(self) -> None:
+        if not self.endpoints_file:
+            return
+        try:
+            with open(self.endpoints_file) as f:
+                raw = f.read()
+        except OSError:
+            return
+        eps = [e for e in re.split(r"[,\s]+", raw) if e]
+        if eps and set(map(self._norm, eps)) != set(self.endpoints()):
+            self.set_endpoints(eps)
+
+    # -- scraping ----------------------------------------------------------
+
+    def _http_get(self, endpoint: str, path: str,
+                  timeout: float = 2.0) -> Tuple[int, bytes]:
+        host, _, port = endpoint.rpartition(":")
+        conn = HTTPConnection(host, int(port), timeout=timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def scrape_once(self) -> None:
+        """One poll of every replica's /readyz + /metrics.  A replica
+        is routable only while its LAST readyz probe succeeded — which
+        is both the drain shed (victim goes false, traffic stops) and
+        the scale-up admission gate (newcomer gets traffic only after
+        its first true).  Endpoints probe CONCURRENTLY: a black-holed
+        replica costs the pass one probe timeout, not one per position
+        behind it — a draining peer's readiness drop must never wait
+        on somebody else's dead socket."""
+        self._reload_endpoints_file()
+
+        def probe(st: ReplicaState) -> None:
+            try:
+                code, _ = self._http_get(st.endpoint, "/readyz")
+                st.ready = code == 200
+                code, body = self._http_get(st.endpoint, "/metrics")
+                if code == 200:
+                    st.gauges = parse_serve_gauges(body.decode())
+                st.last_ok = time.monotonic()
+                st.consecutive_failures = 0
+            except (OSError, socket.timeout, ValueError):
+                # ValueError: a malformed endpoint (no port) must cost
+                # only ITSELF — freezing other endpoints' readiness at
+                # their last value is how dead replicas keep traffic
+                st.consecutive_failures += 1
+                st.ready = False
+
+        states = [st for ep in self.endpoints()
+                  if (st := self.replicas.get(ep)) is not None]
+        if len(states) <= 1:
+            for st in states:
+                probe(st)
+            return
+        # reused pool, not per-tick threads: the router scrapes every
+        # second for its whole lifetime, and per-endpoint probes are
+        # bounded by their own 2s socket timeouts so workers recycle
+        if self._scrape_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._scrape_pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="router-probe")
+        futures = [self._scrape_pool.submit(probe, st)
+                   for st in states]
+        for f in futures:
+            try:
+                f.result(timeout=10)
+            except Exception:
+                pass   # probe() handles its own errors; belt+braces
+
+    def start(self) -> None:
+        if self._scrape_thread is not None:
+            return
+        try:
+            self.scrape_once()   # prime readiness before serving
+        except Exception:
+            pass   # a bad config entry must not crash-loop the router
+
+        def loop() -> None:
+            while not self._stop.wait(self.scrape_interval):
+                try:
+                    self.scrape_once()
+                except Exception:
+                    pass   # scrape must never kill the router
+
+        self._scrape_thread = threading.Thread(
+            target=loop, name="router-scrape", daemon=True)
+        self._scrape_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5)
+            self._scrape_thread = None
+        if self._scrape_pool is not None:
+            self._scrape_pool.shutdown(wait=False)
+            self._scrape_pool = None
+
+    # -- selection ---------------------------------------------------------
+
+    def _ready_endpoints(self) -> List[str]:
+        return [ep for ep, st in self.replicas.items() if st.ready]
+
+    def _hot(self, st: ReplicaState) -> bool:
+        """Affinity target too loaded to queue behind.  Judged only
+        from gauges actually scraped — a replica we have no reading
+        for yet is unknown, not starved (its kvBlocksFree "0" would
+        otherwise mark every fresh replica hot)."""
+        free = st.gauges.get("kvBlocksFree")
+        return (st.queue_depth >= self.hot_queue_depth
+                or (self.low_blocks > 0 and free is not None
+                    and free <= self.low_blocks))
+
+    def mark_unready(self, endpoint: str) -> None:
+        """A proxy attempt failed at the socket: stop routing there
+        until the scrape loop observes it healthy again (faster than
+        waiting a whole scrape interval to shed a dead replica)."""
+        st = self.replicas.get(self._norm(endpoint))
+        if st is not None:
+            st.ready = False
+            st.consecutive_failures += 1
+
+    def choose(self, tokens) -> Tuple[Optional[str], str]:
+        """Pick the replica for a prompt.  Returns ``(endpoint,
+        reason)`` with reason in {"affinity", "spill", "least_loaded"}
+        — or ``(None, "no_ready_replica")``."""
+        with self._lock:
+            ready = self._ready_endpoints()
+            if not ready:
+                self.counters["no_ready_replica"] += 1
+                return None, "no_ready_replica"
+            if self.affinity_blocks > 0 and tokens is not None:
+                key, _ = prefix_chain_key(tokens, self.block_size,
+                                          self.affinity_blocks)
+                target = self.ring.pick(key, ready)
+            else:
+                target = None
+            if target is None:
+                ep = min(ready,
+                         key=lambda e: self.replicas[e].load_rank())
+                self.counters["routed_least_loaded"] += 1
+                return ep, "least_loaded"
+            if self._hot(self.replicas[target]) and len(ready) > 1:
+                spill = min(ready,
+                            key=lambda e: self.replicas[e].load_rank())
+                if spill != target:
+                    self.counters["routed_spill"] += 1
+                    return spill, "spill"
+            self.counters["routed_affinity"] += 1
+            return target, "affinity"
+
+    # -- dedupe ------------------------------------------------------------
+
+    def dedupe_begin(self, request_id: Optional[str]
+                     ) -> Tuple[str, Optional[Tuple[int, bytes]]]:
+        """Returns ``("replay", recorded)`` when the id already
+        completed, ``("inflight", None)`` when the original is still
+        being proxied (the retry should back off and re-ask), or
+        ``("new", None)`` after marking the id in-flight."""
+        if request_id is None:
+            return "new", None
+        with self._lock:
+            rec = self._results.get(request_id)
+            if rec is not None:
+                self._results.move_to_end(request_id)
+                self.counters["dedupe_replays"] += 1
+                return "replay", rec
+            if request_id in self._inflight:
+                return "inflight", None
+            self._inflight.add(request_id)
+            return "new", None
+
+    def dedupe_end(self, request_id: Optional[str], status: int,
+                   body: Optional[bytes]) -> None:
+        """Record a completed RESULT (200 ok / 504 deadline partial —
+        both resolve the request); 503s and errors are not results, so
+        a later retry runs for real."""
+        if request_id is None:
+            return
+        with self._lock:
+            self._inflight.discard(request_id)
+            if body is not None and status in (200, 504):
+                self._results[request_id] = (status, body)
+                while len(self._results) > self._dedupe_cap:
+                    self._results.popitem(last=False)
+
+    # -- fleet status ------------------------------------------------------
+
+    def ready(self) -> bool:
+        # under the lock like choose()/statusz(): the scrape thread's
+        # set_endpoints() deletes replica entries mid-scale, and an
+        # unlocked iteration here would crash the /readyz handler at
+        # exactly the moment kubelet and the admission gate poll it
+        with self._lock:
+            return not self.draining and bool(self._ready_endpoints())
+
+    def statusz(self) -> Dict[str, Any]:
+        with self._lock:
+            per = {ep: dict(st.gauges, ready=st.ready)
+                   for ep, st in self.replicas.items()}
+            return {
+                "replicas": per,
+                "fleet": aggregate_fleet_serving(
+                    {ep: st.gauges for ep, st in self.replicas.items()
+                     if st.gauges}),
+                "router": dict(self.counters,
+                               readyReplicas=len(self._ready_endpoints()),
+                               endpoints=len(self.replicas),
+                               draining=self.draining),
+            }
+
+    def metrics_text(self) -> str:
+        """The fleet's own /metrics: router counters + per-replica
+        readiness/load as labeled gauges."""
+        with self._lock:
+            lines = []
+            for name, val in sorted(self.counters.items()):
+                lines.append(f"tpujob_router_{name}_total {float(val)}")
+            lines.append("tpujob_router_ready_replicas "
+                         f"{float(len(self._ready_endpoints()))}")
+            lines.append("tpujob_router_endpoints "
+                         f"{float(len(self.replicas))}")
+            lines.append("tpujob_router_draining "
+                         f"{1.0 if self.draining else 0.0}")
+            for ep, st in sorted(self.replicas.items()):
+                lbl = f'{{replica="{ep}"}}'
+                lines.append(f"tpujob_router_replica_ready{lbl} "
+                             f"{1.0 if st.ready else 0.0}")
+                lines.append(f"tpujob_router_replica_queue_depth{lbl} "
+                             f"{st.queue_depth}")
+            return "\n".join(lines) + "\n"
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: FleetRouter    # injected by make_router_server
+    protocol_version = "HTTP/1.1"
+    timeout = 120
+
+    def log_message(self, *a):
+        pass
+
+    def _send(self, code: int, obj: Any, headers=None,
+              raw: Optional[bytes] = None) -> None:
+        body = raw if raw is not None else json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        r = self.router
+        if self.path == "/healthz":
+            self._send(200, {"ok": True})
+        elif self.path == "/readyz":
+            if r.ready():
+                self._send(200, {"ready": True,
+                                 "replicas": len(r.endpoints())})
+            else:
+                self._send(503, {"ready": False,
+                                 "reason": ("draining" if r.draining
+                                            else "no ready replica")},
+                           headers={"Retry-After": r.retry_after_s})
+        elif self.path == "/statusz":
+            self._send(200, r.statusz())
+        elif self.path == "/metrics":
+            body = r.metrics_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._send(404, {})
+
+    # -- the proxy ---------------------------------------------------------
+
+    def do_POST(self):
+        r = self.router
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n) if n else b""
+        if self.path != "/v1/generate":
+            self._send(404, {})
+            return
+        retry_hdr = {"Retry-After": r.retry_after_s}
+        if r.draining:
+            self._send(503, {"error": "router draining"},
+                       headers=retry_hdr)
+            return
+        try:
+            req = json.loads(body)
+        except json.JSONDecodeError as e:
+            self._send(400, {"error": str(e)})
+            return
+        request_id = req.get("request_id")
+        tokens = req.get("tokens") or None
+        first_row = tokens[0] if (isinstance(tokens, list) and tokens
+                                  and isinstance(tokens[0], list)) \
+            else tokens
+        state, recorded = r.dedupe_begin(request_id)
+        if state == "replay":
+            code, raw = recorded
+            self._send(code, None, raw=raw,
+                       headers={"X-Router-Dedupe": "replay"})
+            return
+        if state == "inflight":
+            # the original is still running on some replica; re-running
+            # it elsewhere would double-generate.  Tell the retrying
+            # client to come back — by then the original has either
+            # completed (replayed above) or failed (re-routed fresh).
+            self._send(503, {"error": "request in flight"},
+                       headers=retry_hdr)
+            return
+        status, result = 0, None
+        try:
+            try:
+                ep, reason = r.choose(first_row)
+            except (ValueError, TypeError) as e:
+                # malformed tokens (non-int elements): the replica
+                # would 400 this — so must the router, or the client
+                # burns its whole retry budget on a connection reset
+                # for a permanently-bad request
+                self._send(400, {"error": f"bad tokens: {e}"})
+                return
+            if ep is None:
+                self._send(503, {"error": "no ready replica"},
+                           headers=retry_hdr)
+                return
+            status, result = self._proxy(ep, reason, body, req)
+        finally:
+            r.dedupe_end(request_id, status, result)
+
+    def _proxy(self, endpoint: str, reason: str, body: bytes,
+               req: Dict[str, Any]) -> Tuple[int, Optional[bytes]]:
+        """Forward to ``endpoint``; returns (status, recordable body) —
+        body None for streams/errors (not dedupe-recordable)."""
+        r = self.router
+        host, _, port = endpoint.rpartition(":")
+        conn = HTTPConnection(host, int(port),
+                              timeout=r.upstream_timeout)
+        # under the lock: handler threads race, and the SIGTERM drain
+        # gates on this counter reaching zero — a lost update either
+        # burns the whole drain budget or truncates a live stream
+        with r._lock:
+            r.inflight_proxies += 1
+        try:
+            headers = {"Content-Type": "application/json"}
+            hdr = self.headers.get("X-Request-Deadline")
+            if hdr:
+                headers["X-Request-Deadline"] = hdr
+            conn.request("POST", "/v1/generate", body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+            passthrough = {"X-Router-Replica": endpoint,
+                           "X-Router-Reason": reason}
+            ra = resp.getheader("Retry-After")
+            if ra is not None:
+                passthrough["Retry-After"] = ra
+            if req.get("stream") and resp.status == 200:
+                # streaming relay: re-chunk upstream NDJSON as it
+                # arrives — read1 returns whatever is buffered, so the
+                # first token reaches the client without waiting for
+                # the full generation
+                self.send_response(resp.status)
+                self.send_header("Content-Type",
+                                 resp.getheader("Content-Type",
+                                                "application/x-ndjson"))
+                self.send_header("Transfer-Encoding", "chunked")
+                for k, v in passthrough.items():
+                    self.send_header(k, v)
+                self.end_headers()
+                try:
+                    while True:
+                        chunk = resp.read1(65536)
+                        if not chunk:
+                            break
+                        self.wfile.write(
+                            f"{len(chunk):x}\r\n".encode() + chunk
+                            + b"\r\n")
+                        self.wfile.flush()
+                except OSError:
+                    # upstream died mid-stream OR the client went away
+                    # (indistinguishable here; the scrape loop settles
+                    # which) — either way the chunked response must
+                    # still be TERMINATED below, or a waiting client
+                    # hangs on an unfinished stream until its socket
+                    # timeout (it detects truncation by the missing
+                    # done event)
+                    pass
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except OSError:
+                    pass          # downstream client went away
+                return resp.status, None   # streams are not replayable
+            payload = resp.read()
+            # the UPSTREAM result is in hand: from here on a failure is
+            # the downstream client's socket, not the replica's — it
+            # must neither mark the replica unready nor lose the
+            # recordable payload (the dedupe window is exactly what
+            # makes the client's retry after a response-path death
+            # exactly-once)
+            try:
+                self._send(resp.status, None, raw=payload,
+                           headers=passthrough)
+            except OSError:
+                pass              # client gone; result still recorded
+            return resp.status, payload
+        except (OSError, socket.timeout):
+            # the replica vanished mid-proxy (drain finished, pod gone):
+            # mark it down NOW and hand the client the same retryable
+            # 503 a draining replica would have sent
+            r.mark_unready(endpoint)
+            with r._lock:
+                r.counters["upstream_errors"] += 1
+            try:
+                self._send(503, {"error":
+                                 f"replica {endpoint} unreachable"},
+                           headers={"Retry-After": r.retry_after_s})
+            except OSError:
+                pass
+            return 503, None
+        finally:
+            with r._lock:
+                r.inflight_proxies -= 1
+            conn.close()
+
+
+def make_router_server(host: str, port: int, router: FleetRouter
+                       ) -> ThreadingHTTPServer:
+    """HTTP shell around a FleetRouter; starts the scrape loop.  The
+    returned server carries ``.router`` — close it when shutting the
+    server down."""
+    handler = type("RouterHandler", (_RouterHandler,),
+                   {"router": router})
+    srv = ThreadingHTTPServer((host, port), handler)
+    srv.router = router
+    router.start()
+    return srv
+
+
+def main() -> int:
+    """Router entrypoint (the operator's router container runs this).
+
+    Env surface:
+
+    - ``ROUTER_PORT``            listen port (default 8800);
+    - ``TPUJOB_SERVE_REPLICAS``  comma list of ``host:port`` replica
+      endpoints (the rendezvous ConfigMap carries it);
+    - ``ROUTER_ENDPOINTS_FILE``  path re-read every scrape tick — the
+      operator mounts the ConfigMap as a volume here, so scale up/down
+      reaches a RUNNING router (env vars cannot);
+    - ``ROUTER_BLOCK_SIZE``      must match the replicas'
+      SERVE_BLOCK_SIZE (affinity keys are block-granular; default 256);
+    - ``ROUTER_AFFINITY_BLOCKS`` prefix blocks in the affinity key
+      (0 disables affinity -> pure least-loaded; default 2);
+    - ``ROUTER_HOT_QUEUE``       scraped queue depth at/over which the
+      affinity target is "hot" and requests spill (default 4);
+    - ``ROUTER_LOW_BLOCKS``      free-KV-block floor that also marks a
+      replica hot (0 disables; default 0);
+    - ``ROUTER_SCRAPE_S``        scrape interval seconds (default 1);
+    - ``ROUTER_DRAIN_BUDGET_S``  SIGTERM: seconds to let in-flight
+      proxies finish before exit (default 10).
+
+    SIGTERM drains like a replica does (docs/fault-tolerance.md): stop
+    admitting (/readyz false, 503 + Retry-After), let in-flight proxies
+    finish within the budget, exit EXIT_PREEMPTED so the reconciler
+    counts the restart preempted-not-failed."""
+    from paddle_operator_tpu.api.types import EXIT_PREEMPTED
+    from paddle_operator_tpu.ft.preemption import PreemptionWatcher
+
+    port = int(os.environ.get("ROUTER_PORT", "8800"))
+    eps = [e for e in os.environ.get("TPUJOB_SERVE_REPLICAS",
+                                     "").split(",") if e.strip()]
+    router = FleetRouter(
+        eps,
+        block_size=int(os.environ.get("ROUTER_BLOCK_SIZE", "256")),
+        affinity_blocks=int(os.environ.get("ROUTER_AFFINITY_BLOCKS",
+                                           "2")),
+        hot_queue_depth=int(os.environ.get("ROUTER_HOT_QUEUE", "4")),
+        low_blocks=int(os.environ.get("ROUTER_LOW_BLOCKS", "0")),
+        scrape_interval=float(os.environ.get("ROUTER_SCRAPE_S", "1")),
+        endpoints_file=os.environ.get("ROUTER_ENDPOINTS_FILE"))
+    srv = make_router_server("0.0.0.0", port, router)
+    print(f"fleet router on :{port} fronting "
+          f"{len(router.endpoints())} replica(s) "
+          f"(affinity_blocks={router.affinity_blocks}, "
+          f"block_size={router.block_size})", flush=True)
+    budget = float(os.environ.get("ROUTER_DRAIN_BUDGET_S", "10"))
+    code: List[int] = [0]
+
+    def drain() -> None:
+        router.draining = True
+        deadline = time.monotonic() + budget
+        while router.inflight_proxies > 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        code[0] = EXIT_PREEMPTED
+        srv.shutdown()
+
+    watcher = PreemptionWatcher.install()
+    watcher.on_drain(lambda reason: threading.Thread(
+        target=drain, daemon=True).start())
+    srv.serve_forever()
+    router.close()
+    return code[0]
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

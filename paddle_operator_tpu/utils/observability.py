@@ -77,14 +77,54 @@ class StepTimer:
         return s
 
 
-def serving_gauges(status_serving: dict, job: str) -> dict:
+def serving_gauges(status_serving: dict, job: str,
+                   replica: str = None) -> dict:
     """Prometheus gauge lines for one job's workload-published
     ``status.serving`` block (infer/batcher.py
     ContinuousBatcher.serving_status) — shared by the manager's
     /metrics export (controller/manager.py) so names cannot drift from
     docs/serving.md.  ``job`` is ``namespace/name``.  Lives here (not
-    in infer/) because the manager process must not import jax."""
-    lbl = f'{{job="{job}"}}'
+    in infer/) because the manager process must not import jax.
+
+    Fleet shape (ISSUE 9): with ``replica`` set (a serving replica's
+    own /metrics, infer/serve.py), or for each entry of the status
+    block's ``replicas`` sub-map (the operator-aggregated fleet
+    block), every gauge carries a ``replica`` label so per-replica
+    readings never collide under one job key.  The single-pod
+    (unlabeled) shape is byte-identical to the pre-fleet export — the
+    fleet aggregate's top-level keys render exactly as a single pod's
+    block always did, so existing dashboards keep reading."""
+    out = _serving_gauges_one(status_serving, job, replica)
+    for rid, blk in sorted(
+            (status_serving.get("replicas") or {}).items()):
+        if isinstance(blk, dict):
+            out.update(_serving_gauges_one(blk, job, str(rid)))
+    # operator-owned fleet block (controller/reconciler.py
+    # _reconcile_serving): desired/ready replica counts, router
+    # readiness, drain accounting — only rendered when present, so the
+    # single-pod gauge set is untouched
+    fleet = status_serving.get("fleet")
+    if isinstance(fleet, dict):
+        lbl = f'{{job="{job}"}}'
+        out[f"tpujob_serve_fleet_replicas_desired{lbl}"] = \
+            float(fleet.get("replicasDesired", 0))
+        out[f"tpujob_serve_fleet_replicas_ready{lbl}"] = \
+            float(fleet.get("replicasReady", 0))
+        out[f"tpujob_serve_fleet_router_ready{lbl}"] = \
+            1.0 if fleet.get("routerReady") else 0.0
+        out[f"tpujob_serve_fleet_drained_replicas{lbl}"] = \
+            float(fleet.get("drainedReplicas", 0))
+        out[f"tpujob_serve_fleet_replica_restarts{lbl}"] = \
+            float(fleet.get("replicaRestarts", 0))
+    return out
+
+
+def _serving_gauges_one(status_serving: dict, job: str,
+                        replica: str = None) -> dict:
+    """One pod's (or one replica's) gauge set.  ``replica=None``
+    renders the historical unlabeled shape byte-for-byte."""
+    rep = f',replica="{replica}"' if replica else ""
+    lbl = f'{{job="{job}"{rep}}}'
     return {
         f"tpujob_serve_tokens_per_sec{lbl}":
             float(status_serving.get("tokensPerSec", 0.0)),
@@ -105,7 +145,7 @@ def serving_gauges(status_serving: dict, job: str) -> dict:
         # fleets, plus the share of prefill tokens that arrived in
         # interleaved chunked slices
         ("tpujob_serve_prefill_queue_depth"
-         f'{{job="{job}",mode="{status_serving.get("prefillMode", "inline")}"}}'):
+         f'{{job="{job}"{rep},mode="{status_serving.get("prefillMode", "inline")}"}}'):
             float(status_serving.get("prefillQueueDepth", 0.0)),
         f"tpujob_serve_chunked_prefill_token_share{lbl}":
             float(status_serving.get("chunkedPrefillTokenShare", 0.0)),
@@ -115,7 +155,7 @@ def serving_gauges(status_serving: dict, job: str) -> dict:
         # capacity dashboards can split int8 and bf16 fleets on one
         # metric name
         ("tpujob_serve_kv_pool_bytes"
-         f'{{job="{job}",mode="{status_serving.get("kvQuantMode", "none")}"}}'):
+         f'{{job="{job}"{rep},mode="{status_serving.get("kvQuantMode", "none")}"}}'):
             float(status_serving.get("kvPoolBytes", 0.0)),
         # hierarchical KV cache (SERVE_HOST_CACHE_MB/_BLOCKS): blocks
         # resident in the host spill tier, the share of looked-up

@@ -6,7 +6,7 @@ apiserver: create-from-yaml (with validation), get, list, delete.
 import json
 import sys
 import threading
-from http.server import ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import pytest
 import yaml
@@ -82,3 +82,66 @@ class TestClientCLI:
 
     def test_usage_on_unknown_command(self, server, capsys):
         assert client_cli.main(["frobnicate"]) == 2
+
+
+class TestIdempotentRequestId:
+    """ISSUE 9 satellite: every post_generate attempt must carry the
+    SAME request_id — the fleet router dedupes a retry that raced the
+    original's completion on it (exactly-once at the fleet level)."""
+
+    class _Flaky(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        bodies: list = []
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            type(self).bodies.append(json.loads(self.rfile.read(n)))
+            if len(type(self).bodies) == 1:     # first attempt: shed
+                body = b'{"error": "server draining"}'
+                self.send_response(503)
+                self.send_header("Retry-After", "0")
+            else:
+                body = b'{"tokens": [[1, 2, 3]]}'
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    @pytest.fixture()
+    def flaky(self):
+        handler = type("Flaky", (self._Flaky,), {"bodies": []})
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{srv.server_address[1]}", handler
+        srv.shutdown()
+        srv.server_close()
+
+    def test_request_id_minted_once_and_stable_across_retries(
+            self, flaky):
+        base, handler = flaky
+        code, out = client_cli.post_generate(
+            base, {"tokens": [[5]]}, max_retries=3,
+            backoff_base_s=0.01, sleep=lambda s: None)
+        assert code == 200
+        assert len(handler.bodies) == 2         # 503 then 200
+        ids = [b.get("request_id") for b in handler.bodies]
+        assert ids[0] and ids[0] == ids[1]      # minted once, reused
+
+    def test_caller_supplied_request_id_preserved(self, flaky):
+        base, handler = flaky
+        client_cli.post_generate(
+            base, {"tokens": [[5]], "request_id": "mine"},
+            max_retries=3, backoff_base_s=0.01, sleep=lambda s: None)
+        assert [b["request_id"] for b in handler.bodies] \
+            == ["mine", "mine"]
+
+    def test_caller_payload_not_mutated(self, flaky):
+        base, handler = flaky
+        payload = {"tokens": [[5]]}
+        client_cli.post_generate(base, payload, max_retries=3,
+                                 backoff_base_s=0.01,
+                                 sleep=lambda s: None)
+        assert "request_id" not in payload

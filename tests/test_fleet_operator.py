@@ -1,0 +1,392 @@
+"""Operator side of the serving fleet (ISSUE 9): the reconciler
+materializes replica pods + router pod + fleet service from
+``spec.serving``, aggregates per-replica telemetry into the fleet
+status block, and scales drain-aware — scale-down victims drain one at
+a time and land in the preempted (not failed) accounting; a training
+gang restart never touches the fleet."""
+
+import pytest
+
+from paddle_operator_tpu.api import (
+    ResourceSpec,
+    ServingSpec,
+    TPUJob,
+    TPUJobSpec,
+)
+from paddle_operator_tpu.api.types import EXIT_PREEMPTED
+from paddle_operator_tpu.controller.fake_api import FakeAPI, FakeFleet
+from paddle_operator_tpu.controller.reconciler import (
+    KIND_JOB,
+    TPUJobReconciler,
+    run_to_settled,
+)
+
+NS = "default"
+TMPL = {"spec": {"containers": [{"name": "m", "image": "jax:latest"}]}}
+
+
+def _fleet_job(replicas=2, name="fj", **kw):
+    return TPUJob(name=name, namespace=NS, spec=TPUJobSpec(
+        serving=ServingSpec(replicas=replicas, template=TMPL,
+                            block_size=8, **kw)))
+
+
+def _setup(replicas=2, name="fj"):
+    api = FakeAPI()
+    rec = TPUJobReconciler(api)
+    fleet = FakeFleet(api, NS)
+    api.create(KIND_JOB, _fleet_job(replicas, name).to_dict())
+    run_to_settled(rec, NS, name)
+    fleet.run_all()
+    run_to_settled(rec, NS, name)
+    return api, rec, fleet
+
+
+def _set_replicas(api, name, n):
+    raw = api.get(KIND_JOB, NS, name)
+    raw["spec"]["serving"]["replicas"] = n
+    api.update(KIND_JOB, raw)
+
+
+class TestFleetMaterialization:
+    def test_pods_router_and_service(self):
+        api, rec, fleet = _setup(replicas=3)
+        pods = sorted(k[2] for k in api.store if k[0] == "Pod")
+        assert pods == ["fj-router-0", "fj-serve-0", "fj-serve-1",
+                        "fj-serve-2"]
+        assert ("Service", NS, "fj-serve") in api.store
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert got.status.phase == "Running"
+        # replicas only — the router rides fleet.routerReady, so a
+        # router-up/replicas-down outage can never read as RUNNING
+        assert got.status.serve.running == 3
+        assert got.status.serve.ready == "3/3"
+        flt = got.status.serving["fleet"]
+        assert flt["replicasDesired"] == 3
+        assert flt["replicasReady"] == 3
+        assert flt["routerReady"] is True
+
+    def test_configmap_carries_replica_endpoints(self):
+        api, rec, fleet = _setup(replicas=2)
+        cm = api.get("ConfigMap", NS, "fj")
+        eps = cm["data"]["TPUJOB_SERVE_REPLICAS"].split(",")
+        assert len(eps) == 2
+        assert all(ep.endswith(":8700") for ep in eps)
+        assert cm["data"]["TPUJOB_SERVE_FLEET_SIZE"] == "2"
+
+    def test_serve_pod_contract(self):
+        api, rec, fleet = _setup(replicas=1)
+        pod = api.get("Pod", NS, "fj-serve-0")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["TPUJOB_REPLICA_ID"] == "0"
+        assert env["TPUJOB_PORT"] == "8700"
+        assert env["SERVE_CONTINUOUS"] == "1"
+        assert env["SERVE_PAGED"] == "1"
+        assert env["SERVE_BLOCK_SIZE"] == "8"
+        # exit 83 must be observable: kubelet may not restart in place
+        assert pod["spec"]["restartPolicy"] == "Never"
+
+    def test_router_pod_contract(self):
+        api, rec, fleet = _setup(replicas=1)
+        pod = api.get("Pod", NS, "fj-router-0")
+        c0 = pod["spec"]["containers"][0]
+        env = {e["name"]: e.get("value") for e in c0.get("env", [])}
+        assert env["ROUTER_BLOCK_SIZE"] == "8"      # matches replicas
+        assert env["ROUTER_PORT"] == "8700"
+        # live endpoint updates ride the ConfigMap VOLUME (env is
+        # frozen at container start; the file is not)
+        assert env["ROUTER_ENDPOINTS_FILE"].endswith(
+            "TPUJOB_SERVE_REPLICAS")
+        assert any(v.get("configMap", {}).get("name") == "fj"
+                   for v in pod["spec"]["volumes"])
+        assert c0["command"][-1] == "paddle_operator_tpu.router"
+
+    def test_user_env_wins_over_injected_defaults(self):
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        tmpl = {"spec": {"containers": [{
+            "name": "m", "image": "i",
+            "env": [{"name": "SERVE_BLOCK_SIZE", "value": "512"}]}]}}
+        job = TPUJob(name="uj", namespace=NS, spec=TPUJobSpec(
+            serving=ServingSpec(replicas=1, template=tmpl)))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "uj")
+        pod = api.get("Pod", NS, "uj-serve-0")
+        vals = [e.get("value")
+                for e in pod["spec"]["containers"][0]["env"]
+                if e["name"] == "SERVE_BLOCK_SIZE"]
+        assert vals == ["512"]
+
+
+class TestScaleDown:
+    def test_drain_then_preempted_accounting(self):
+        api, rec, fleet = _setup(replicas=2)
+        _set_replicas(api, "fj", 1)
+        rec.reconcile(NS, "fj")
+        # pass 1: victim annotated, NOT deleted — advance notice
+        pod = api.get("Pod", NS, "fj-serve-1")
+        assert pod["metadata"]["annotations"]["tpujob-drain"] \
+            == "scale-down"
+        assert any(e["reason"] == "DrainRequested"
+                   for e in api.events)
+        # the replica drains via the notice file and exits 83
+        fleet.preempt("fj-serve-1")
+        run_to_settled(rec, NS, "fj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        pods = sorted(k[2] for k in api.store if k[0] == "Pod")
+        assert pods == ["fj-router-0", "fj-serve-0"]
+        # counted preempted — NOT failed, NOT a restart, phase intact
+        assert got.status.preempted_count == 1
+        assert got.status.restart_count == 0
+        assert got.status.phase == "Running"
+        assert got.status.serving["fleet"]["drainedReplicas"] == 1
+        assert any(e["reason"] == "ReplicaDrained"
+                   for e in api.events)
+
+    def test_one_victim_at_a_time(self):
+        api, rec, fleet = _setup(replicas=4)
+        _set_replicas(api, "fj", 1)
+        rec.reconcile(NS, "fj")
+        annotated = [
+            n for n in ("fj-serve-1", "fj-serve-2", "fj-serve-3")
+            if "tpujob-drain" in (api.get("Pod", NS, n)["metadata"]
+                                  .get("annotations") or {})]
+        assert annotated == ["fj-serve-3"]      # highest index only
+        fleet.preempt("fj-serve-3")
+        rec.reconcile(NS, "fj")   # observe drain: account + delete 3
+        rec.reconcile(NS, "fj")   # NOW 2 becomes the victim: annotate
+        assert ("Pod", NS, "fj-serve-3") not in api.store
+        assert "tpujob-drain" in (api.get("Pod", NS, "fj-serve-2")
+                                  ["metadata"].get("annotations") or {})
+        # ...while 1 has not been touched yet — strictly rolling
+        assert "tpujob-drain" not in (
+            api.get("Pod", NS, "fj-serve-1")["metadata"]
+            .get("annotations") or {})
+
+    def test_sigterm_fallback_still_counts_preempted(self):
+        """No node agent mirrors the annotation: the second pass
+        deletes the pod (kubelet SIGTERM -> ServingDrain -> exit 83
+        within the grace period) and the drain is still accounted."""
+        api, rec, fleet = _setup(replicas=2)
+        _set_replicas(api, "fj", 1)
+        rec.reconcile(NS, "fj")          # pass 1: annotate
+        run_to_settled(rec, NS, "fj")    # pass 2+: delete + account
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert ("Pod", NS, "fj-serve-1") not in api.store
+        assert got.status.preempted_count == 1
+        assert got.status.serving["fleet"]["drainedReplicas"] == 1
+
+    def test_drain_accounting_survives_crash_before_delete(self):
+        """Exactly-once accounting: if the controller dies AFTER the
+        counter write but BEFORE the pod delete, the re-entered pass
+        must not count the same drain twice (the victim's uid rides
+        the same status write as the counters)."""
+        api, rec, fleet = _setup(replicas=2)
+        _set_replicas(api, "fj", 1)
+        rec.reconcile(NS, "fj")          # pass 1: annotate
+        # simulate the crash window: persist succeeds, delete never runs
+        orig = rec._delete_serve_pod
+        rec._delete_serve_pod = lambda job, pod: None
+        rec.reconcile(NS, "fj")          # accounted, "crashed"
+        rec._delete_serve_pod = orig
+        run_to_settled(rec, NS, "fj")    # re-entered pass: deletes
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert ("Pod", NS, "fj-serve-1") not in api.store
+        assert got.status.preempted_count == 1            # not 2
+        assert got.status.serving["fleet"]["drainedReplicas"] == 1
+
+    def test_scale_to_zero_removes_router_and_service(self):
+        api, rec, fleet = _setup(replicas=1)
+        _set_replicas(api, "fj", 0)
+        rec.reconcile(NS, "fj")
+        fleet.preempt("fj-serve-0")
+        run_to_settled(rec, NS, "fj")
+        assert not [k for k in api.store if k[0] == "Pod"]
+        assert ("Service", NS, "fj-serve") not in api.store
+
+
+class TestScaleUpAndReplace:
+    def test_scale_up_creates_and_configmap_follows(self):
+        api, rec, fleet = _setup(replicas=1)
+        _set_replicas(api, "fj", 3)
+        run_to_settled(rec, NS, "fj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "fj")
+        pods = sorted(k[2] for k in api.store if k[0] == "Pod")
+        assert pods == ["fj-router-0", "fj-serve-0", "fj-serve-1",
+                        "fj-serve-2"]
+        cm = api.get("ConfigMap", NS, "fj")
+        assert len(cm["data"]["TPUJOB_SERVE_REPLICAS"]
+                   .split(",")) == 3
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert got.status.serving["fleet"]["replicasReady"] == 3
+
+    def test_crashed_replica_replaced_without_burning_budget(self):
+        api, rec, fleet = _setup(replicas=2)
+        fleet.fail("fj-serve-0")         # unclean exit (not 83)
+        run_to_settled(rec, NS, "fj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "fj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        # replaced in place: same name, fresh pod
+        assert ("Pod", NS, "fj-serve-0") in api.store
+        assert got.status.restart_count == 0          # gang budget
+        assert got.status.phase == "Running"          # never Failed
+        assert got.status.serving["fleet"]["replicaRestarts"] == 1
+        assert any(e["reason"] == "ReplicaFailed" for e in api.events)
+
+    def test_dead_router_is_replaced(self):
+        """Eviction/node loss leaves the router pod Failed (Always
+        restartPolicy does not survive it): the reconciler must
+        recreate it — a dead router is the whole fleet's ingress."""
+        api, rec, fleet = _setup(replicas=1)
+        uid = api.get("Pod", NS, "fj-router-0")["metadata"]["uid"]
+        fleet.fail("fj-router-0")
+        run_to_settled(rec, NS, "fj")
+        fresh = api.get("Pod", NS, "fj-router-0")
+        assert fresh["metadata"]["uid"] != uid
+        assert any(e["reason"] == "RouterReplaced" for e in api.events)
+
+    def test_removing_serving_block_drains_the_fleet(self):
+        """Deleting spec.serving outright (instead of replicas: 0)
+        must drain the fleet away, not orphan chip-holding pods and
+        the Service forever."""
+        api, rec, fleet = _setup(replicas=2)
+        raw = api.get(KIND_JOB, NS, "fj")
+        del raw["spec"]["serving"]
+        api.update(KIND_JOB, raw)
+        for _ in range(3):
+            rec.reconcile(NS, "fj")
+        # the victims drain through the normal path
+        for name in ("fj-serve-0", "fj-serve-1"):
+            if ("Pod", NS, name) in api.store:
+                fleet.preempt(name)
+        run_to_settled(rec, NS, "fj")
+        assert not [k for k in api.store if k[0] == "Pod"]
+        assert ("Service", NS, "fj-serve") not in api.store
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert "fleet" not in got.status.serving
+
+    def test_preempted_replica_replaced_with_preempted_credit(self):
+        api, rec, fleet = _setup(replicas=2)
+        fleet.preempt("fj-serve-1")      # node preemption: exit 83
+        run_to_settled(rec, NS, "fj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert got.status.preempted_count == 1
+        assert got.status.serving["fleet"].get("replicaRestarts",
+                                               0) == 0
+        assert ("Pod", NS, "fj-serve-1") in api.store   # recreated
+
+
+class TestFleetStatusAggregation:
+    def test_per_replica_blocks_aggregate(self):
+        api, rec, fleet = _setup(replicas=2)
+        raw = api.get(KIND_JOB, NS, "fj")
+        raw["status"]["serving"]["replicas"] = {
+            "0": {"tokensPerSec": 10.0, "queueDepth": 1,
+                  "prefixHitRate": 0.8, "tokensTotal": 100},
+            "1": {"tokensPerSec": 30.0, "queueDepth": 3,
+                  "prefixHitRate": 0.4, "tokensTotal": 300},
+        }
+        api.update_status(KIND_JOB, raw)
+        run_to_settled(rec, NS, "fj")
+        sv = TPUJob.from_dict(
+            api.get(KIND_JOB, NS, "fj")).status.serving
+        assert sv["tokensPerSec"] == 40
+        assert sv["queueDepth"] == 4
+        assert sv["prefixHitRate"] == 0.5     # token-weighted
+        assert sv["replicasReporting"] == 2
+        # per-replica blocks preserved for the labeled gauge export
+        assert set(sv["replicas"]) == {"0", "1"}
+
+
+class TestFleetTrainingIsolation:
+    def test_gang_restart_leaves_fleet_alone(self):
+        """A MIXED job (training workers + serving fleet): a worker
+        failure tears down and recreates the GANG, but the serving
+        replicas — independent processes with warm radix caches —
+        survive untouched."""
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        fleet = FakeFleet(api, NS)
+        job = TPUJob(name="mj", namespace=NS, spec=TPUJobSpec(
+            worker=ResourceSpec(replicas=2, template=TMPL),
+            serving=ServingSpec(replicas=2, template=TMPL),
+            max_restarts=2))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "mj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "mj")
+        serve_uids = {
+            n: api.get("Pod", NS, n)["metadata"]["uid"]
+            for n in ("mj-serve-0", "mj-serve-1", "mj-router-0")}
+        fleet.fail("mj-worker-0")
+        run_to_settled(rec, NS, "mj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "mj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "mj"))
+        assert got.status.restart_count == 1       # the gang restarted
+        for n, uid in serve_uids.items():          # the fleet did not
+            assert api.get("Pod", NS, n)["metadata"]["uid"] == uid
+
+    def test_router_alone_is_not_running(self):
+        """A live router fronting zero ready replicas is a total
+        serving outage — the serving-only job's phase must not read
+        RUNNING off the router pod."""
+        api, rec, fleet = _setup(replicas=1)
+        fleet.fail("fj-serve-0")
+        rec.reconcile(NS, "fj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert got.status.phase != "Running"
+
+    def test_serve_exit83_is_not_a_job_failure(self):
+        """Serving pod counters never feed the gang phase: every
+        replica exiting 83 at once must not flip the job to
+        RESTARTING/FAILED."""
+        api, rec, fleet = _setup(replicas=2)
+        fleet.preempt("fj-serve-0")
+        fleet.preempt("fj-serve-1")
+        for _ in range(3):
+            rec.reconcile(NS, "fj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "fj"))
+        assert got.status.phase in ("Running", "Pending", "Starting")
+        assert got.status.restart_count == 0
+
+
+class TestValidationAndSchema:
+    def test_validation(self):
+        job = _fleet_job(replicas=-1)
+        assert any("serving.replicas" in e for e in job.validate())
+        job = TPUJob(name="x", spec=TPUJobSpec(
+            serving=ServingSpec(replicas=1, template={})))
+        assert any("container" in e for e in job.validate())
+        assert _fleet_job(replicas=2).validate() == []
+
+    def test_serde_roundtrip(self):
+        job = _fleet_job(replicas=3, affinity_blocks=4, port=9000)
+        back = TPUJob.from_dict(job.to_dict())
+        assert back.spec.serving.replicas == 3
+        assert back.spec.serving.affinity_blocks == 4
+        assert back.spec.serving.port == 9000
+        assert back.spec.serving.block_size == 8
+
+    def test_crd_schema_covers_serving(self):
+        from paddle_operator_tpu.api.crd import (
+            generate_crd,
+            validate_tpujob_object,
+        )
+
+        crd = generate_crd()
+        schema = crd["spec"]["versions"][0]["schema"][
+            "openAPIV3Schema"]["properties"]
+        assert "serving" in schema["spec"]["properties"]
+        assert "serve" in schema["status"]["properties"]
+        assert validate_tpujob_object(
+            _fleet_job(replicas=2).to_dict()) == []
+        bad = _fleet_job(replicas=2).to_dict()
+        bad["spec"]["serving"]["replicas"] = "two"
+        assert validate_tpujob_object(bad)
+
+    def test_exit_preempted_pinned(self):
+        assert EXIT_PREEMPTED == 83

@@ -272,6 +272,8 @@ class TestQuantRing:
         finally:
             b.close()
 
+    @pytest.mark.slow   # ISSUE 9 budget: pinned every run by the
+    # dryrun serve-kvquant line (cold/hit identity + logit bound)
     def test_cold_and_prefix_hit_match_oracle(self, setup):
         """Greedy generation through the int8 ring — cold admission,
         then a full-prefix-hit follower — matches decode.generate on
@@ -297,6 +299,8 @@ class TestQuantRing:
         finally:
             b.close()
 
+    @pytest.mark.slow   # ISSUE 9 budget: the CoW/radix-hit/suffix int8
+    # paths ride the dryrun serve-kvquant gate's prefix-hit leg
     def test_cow_mid_block_hit_suffix_insert(self, setup):
         """Partial-tail radix hit: the follower shares 19 of a cached
         24-token prompt — hit lands MID-BLOCK, the hit block CoWs

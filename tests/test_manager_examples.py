@@ -90,9 +90,15 @@ class TestExamples:
             mgr.run_once()
         got = TPUJob.from_dict(api.get(KIND_JOB, "default", job.name))
         assert got.status.phase == "Running", path
-        # rendezvous ConfigMap exists with the coordinator address
+        # rendezvous ConfigMap exists with the coordinator address —
+        # or, for a serving-only fleet (no training roles, no XLA
+        # world), the replica endpoint list the router consumes
         cm = api.get("ConfigMap", "default", job.name)
-        assert "TPUJOB_COORDINATOR_ADDRESS" in cm["data"]
+        if job.spec.worker is not None:
+            assert "TPUJOB_COORDINATOR_ADDRESS" in cm["data"]
+        if job.spec.serving is not None:
+            eps = cm["data"]["TPUJOB_SERVE_REPLICAS"].split(",")
+            assert len(eps) == job.spec.serving.replicas
 
     def test_examples_cover_all_baseline_configs(self):
         names = {os.path.basename(p) for p in EXAMPLES}

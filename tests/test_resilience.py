@@ -308,6 +308,10 @@ class TestWatchdogSelfHeal:
         with pytest.raises(RuntimeError, match="chaos"):
             b.submit(p, max_new_tokens=8).result(timeout=60)
         assert not b.healthy
+        # the fatal fault kills the loop thread, but submit's
+        # is_alive() check races its last instants under load — wait
+        # for the death the legacy contract promises, then assert it
+        b._thread.join(timeout=30)
         with pytest.raises(ShuttingDown):
             b.submit(p, max_new_tokens=2)
         b.close()

@@ -221,10 +221,15 @@ class TestContinuousServe:
         assert "fixed per continuous server" in json.loads(
             ei.value.read())["error"]
 
+    @pytest.mark.slow
     def test_speculative_server_surfaces_accept_rate(self):
         """SERVE_SPEC_K-shaped server (continuous + draft): responses
         carry per-row accept_rate, tokens still match plain generate
-        (greedy speculative is token-identical)."""
+        (greedy speculative is token-identical).  Slow tier (ISSUE 9
+        budget): the ring-level accept rate + greedy spec parity stay
+        pinned every run by the dryrun serve-spec line and the fast
+        tests in test_speculative.py; this adds only the HTTP
+        surfacing on top."""
         from paddle_operator_tpu.models.llama import Llama
 
         model, cfg = make_model("tiny", dtype=jnp.float32)

@@ -68,6 +68,75 @@ class TestGaugeNaming:
         g = serving_gauges({}, "ns/x")
         assert all(v == 0.0 for v in g.values())
 
+    def test_single_pod_key_set_byte_identical(self):
+        """ISSUE 9 satellite pin: the fleet work must NOT change the
+        single-pod (unlabeled) gauge shape — existing dashboards key on
+        these exact strings."""
+        g = serving_gauges(SERVING, "default/j")
+        assert set(g) == {
+            'tpujob_serve_tokens_per_sec{job="default/j"}',
+            'tpujob_serve_accept_rate{job="default/j"}',
+            'tpujob_serve_queue_depth{job="default/j"}',
+            'tpujob_serve_prefix_hit_rate{job="default/j"}',
+            'tpujob_serve_kv_blocks_free{job="default/j"}',
+            'tpujob_serve_prefill_queue_depth'
+            '{job="default/j",mode="chunked"}',
+            'tpujob_serve_chunked_prefill_token_share'
+            '{job="default/j"}',
+            'tpujob_serve_kv_pool_bytes'
+            '{job="default/j",mode="int8"}',
+            'tpujob_serve_host_cache_blocks{job="default/j"}',
+            'tpujob_serve_host_hit_rate{job="default/j"}',
+            'tpujob_serve_promoted_blocks_total{job="default/j"}',
+            'tpujob_serve_deadline_exceeded{job="default/j"}',
+            'tpujob_serve_watchdog_restarts{job="default/j"}',
+            'tpujob_serve_quarantined_lanes{job="default/j"}',
+            'tpujob_serve_draining{job="default/j"}',
+        }
+
+    def test_fleet_block_adds_replica_labeled_gauges(self):
+        """ISSUE 9: per-replica blocks under ``replicas`` render with a
+        ``replica`` label so they never collide under one job key; the
+        aggregate top-level keys keep the single-pod shape; the
+        operator's ``fleet`` block adds its own gauges."""
+        fleet_status = dict(
+            SERVING,
+            replicas={
+                "0": {"tokensPerSec": 23.4, "queueDepth": 1,
+                      "prefillMode": "inline", "kvQuantMode": "none"},
+                "1": {"tokensPerSec": 100.0, "queueDepth": 2,
+                      "prefillMode": "inline", "kvQuantMode": "none"},
+            },
+            fleet={"replicasDesired": 2, "replicasReady": 2,
+                   "routerReady": True, "drainedReplicas": 1,
+                   "replicaRestarts": 0},
+        )
+        g = serving_gauges(fleet_status, "default/j")
+        # aggregate: byte-identical single-pod shape
+        assert g['tpujob_serve_tokens_per_sec{job="default/j"}'] \
+            == 123.4
+        # per-replica: labeled, no collisions
+        assert g['tpujob_serve_tokens_per_sec'
+                 '{job="default/j",replica="0"}'] == 23.4
+        assert g['tpujob_serve_tokens_per_sec'
+                 '{job="default/j",replica="1"}'] == 100.0
+        assert g['tpujob_serve_prefill_queue_depth'
+                 '{job="default/j",replica="0",mode="inline"}'] == 0.0
+        # operator fleet block
+        assert g['tpujob_serve_fleet_replicas_desired'
+                 '{job="default/j"}'] == 2.0
+        assert g['tpujob_serve_fleet_replicas_ready'
+                 '{job="default/j"}'] == 2.0
+        assert g['tpujob_serve_fleet_router_ready'
+                 '{job="default/j"}'] == 1.0
+        assert g['tpujob_serve_fleet_drained_replicas'
+                 '{job="default/j"}'] == 1.0
+        # and every gauge name is one of: unlabeled aggregate,
+        # replica-labeled, or a fleet_* gauge — nothing else leaked
+        for k in g:
+            assert ('replica="' in k or 'tpujob_serve_fleet_' in k
+                    or k in serving_gauges(SERVING, "default/j"))
+
 
 def _running_job_with_serving(api, rec, fleet, serving, name="sj"):
     job = TPUJob(name=name, namespace=NS, spec=TPUJobSpec(
