@@ -48,7 +48,8 @@ bench:
 # CPU dry-run gate: entry forward + the 8-virtual-device multichip run
 # (all training parallelism axes, plus the serving parity lines:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
-# serve-disagg, serve-kvquant, serve-hostcache, serve-fleet, ft-drain)
+# serve-disagg, serve-kvquant, serve-hostcache, serve-fleet,
+# serve-qos, ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

@@ -933,6 +933,194 @@ def measure_hierarchical_cache(cfg, params, *, n_prompts: int = 8,
     return out
 
 
+def measure_qos(cfg, params, *, slots: int = 2, prompt_len: int = 16,
+                p0_new: int = 8, p1_new: int = 48, probes: int = 6,
+                backlog: int = 8, max_len: int = 128,
+                block_size: int = 8, chunk: int = 4,
+                adapter_counts=(0, 2, 4), adapter_rank: int = 8,
+                mix_requests: int = 12, mix_new: int = 16) -> list:
+    """Multi-tenant QoS benchmark (ISSUE 10).  Three measurements:
+
+    - **priority isolation**: priority-0 TTFT p50/p95 on a FREE ring
+      vs under a SATURATING priority-1 flood (every lane busy, backlog
+      queued).  With preemptive lane spill the flood adds only the
+      quiesce+spill+admit overhead to p0's TTFT — the
+      ``qos_p0_ttft_flood_ratio`` summary key, acceptance bar <= 1.1x;
+    - **preempt-resume cost**: the full spill -> retire -> restore
+      device round-trip for a mid-generation lane, measured on the
+      executor (``qos_preempt_resume_ms``) — what one preemption
+      charges the VICTIM beyond its parked wait;
+    - **adapter-count sweep**: aggregate served tok/s with requests
+      spread round-robin over N loaded LoRA adapters vs the base-only
+      run on the same ring shape (``adapter_tok_s_ratio`` at the top
+      count) — the cost of the per-lane gather + delta matmul riding
+      every step.
+    """
+    import numpy as np
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+    from paddle_operator_tpu.infer.executor import RingExecutor
+    from paddle_operator_tpu.infer.qos import AdapterRegistry
+
+    rng = np.random.default_rng(0)
+
+    def mk_prompt(seed):
+        return np.random.default_rng(seed).integers(
+            0, cfg.vocab_size, (prompt_len,)).tolist()
+
+    rows = []
+
+    # -- priority isolation -------------------------------------------------
+    b = ContinuousBatcher(params, cfg, slots=slots, max_len=max_len,
+                          chunk_tokens=chunk, paged=True,
+                          block_size=block_size,
+                          prefill_buckets=(prompt_len, max_len))
+    try:
+        b.submit(mk_prompt(0), max_new_tokens=p0_new).result(timeout=600)
+
+        def ttft_probe(i):
+            t0 = time.perf_counter()
+            h = b.submit(mk_prompt(100 + i), max_new_tokens=p0_new,
+                         priority=0, stream=True)
+            next(h.stream(timeout=600))
+            dt = (time.perf_counter() - t0) * 1000
+            h.result(timeout=600)
+            return dt
+
+        free = [ttft_probe(i) for i in range(probes)]
+        # saturating p1 flood: keep every lane busy + a queued backlog
+        # for the whole probe window.  Let the submit burst SETTLE
+        # before the first probe: each submit's device transfer
+        # serializes behind in-flight dispatches, and a probe issued
+        # inside the burst measures that backlog, not admission.
+        flood_handles = [
+            b.submit(mk_prompt(200 + i), max_new_tokens=p1_new)
+            for i in range(slots + backlog)]
+        deadline = time.monotonic() + 30
+        while (sum(r is not None for r in b.lane) < slots
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        time.sleep(0.1)
+        flooded = []
+        for i in range(probes):
+            flooded.append(ttft_probe(1000 + i))
+            # top the flood back up so it stays saturating (2 per
+            # probe: on a fast-draining host the backlog must outpace
+            # lane turnover or the "flood" quietly evaporates)
+            for j in range(2):
+                flood_handles.append(b.submit(
+                    mk_prompt(300 + 10 * i + j),
+                    max_new_tokens=p1_new))
+        # the no-QoS counterfactual: the SAME probe submitted as an
+        # ordinary (default-class) request under the same flood — it
+        # queues behind the whole backlog, which is exactly what a
+        # single-FIFO ring charges an express request.  The
+        # flood-vs-fifo ratio is the isolation win and holds in any
+        # regime; the flood-vs-FREE ratio additionally carries the
+        # host's compute contention (on a shared-core CPU box the
+        # flood steals the prefill's own cycles — the <=1.1x
+        # acceptance bar is the TPU regime, docs/serving.md).
+        fifo = []
+        for i in range(max(2, probes // 3)):
+            # keep the flood saturating for the fifo probe too
+            for j in range(2):
+                flood_handles.append(b.submit(
+                    mk_prompt(600 + 10 * i + j),
+                    max_new_tokens=p1_new))
+            t0 = time.perf_counter()
+            h = b.submit(mk_prompt(500 + i), max_new_tokens=p0_new,
+                         stream=True)
+            next(h.stream(timeout=600))
+            fifo.append((time.perf_counter() - t0) * 1000)
+            h.result(timeout=600)
+        for h in flood_handles:
+            h.result(timeout=600)
+        row = {
+            "qos_slots": slots, "qos_probes": probes,
+            "qos_p0_ttft_free_p50_ms": round(_pctl(free, 0.5), 2),
+            "qos_p0_ttft_free_p95_ms": round(_pctl(free, 0.95), 2),
+            "qos_p0_ttft_flood_p50_ms": round(_pctl(flooded, 0.5), 2),
+            "qos_p0_ttft_flood_p95_ms": round(_pctl(flooded, 0.95), 2),
+            "qos_p0_ttft_fifo_p95_ms": round(_pctl(fifo, 0.95), 2),
+            "qos_preempted_lanes": b.stats["preempted_lanes"],
+            "qos_restored_lanes": b.stats["restored_lanes"],
+        }
+        if _pctl(free, 0.95) > 0:
+            row["qos_p0_ttft_flood_ratio"] = round(
+                _pctl(flooded, 0.95) / _pctl(free, 0.95), 3)
+        if _pctl(flooded, 0.95) > 0:
+            row["qos_fifo_vs_p0_ratio"] = round(
+                _pctl(fifo, 0.95) / _pctl(flooded, 0.95), 2)
+        b.pool.check_invariant()
+        rows.append(row)
+    finally:
+        b.close()
+
+    # -- preempt-resume device cost ----------------------------------------
+    ex = RingExecutor(params, cfg, slots=2, max_len=max_len,
+                      chunk_tokens=chunk, paged=True,
+                      block_size=block_size,
+                      prefill_buckets=(prompt_len, max_len))
+    p = mk_prompt(7)
+    ex.pool.admit(0, p)
+    padded = np.zeros((1, prompt_len), np.int32)
+    padded[0, :] = p
+    import jax.numpy as jnp
+
+    ex.cache, ex.tok, ex.temp, ex.keys, _ = ex.inserts[prompt_len](
+        ex.params, ex.cache, jnp.asarray(ex.pool.table[0]), ex.tok,
+        ex.temp, ex.keys, jnp.asarray(padded), len(p), 0, 0.0, 0)
+    cycles = []
+    for _ in range(max(3, probes // 2)):
+        t0 = time.perf_counter()
+        spill = ex.spill_lane(0)
+        ex.pool.retire(0)
+        ex.restore_lane(0, spill)
+        np.asarray(ex.cache["pos"])     # sync the promote scatter
+        cycles.append((time.perf_counter() - t0) * 1000)
+    rows.append({
+        "qos_preempt_resume_ms": round(_pctl(cycles, 0.5), 2),
+        "qos_preempt_resume_p95_ms": round(_pctl(cycles, 0.95), 2),
+        "qos_spill_blocks": spill["n_blocks"],
+    })
+
+    # -- adapter-count sweep ------------------------------------------------
+    base_tok_s = None
+    for n_adp in adapter_counts:
+        reg = None
+        if n_adp:
+            reg = AdapterRegistry(cfg, capacity=max(adapter_counts),
+                                  rank=adapter_rank)
+            for j in range(n_adp):
+                reg.load(f"bench-{j}", seed=j + 1)
+        b = ContinuousBatcher(params, cfg, slots=slots, max_len=max_len,
+                              chunk_tokens=chunk,
+                              prefill_buckets=(prompt_len, max_len),
+                              adapters=reg)
+        try:
+            b.submit(mk_prompt(0),
+                     max_new_tokens=chunk).result(timeout=600)
+            names = reg.names() if reg is not None else []
+            t0 = time.perf_counter()
+            hs = [b.submit(mk_prompt(400 + i), max_new_tokens=mix_new,
+                           adapter=(names[i % len(names)]
+                                    if names else None))
+                  for i in range(mix_requests)]
+            outs = [h.result(timeout=600) for h in hs]
+            dt = time.perf_counter() - t0
+            generated = sum(len(o) - prompt_len for o in outs)
+            tok_s = round(generated / dt, 1)
+        finally:
+            b.close()
+        row = {"qos_adapters": n_adp, "adapter_tok_s": tok_s}
+        if n_adp == 0:
+            base_tok_s = tok_s
+        elif base_tok_s:
+            row["adapter_tok_s_ratio"] = round(tok_s / base_tok_s, 3)
+        rows.append(row)
+    return rows
+
+
 def measure_speculative(cfg, dcfg, params, dparams, *,
                         spec_ks=(2, 4, 8), batches=(1, 8),
                         prompt_len: int = 128, new_tokens: int = 192,
@@ -1871,6 +2059,39 @@ def main() -> int:
                         cold / host, 2)
         else:
             emit("hier_sweep", hier)
+
+        # multi-tenant QoS sweep on CPU (ISSUE 10): the p0-vs-flood
+        # TTFT split, the preempt->spill->restore device cost and the
+        # adapter-count ratio are all REAL scheduler/allocator
+        # behavior at tiny shapes; absolute latencies are CPU-einsum
+        # physics
+        def cpu_qos():
+            from paddle_operator_tpu.infer.quant import serving_params
+
+            tcfg = dataclasses.replace(L.CONFIGS["tiny"],
+                                       max_seq_len=128)
+            tparams = serving_params(L.Llama(tcfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"], tcfg.dtype)
+            return measure_qos(tcfg, tparams, slots=2, prompt_len=16,
+                               p0_new=8, p1_new=96, probes=6,
+                               max_len=128, block_size=8, chunk=4,
+                               adapter_counts=(0, 2, 4),
+                               adapter_rank=8)
+
+        qos_rows = guarded("qos", cpu_qos)
+        if isinstance(qos_rows, list):
+            for entry in qos_rows:
+                emit("qos_sweep", entry)
+            for entry in qos_rows:
+                for key in ("qos_p0_ttft_flood_ratio",
+                            "qos_fifo_vs_p0_ratio",
+                            "qos_preempt_resume_ms",
+                            "adapter_tok_s_ratio"):
+                    if key in entry:
+                        summary[key] = entry[key]
+        else:
+            emit("qos_sweep", qos_rows)
 
         # speculative sweep on CPU: tiny pattern-trained pair — speeds
         # are meaningless but accept-rate and the greedy-parity path run

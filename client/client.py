@@ -9,6 +9,8 @@ Usage::
     python client/client.py list
     python client/client.py delete my-job
     python client/client.py generate http://host:port '{"tokens": [[1,2]]}'
+    python client/client.py generate http://host:port '{"tokens": [[1,2]]}' \
+        --priority 0 --adapter acme-support
 
 Talks to the apiserver through the same stdlib KubeAPI the controller uses
 (in-cluster service account, or KUBE_HOST/KUBE_TOKEN env for dev).
@@ -163,12 +165,40 @@ def main(argv=None) -> int:
         print(f"tpujob {args[0]} deleted")
     elif cmd == "generate":
         # args: <base_url> <json payload or @file>
-        base = args[0].rstrip("/")
-        raw = args[1] if len(args) > 1 else "{}"
+        #       [--priority N] [--adapter NAME]
+        # QoS flags (ISSUE 10) thread into the request BODY before the
+        # first attempt, so every retry carries them verbatim alongside
+        # the once-minted request_id — the router forwards both
+        # untouched and a replayed result is the same class/adapter
+        # the original ran under.
+        priority = adapter = None
+        rest = []
+        it = iter(args)
+        try:
+            for a in it:
+                if a == "--priority":
+                    priority = int(next(it))
+                elif a.startswith("--priority="):
+                    priority = int(a.split("=", 1)[1])
+                elif a == "--adapter":
+                    adapter = next(it)
+                elif a.startswith("--adapter="):
+                    adapter = a.split("=", 1)[1]
+                else:
+                    rest.append(a)
+        except StopIteration:
+            print(f"{a} needs a value", file=sys.stderr)
+            return 2
+        base = rest[0].rstrip("/")
+        raw = rest[1] if len(rest) > 1 else "{}"
         if raw.startswith("@"):
             with open(raw[1:]) as f:
                 raw = f.read()
         payload = json.loads(raw)
+        if priority is not None:
+            payload["priority"] = priority
+        if adapter is not None:
+            payload["adapter"] = adapter
         deadline_env = os.environ.get("GEN_DEADLINE_S")
         deadline_s = payload.get(
             "deadline_s",

@@ -231,6 +231,19 @@ def _spec_schema() -> Dict[str, Any]:
                     "router": _pod_template_schema(),
                     "affinityBlocks": _int(0),
                     "blockSize": _int(1),
+                    # multi-tenant QoS + many-adapter serving
+                    # (ISSUE 10): priority classes (0 most urgent),
+                    # preemptive lane spill, and the LoRA adapter set
+                    # each replica loads at boot (SERVE_ADAPTERS
+                    # entries — name / name:seed:N / name:path.npz)
+                    "priorities": _int(0),
+                    "preemption": {"type": "boolean"},
+                    "adapters": {
+                        "type": "array",
+                        "items": {"type": "string"},
+                    },
+                    "adapterRank": _int(0),
+                    "maxAdapters": _int(0),
                 },
             },
             "tpu": {

@@ -293,6 +293,19 @@ class ServingSpec:
       (0 = pure least-loaded routing);
     - ``block_size``       must match the replicas' SERVE_BLOCK_SIZE —
       the radix chain the affinity key reuses is block-granular.
+
+    Multi-tenant QoS + many-adapter serving (ISSUE 10):
+
+    - ``priorities``       admission classes per replica (0 most
+      urgent; 0/unset keeps the server default) -> SERVE_PRIORITIES;
+    - ``preemption``       allow preemptive lane spill for more urgent
+      waiting work (None keeps the server default) -> SERVE_PREEMPT;
+    - ``adapters``         LoRA adapters every replica loads at boot —
+      SERVE_ADAPTERS entry syntax (``name`` / ``name:seed:N`` /
+      ``name:/path.npz``); the router prefers replicas holding a
+      request's adapter;
+    - ``adapter_rank`` / ``max_adapters``  size the fixed-shape
+      adapter pool (SERVE_ADAPTER_RANK / SERVE_MAX_ADAPTERS).
     """
 
     replicas: int = 1
@@ -301,6 +314,11 @@ class ServingSpec:
     router: Dict[str, Any] = field(default_factory=dict)
     affinity_blocks: int = 2
     block_size: int = 256
+    priorities: int = 0
+    preemption: Optional[bool] = None
+    adapters: List[str] = field(default_factory=list)
+    adapter_rank: int = 0
+    max_adapters: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"replicas": self.replicas}
@@ -314,6 +332,16 @@ class ServingSpec:
             d["affinityBlocks"] = self.affinity_blocks
         if self.block_size != 256:
             d["blockSize"] = self.block_size
+        if self.priorities:
+            d["priorities"] = self.priorities
+        if self.preemption is not None:
+            d["preemption"] = self.preemption
+        if self.adapters:
+            d["adapters"] = list(self.adapters)
+        if self.adapter_rank:
+            d["adapterRank"] = self.adapter_rank
+        if self.max_adapters:
+            d["maxAdapters"] = self.max_adapters
         return d
 
     @classmethod
@@ -321,6 +349,7 @@ class ServingSpec:
                   ) -> Optional["ServingSpec"]:
         if d is None:
             return None
+        preempt = d.get("preemption")
         return cls(
             replicas=int(d.get("replicas", 1)),
             port=int(d.get("port", SERVE_PORT)),
@@ -328,6 +357,11 @@ class ServingSpec:
             router=d.get("router", {}) or {},
             affinity_blocks=int(d.get("affinityBlocks", 2)),
             block_size=int(d.get("blockSize", 256)),
+            priorities=int(d.get("priorities", 0)),
+            preemption=bool(preempt) if preempt is not None else None,
+            adapters=[str(a) for a in (d.get("adapters") or [])],
+            adapter_rank=int(d.get("adapterRank", 0)),
+            max_adapters=int(d.get("maxAdapters", 0)),
         )
 
 

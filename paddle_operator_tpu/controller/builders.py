@@ -616,6 +616,19 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
     _env_setdefault(env, "SERVE_CONTINUOUS", "1")
     _env_setdefault(env, "SERVE_PAGED", "1")
     _env_setdefault(env, "SERVE_BLOCK_SIZE", str(sv.block_size))
+    # multi-tenant QoS + many-adapter serving (ISSUE 10): spec knobs
+    # map onto the SERVE_* surface, user template still overrides
+    if sv.priorities:
+        _env_setdefault(env, "SERVE_PRIORITIES", str(sv.priorities))
+    if sv.preemption is not None:
+        _env_setdefault(env, "SERVE_PREEMPT",
+                        "1" if sv.preemption else "0")
+    if sv.adapters:
+        _env_setdefault(env, "SERVE_ADAPTERS", ",".join(sv.adapters))
+    if sv.adapter_rank:
+        _env_setdefault(env, "SERVE_ADAPTER_RANK", str(sv.adapter_rank))
+    if sv.max_adapters:
+        _env_setdefault(env, "SERVE_MAX_ADAPTERS", str(sv.max_adapters))
     if job.spec.checkpoint_path:
         _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
                         job.spec.checkpoint_path)

@@ -38,6 +38,11 @@ from paddle_operator_tpu.infer.executor import (  # noqa: F401
     make_spec_chunked_final_insert,
     make_spec_prefill_insert,
 )
+from paddle_operator_tpu.infer.qos import (  # noqa: F401
+    AdapterRegistry,
+    MultiClassQueue,
+    QoSConfig,
+)
 from paddle_operator_tpu.infer.scheduler import (  # noqa: F401
     PREFILL_MODES,
     ContinuousBatcher,
@@ -53,4 +58,5 @@ __all__ = [
     "make_prefill_chunk", "make_chunked_final_insert",
     "make_spec_chunked_final_insert", "make_attach_lane",
     "make_spec_attach", "make_disagg_prefill",
+    "QoSConfig", "AdapterRegistry", "MultiClassQueue",
 ]

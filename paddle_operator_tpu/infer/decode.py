@@ -169,16 +169,29 @@ def init_cache(cfg: LlamaConfig, batch: int,
 
 
 def _qkv(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
-         cos: jax.Array, sin: jax.Array, pos: jax.Array
-         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+         cos: jax.Array, sin: jax.Array, pos: jax.Array,
+         lora=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Pre-attention half of a decoder layer: RMSNorm -> q/k/v
-    projections -> RoPE at offset ``pos``.  Shapes [B, T, H, D]."""
+    projections -> RoPE at offset ``pos``.  Shapes [B, T, H, D].
+
+    ``lora`` (ISSUE 10 many-adapter serving): ``(adp_l, aid)`` — one
+    layer's stacked LoRA arrays + per-row adapter ids; the low-rank
+    delta adds to the projection outputs BEFORE RoPE (qos.lora_qkv),
+    so adapter KV enters the cache exactly as a merged-weight forward
+    would produce it."""
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = _rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    q = _mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
-    k = _mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
-    v = _mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    q = _mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype)
+    k = _mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype)
+    v = _mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype)
+    if lora is not None:
+        from paddle_operator_tpu.infer.qos import lora_qkv
+
+        q, k, v = lora_qkv(h, lora[0], lora[1], q, k, v, cfg.dtype)
+    q = q.reshape(b, t, hq, d)
+    k = k.reshape(b, t, hkv, d)
+    v = v.reshape(b, t, hkv, d)
     return _rope(q, cos, sin, pos), _rope(k, cos, sin, pos), v
 
 
@@ -210,7 +223,7 @@ def _finish_layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
 def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
            cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
-           v_cache: jax.Array, pos: jax.Array
+           v_cache: jax.Array, pos: jax.Array, lora=None
            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer over [B, T] new positions starting at ``pos``,
     attending to the cache's [0, pos+T), with the XLA einsum attention.
@@ -220,7 +233,7 @@ def _layer(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     caches stacked (see _forward) so the kernel reads them copy-free."""
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q, k, v = _qkv(cfg, lp, x, cos, sin, pos)
+    q, k, v = _qkv(cfg, lp, x, cos, sin, pos, lora=lora)
 
     # [B, T, H, D] -> head-major [B, H, T, D] rows into the cache
     k_cache = jax.lax.dynamic_update_slice(
@@ -287,7 +300,8 @@ def _moe_ffn(cfg: LlamaConfig, mp: Dict[str, Any],
 
 def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
              cache: Dict[str, jax.Array], *, last_only: bool = False,
-             mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+             mesh=None, lora=None
+             ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """[B, T] new tokens at cache['pos'] -> ([B, T, vocab] logits,
     advanced cache).  Layers run under lax.scan over the stacked params
     (the same ``layers`` layout nn.scan trains).
@@ -302,8 +316,12 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
     GSPMD off the param/cache shardings, and the pallas kernel enters
     through its own shard_map with a per-layer wo psum
     (sharded_decode_attention).  Configs the kernel cannot split
-    (decode_tp_compatible) fall back to the GSPMD einsum path whole."""
+    (decode_tp_compatible) fall back to the GSPMD einsum path whole.
+
+    ``lora``: ``(adp, aid)`` — stacked [L, ...] adapter arrays riding
+    the layer scan as xs, per-row adapter ids (infer/qos.py)."""
     pos = cache["pos"]
+    adp, aid = lora if lora is not None else (None, None)
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tokens]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
@@ -325,8 +343,13 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv(cfg, lp, x, cos, sin, pos)
+            if adp is not None:
+                lp, adp_l, li = layer_in
+                lo = (adp_l, aid)
+            else:
+                lp, li = layer_in
+                lo = None
+            q, k, v = _qkv(cfg, lp, x, cos, sin, pos, lora=lo)
             kc = jax.lax.dynamic_update_slice(
                 kc, k.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
             vc = jax.lax.dynamic_update_slice(
@@ -339,9 +362,11 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
             x = x + proj[:, None].astype(cfg.dtype)
             return (_ffn_residual(cfg, lp, x), kc, vc), ()
 
+        xs = ((params["layers"], adp, jnp.arange(cfg.n_layers))
+              if adp is not None
+              else (params["layers"], jnp.arange(cfg.n_layers)))
         (x, k_new, v_new), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["layers"], jnp.arange(cfg.n_layers)))
+            body, (x, cache["k"], cache["v"]), xs)
     elif tokens.shape[1] == 1 and attn_impl != "xla":
         # pallas decode path: the caches stay STACKED [L, B, H, S, D]
         # and flow as scan CARRY, with the layer index steering the
@@ -357,8 +382,13 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
 
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv(cfg, lp, x, cos, sin, pos)
+            if adp is not None:
+                lp, adp_l, li = layer_in
+                lo = (adp_l, aid)
+            else:
+                lp, li = layer_in
+                lo = None
+            q, k, v = _qkv(cfg, lp, x, cos, sin, pos, lora=lo)
             kc = jax.lax.dynamic_update_slice(
                 kc, k.transpose(0, 2, 1, 3)[None], (li, 0, 0, pos, 0))
             vc = jax.lax.dynamic_update_slice(
@@ -369,17 +399,27 @@ def _forward(cfg: LlamaConfig, params: Dict[str, Any], tokens: jax.Array,
             out = out.reshape(b, 1, hq * d).astype(cfg.dtype)
             return (_finish_layer(cfg, lp, x, out), kc, vc), ()
 
+        xs = ((params["layers"], adp, jnp.arange(cfg.n_layers))
+              if adp is not None
+              else (params["layers"], jnp.arange(cfg.n_layers)))
         (x, k_new, v_new), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["layers"], jnp.arange(cfg.n_layers)))
+            body, (x, cache["k"], cache["v"]), xs)
     else:
         def body(x, layer_in):
-            lp, k_c, v_c = layer_in
-            y, k_c, v_c = _layer(cfg, lp, x, cos, sin, k_c, v_c, pos)
+            if adp is not None:
+                lp, adp_l, k_c, v_c = layer_in
+                lo = (adp_l, aid)
+            else:
+                lp, k_c, v_c = layer_in
+                lo = None
+            y, k_c, v_c = _layer(cfg, lp, x, cos, sin, k_c, v_c, pos,
+                                 lora=lo)
             return y, (k_c, v_c)
 
-        x, (k_new, v_new) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+        xs = ((params["layers"], adp, cache["k"], cache["v"])
+              if adp is not None
+              else (params["layers"], cache["k"], cache["v"]))
+        x, (k_new, v_new) = jax.lax.scan(body, x, xs)
     if last_only:
         x = x[:, -1:]
     x = _rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
@@ -409,7 +449,7 @@ def paged_prefill(params: Dict[str, Any], cfg: LlamaConfig,
                   tokens: jax.Array, pool_cache: Dict[str, jax.Array],
                   table_row: jax.Array, *, block_size: Optional[int] = None,
                   mesh=None, quant: bool = False,
-                  prompt_len: Optional[jax.Array] = None):
+                  prompt_len: Optional[jax.Array] = None, lora=None):
     """Prefill a whole [1, bucket] prompt and write its KV into the
     PAGED block pool (infer/paged.py) as block-aligned chunks at the
     lane's ``table_row`` entries — the cold-admission half of paged
@@ -434,7 +474,8 @@ def paged_prefill(params: Dict[str, Any], cfg: LlamaConfig,
 
     bs = block_size or pool_cache["k"].shape[3]
     lane = init_cache(cfg, 1, tokens.shape[1])
-    logits, lane = _forward(cfg, params, tokens, lane, mesh=mesh)
+    logits, lane = _forward(cfg, params, tokens, lane, mesh=mesh,
+                            lora=lora)
     if not quant:
         k = _scatter_prompt_blocks(pool_cache["k"], lane["k"], table_row,
                                    bs)

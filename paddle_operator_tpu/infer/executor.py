@@ -84,16 +84,29 @@ def _write_lane(cache_l: jax.Array, kv: jax.Array,
 
 
 def _qkv_ring(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
-              cos: jax.Array, sin: jax.Array, pos: jax.Array):
+              cos: jax.Array, sin: jax.Array, pos: jax.Array,
+              lora=None):
     """Pre-attention half for ONE new token per lane at per-lane
     positions ``pos`` [B]: RMSNorm -> projections -> RoPE at each
-    lane's own position (the table slice is a plain gather cos[pos])."""
+    lane's own position (the table slice is a plain gather cos[pos]).
+
+    ``lora`` (ISSUE 10): ``(adp_l, aid)`` — one layer's stacked LoRA
+    arrays + the per-LANE adapter id vector; the batched gather +
+    delta matmul (qos.lora_qkv) runs inside the same compiled step, so
+    a mixed-adapter batch is still ONE dispatch."""
     b = x.shape[0]
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, 1, hq, d)
-    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
-    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, 1, hkv, d)
+    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype)
+    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype)
+    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype)
+    if lora is not None:
+        from paddle_operator_tpu.infer.qos import lora_qkv
+
+        q, k, v = lora_qkv(h, lora[0], lora[1], q, k, v, cfg.dtype)
+    q = q.reshape(b, 1, hq, d)
+    k = k.reshape(b, 1, hkv, d)
+    v = v.reshape(b, 1, hkv, d)
     cos_b = cos[pos][:, None, None, :]          # [B, 1, 1, d/2]
     sin_b = sin[pos][:, None, None, :]
 
@@ -108,7 +121,7 @@ def _qkv_ring(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
 def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
                 cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
-                v_cache: jax.Array, pos: jax.Array
+                v_cache: jax.Array, pos: jax.Array, lora=None
                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer for ONE new token per lane ([B, 1, D] at lane
     positions ``pos`` [B]) with the XLA einsum attention.  Same math as
@@ -117,7 +130,7 @@ def _layer_step(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     stacked and does not go through here (see _ring_forward)."""
     b = x.shape[0]
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+    q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lora)
     k_cache = _write_lane(k_cache, k.transpose(0, 2, 1, 3), pos)
     v_cache = _write_lane(v_cache, v.transpose(0, 2, 1, 3), pos)
 
@@ -167,7 +180,8 @@ def _write_lane_stacked(stack: jax.Array, kv: jax.Array, li: jax.Array,
 
 def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
                   tok: jax.Array, cache: Dict[str, jax.Array],
-                  mesh=None) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                  mesh=None, lora=None
+                  ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """tok [B] at per-lane cache['pos'] -> (logits [B, V], advanced
     cache).  Counterpart of decode._forward for vector positions; like
     it, the pallas path carries the caches STACKED through the layer
@@ -177,6 +191,7 @@ def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     ``pos`` vector is exactly the ``lengths`` operand the kernel's
     index map already takes — replicated across shards)."""
     pos = cache["pos"]
+    adp, aid = lora if lora is not None else (None, None)
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tok[:, None]]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
@@ -185,6 +200,17 @@ def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     use_sharded = D._use_sharded_kernel(cfg, mesh, attn_impl)
     if D.mesh_tp(mesh) > 1 and not use_sharded:
         attn_impl = "xla"   # whole GQA groups don't split: GSPMD einsum
+    stacked_xs = ((params["layers"], adp, jnp.arange(cfg.n_layers))
+                  if adp is not None
+                  else (params["layers"], jnp.arange(cfg.n_layers)))
+
+    def _unpack(layer_in):
+        if adp is not None:
+            lp, adp_l, li = layer_in
+            return lp, li, (adp_l, aid)
+        lp, li = layer_in
+        return lp, li, None
+
     if use_sharded:
         from paddle_operator_tpu.ops.decode_attention import (
             sharded_decode_attention,
@@ -192,8 +218,8 @@ def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
             vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
             proj = sharded_decode_attention(
@@ -205,8 +231,7 @@ def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
             return (D._ffn_residual(cfg, lp, x), kc, vc), ()
 
         (x, k_new, v_new), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["layers"], jnp.arange(cfg.n_layers)))
+            body, (x, cache["k"], cache["v"]), stacked_xs)
     elif attn_impl != "xla":
         from paddle_operator_tpu.ops.decode_attention import decode_attention
 
@@ -215,8 +240,8 @@ def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc = _write_lane_stacked(kc, k.transpose(0, 2, 1, 3), li, pos)
             vc = _write_lane_stacked(vc, v.transpose(0, 2, 1, 3), li, pos)
             out = decode_attention(
@@ -226,16 +251,23 @@ def _ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
             return (D._finish_layer(cfg, lp, x, out), kc, vc), ()
 
         (x, k_new, v_new), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["layers"], jnp.arange(cfg.n_layers)))
+            body, (x, cache["k"], cache["v"]), stacked_xs)
     else:
         def body(x, layer_in):
-            lp, k_c, v_c = layer_in
-            y, k_c, v_c = _layer_step(cfg, lp, x, cos, sin, k_c, v_c, pos)
+            if adp is not None:
+                lp, adp_l, k_c, v_c = layer_in
+                lo = (adp_l, aid)
+            else:
+                lp, k_c, v_c = layer_in
+                lo = None
+            y, k_c, v_c = _layer_step(cfg, lp, x, cos, sin, k_c, v_c,
+                                      pos, lora=lo)
             return y, (k_c, v_c)
 
-        x, (k_new, v_new) = jax.lax.scan(
-            body, x, (params["layers"], cache["k"], cache["v"]))
+        xs = ((params["layers"], adp, cache["k"], cache["v"])
+              if adp is not None
+              else (params["layers"], cache["k"], cache["v"]))
+        x, (k_new, v_new) = jax.lax.scan(body, x, xs)
     x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     logits = D._mm(x, params["lm_head"]["kernel"],
                    cfg.dtype).astype(jnp.float32)
@@ -284,7 +316,12 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     home.  Token outputs are unchanged; the fold rides the same scan.
     """
 
-    def step(params, cache, tok, temp, keys, active):
+    def step(params, cache, tok, temp, keys, active, *lora_args):
+        # adapter serving (ISSUE 10): the stacked LoRA arrays + per-lane
+        # adapter ids arrive as trailing operands — absent, the traced
+        # program is byte-identical to the adapterless ring
+        lora = tuple(lora_args) if lora_args else None
+
         def tick(carry, _):
             # the isfinite fold rides the carry ONLY when requested —
             # the default resident program is unchanged
@@ -293,7 +330,7 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
             else:
                 cache, tok = carry
             logits, new_cache = _ring_forward(cfg, params, tok, cache,
-                                              mesh=mesh)
+                                              mesh=mesh, lora=lora)
             nxt = _sample_tokens(logits, temp, keys, cache["pos"],
                                  top_k, top_p)
             # retired/free lanes: position ZEROED (a stale fill
@@ -373,9 +410,11 @@ def make_prefill_insert(cfg: LlamaConfig, bucket: int,
     """
 
     def insert(params, cache, tok, temp, keys, prompt, prompt_len, slot,
-               temp_val, seed):
+               temp_val, seed, *lora_args):
         lane = D.init_cache(cfg, 1, bucket)
-        logits, lane = D._forward(cfg, params, prompt, lane, mesh=mesh)
+        logits, lane = D._forward(
+            cfg, params, prompt, lane, mesh=mesh,
+            lora=tuple(lora_args) if lora_args else None)
         logits = logits[0, prompt_len - 1]                  # last real row
         new_cache = _splice_lane(cache, lane, slot, prompt_len)
         # first token through the SHARED sampling rule (_sample_tokens),
@@ -452,11 +491,12 @@ def make_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
     """
     from paddle_operator_tpu.infer.speculative import _multi_forward
 
-    def chunk(params, lane_k, lane_v, toks, start):
+    def chunk(params, lane_k, lane_v, toks, start, *lora_args):
         cache = {"k": lane_k, "v": lane_v,
                  "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
-        _, new = _multi_forward(cfg, params, toks, cache, mesh=mesh,
-                                head=False)
+        _, new = _multi_forward(
+            cfg, params, toks, cache, mesh=mesh, head=False,
+            lora=tuple(lora_args) if lora_args else None)
         return new["k"], new["v"]
 
     return jax.jit(chunk, donate_argnums=(1, 2))
@@ -479,11 +519,13 @@ def make_chunked_final_insert(cfg: LlamaConfig, slice_bucket: int,
     from paddle_operator_tpu.infer.speculative import _multi_forward
 
     def insert(params, cache, lane_k, lane_v, tok, temp, keys, toks,
-               n_rows, start, prompt_len, slot, temp_val, seed):
+               n_rows, start, prompt_len, slot, temp_val, seed,
+               *lora_args):
         stage = {"k": lane_k, "v": lane_v,
                  "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
-        logits, new_lane = _multi_forward(cfg, params, toks, stage,
-                                          mesh=mesh)
+        logits, new_lane = _multi_forward(
+            cfg, params, toks, stage, mesh=mesh,
+            lora=tuple(lora_args) if lora_args else None)
         logits = logits[0, n_rows - 1]
         new_cache = _splice_lane(cache, new_lane, slot, prompt_len)
         key = jax.random.PRNGKey(seed)
@@ -623,12 +665,13 @@ def make_disagg_prefill(cfg: LlamaConfig, bucket: int, block_size: int,
     """
 
     def prefill(params, cache, table_row, prompt, prompt_len, temp_val,
-                seed):
+                seed, *lora_args):
+        lora = tuple(lora_args) if lora_args else None
         if quant:
             logits, new_cache, tail_k, tail_v = D.paged_prefill(
                 params, cfg, prompt, cache, table_row,
                 block_size=block_size, mesh=mesh, quant=True,
-                prompt_len=prompt_len)
+                prompt_len=prompt_len, lora=lora)
             new_cache["kt"] = jax.lax.dynamic_update_slice(
                 new_cache["kt"], tail_k, (0, 0, 0, 0, 0))
             new_cache["vt"] = jax.lax.dynamic_update_slice(
@@ -637,7 +680,7 @@ def make_disagg_prefill(cfg: LlamaConfig, bucket: int, block_size: int,
             logits, new_cache = D.paged_prefill(params, cfg, prompt,
                                                 cache, table_row,
                                                 block_size=block_size,
-                                                mesh=mesh)
+                                                mesh=mesh, lora=lora)
         logits = logits[0, prompt_len - 1]
         key = jax.random.PRNGKey(seed)
         first = _sample_tokens(
@@ -675,9 +718,13 @@ class PrefillExecutor:
                  block_size: int, buckets: Tuple[int, ...],
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None, mesh=None,
-                 kv_quant: str = "none") -> None:
+                 kv_quant: str = "none", adapters=None) -> None:
         from paddle_operator_tpu.infer import paged as PG
 
+        # adapter registry shared with the decode ring (ISSUE 10): a
+        # cold adapter prompt must prefill WITH its delta — the KV the
+        # handoff copies is the adapter's, not the base model's
+        self.adapters = adapters
         self.params = params
         self.cfg = cfg
         self.block_size = int(block_size)
@@ -747,9 +794,14 @@ class PrefillExecutor:
                     padded[0, :n] = req.prompt
                     prompt = jnp.asarray(padded)
                 prog = self._progs[pb]
+                tail = ()
+                if self.adapters is not None:
+                    tail = (self.adapters.arrays(),
+                            jnp.full((1,), getattr(req, "adapter_idx", 0),
+                                     jnp.int32))
                 self.cache, first = prog(
                     self.params, self.cache, self.table_row,
-                    prompt, n, float(req.temperature), req.seed)
+                    prompt, n, float(req.temperature), req.seed, *tail)
                 n_blocks = -(-len(req.prompt) // self.block_size)
                 try:
                     first.copy_to_host_async()
@@ -808,7 +860,22 @@ class RingExecutor:
                  prefill_chunk: int = 64,
                  check_finite: bool = False,
                  kv_quant: str = "none",
-                 host_cache_blocks: int = 0) -> None:
+                 host_cache_blocks: int = 0,
+                 adapters=None) -> None:
+        # many-adapter serving (ISSUE 10, infer/qos.py AdapterRegistry):
+        # stacked LoRA deltas served off the one base param set.  The
+        # registry's arrays ride every dispatch as trailing operands
+        # (lora_step_tail / lora_insert_tail), so load/evict reaches
+        # the compiled programs without retraces.  Spec rings refuse:
+        # the draft stays base-only by design, and a drafted token
+        # stream verified under a different (adapted) target would
+        # collapse acceptance — scheduler.submit rejects per-request
+        # adapters instead of silently serving base math.
+        if adapters is not None and spec_k:
+            raise ValueError(
+                "adapters are not supported on speculative rings (the "
+                "draft proposes base-only); disable one of them")
+        self.adapters = adapters
         self.mesh = mesh
         if mesh is not None and D.mesh_tp(mesh) > 1:
             params = D.shard_params_for_serving(params, cfg, mesh)
@@ -957,7 +1024,7 @@ class RingExecutor:
                 self.params, cfg, max_len=max_len,
                 block_size=self.block_size, buckets=self.buckets,
                 top_k=top_k, top_p=top_p, mesh=mesh,
-                kv_quant=self.kv_quant)
+                kv_quant=self.kv_quant, adapters=adapters)
             self._transfer = self._pg.make_pool_transfer(
                 self.pool.max_blocks, quant=self.quant)
             self._attach = make_attach_lane()
@@ -1000,6 +1067,28 @@ class RingExecutor:
         self.tok = jnp.zeros((self.slots,), jnp.int32)
         self.temp = jnp.zeros((self.slots,), jnp.float32)
         self.keys = jnp.zeros((self.slots, 2), jnp.uint32)
+        # per-lane adapter id HOST mirror (ISSUE 10): set at admission,
+        # zeroed at evict, shipped with every adapter-aware dispatch.
+        # Host-side (not donated device state) because it changes only
+        # at admission and the step reads it as a tiny operand.
+        self.aid = np.zeros((self.slots,), np.int32)
+
+    # -- adapter (LoRA) dispatch tails (ISSUE 10) --------------------------
+
+    def lora_step_tail(self) -> tuple:
+        """Trailing operands for the resident chunk step: the stacked
+        adapter arrays + the per-lane id vector — or () when adapters
+        are off, keeping every dispatch byte-identical to today's."""
+        if self.adapters is None:
+            return ()
+        return (self.adapters.arrays(), jnp.asarray(self.aid))
+
+    def lora_insert_tail(self, aid_val: int) -> tuple:
+        """Trailing operands for a batch-of-one admission insert."""
+        if self.adapters is None:
+            return ()
+        return (self.adapters.arrays(),
+                jnp.full((1,), int(aid_val), jnp.int32))
 
     # -- lazily-compiled admission programs --------------------------------
 
@@ -1159,6 +1248,18 @@ class RingExecutor:
             spill["vs"] = np.asarray(jnp.take(c["vs"], ids, axis=1))
             spill["kt"] = np.asarray(c["kt"][:, slot])
             spill["vt"] = np.asarray(c["vt"][:, slot])
+        if self.spec_k:
+            # the DRAFT lane is resident context too (contiguous ring):
+            # a spec round resumed without it would re-propose from a
+            # zeroed draft cache and diverge from the uninterrupted
+            # stream the moment any draft is accepted.  The whole lane
+            # alloc is captured — rows past dpos are junk the fill mask
+            # already hides, and exactness beats a slice here.
+            spill["dk"] = np.asarray(self.dcache["k"][:, slot])
+            spill["dv"] = np.asarray(self.dcache["v"][:, slot])
+            spill["dpos"] = int(np.asarray(self.dcache["pos"])[slot])
+        if self.adapters is not None:
+            spill["aid"] = int(self.aid[slot])
         return spill
 
     def restore_lane(self, slot: int, spill: Dict[str, Any]) -> None:
@@ -1191,6 +1292,15 @@ class RingExecutor:
                 jnp.asarray(spill["kt"]))
             self.cache["vt"] = self.cache["vt"].at[:, slot].set(
                 jnp.asarray(spill["vt"]))
+        if self.spec_k:
+            self.dcache["k"] = self.dcache["k"].at[:, slot].set(
+                jnp.asarray(spill["dk"]))
+            self.dcache["v"] = self.dcache["v"].at[:, slot].set(
+                jnp.asarray(spill["dv"]))
+            self.dcache["pos"] = self.dcache["pos"].at[slot].set(
+                spill["dpos"])
+        if self.adapters is not None and "aid" in spill:
+            self.aid[slot] = spill["aid"]
         self.cache["pos"] = self.cache["pos"].at[slot].set(spill["pos"])
         self.tok = self.tok.at[slot].set(spill["tok"])
         self.temp = self.temp.at[slot].set(spill["temp"])
@@ -1306,6 +1416,10 @@ class RingExecutor:
         active = jnp.zeros((slots,), bool)
         dcache = (init_ring_cache(self.draft_cfg, slots, self.max_len,
                                   mesh=self.mesh) if self.spec_k else None)
+        # adapter-aware rings dispatch with trailing lora operands —
+        # warm THOSE traces (the tail-less ones would never run)
+        st = self.lora_step_tail()
+        it = self.lora_insert_tail(0)
         # the resident step first: it is the program every lane shares
         if self.spec_k:
             args = (self.params, self.draft_params, cache, dcache)
@@ -1315,10 +1429,11 @@ class RingExecutor:
             cache, dcache, tok = out[0], out[1], out[2]
         elif self.paged:
             out = self.step(self.params, cache, tbl, tok, temp, keys,
-                            active)
+                            active, *st)
             cache, tok = out[0], out[1]
         else:
-            out = self.step(self.params, cache, tok, temp, keys, active)
+            out = self.step(self.params, cache, tok, temp, keys, active,
+                            *st)
             cache, tok = out[0], out[1]
         for b in self.buckets:
             prompt = jnp.zeros((1, b), jnp.int32)
@@ -1335,11 +1450,11 @@ class RingExecutor:
                 row = jnp.zeros((self.pool.max_blocks,), jnp.int32)
                 cache, tok, temp, keys, _ = self.inserts[b](
                     self.params, cache, row, tok, temp, keys, prompt,
-                    1, 0, 0.0, 0)
+                    1, 0, 0.0, 0, *it)
             else:
                 cache, tok, temp, keys, _ = self.inserts[b](
                     self.params, cache, tok, temp, keys, prompt, 1, 0,
-                    0.0, 0)
+                    0.0, 0, *it)
         if self.paged and not self.spec_k:
             # the SUFFIX-insert ladder: a radix prefix hit (even a
             # partial-tail one on an otherwise cold prompt) admits
@@ -1361,7 +1476,7 @@ class RingExecutor:
                 toks = jnp.zeros((1, sb), jnp.int32)
                 cache, tok, temp, keys, _ = self.suffix_insert(sb)(
                     self.params, cache, row, tok, temp, keys, toks,
-                    1, 0, 0, 0.0, 0)
+                    1, 0, 0, 0.0, 0, *it)
             if self.quant:
                 self._copy_block(jnp.zeros_like(cache["k"]),
                                  jnp.zeros_like(cache["v"]),
@@ -1419,7 +1534,7 @@ class RingExecutor:
             pe = self.prefill_exec
             for b, prog in pe._progs.items():
                 prog(self.params, pe.cache, pe.table_row,
-                     jnp.zeros((1, b), jnp.int32), 1, 0.0, 0)
+                     jnp.zeros((1, b), jnp.int32), 1, 0.0, 0, *it)
             m = self.pool.max_blocks
             ids = jnp.zeros((m,), jnp.int32)
             if self.quant:
@@ -1448,7 +1563,7 @@ class RingExecutor:
                 chunk_args = (self.params, cache, row, toks, 0, 0)
                 if self.quant:      # quant slices take a trailing slot
                     chunk_args += (0,)
-                cache = self.chunk_prog(None)(*chunk_args)
+                cache = self.chunk_prog(None)(*chunk_args, *it)
                 if self.spec_k:
                     for b in self.buckets:
                         prompt = jnp.zeros((1, b), jnp.int32)
@@ -1460,7 +1575,7 @@ class RingExecutor:
                 else:
                     out = self.final_insert(None)(
                         self.params, cache, row, tok, temp, keys, toks,
-                        1, 0, 0, 0.0, 0)
+                        1, 0, 0, 0.0, 0, *it)
                     cache, tok, temp, keys = out[:4]
             else:
                 for b in self.buckets:
@@ -1468,7 +1583,7 @@ class RingExecutor:
                     lk, lv = self.make_staging(b)
                     if sl > sb:
                         lk, lv = self.chunk_prog(sl)(self.params, lk, lv,
-                                                     toks, 0)
+                                                     toks, 0, *it)
                     if self.spec_k:
                         prompt = jnp.zeros((1, b), jnp.int32)
                         out = self.final_insert(sl, b)(
@@ -1479,7 +1594,7 @@ class RingExecutor:
                     else:
                         out = self.final_insert(sl)(
                             self.params, cache, lk, lv, tok, temp, keys,
-                            toks, 1, 0, 1, 0, 0.0, 0)
+                            toks, 1, 0, 1, 0, 0.0, 0, *it)
                         cache, tok, temp, keys = out[:4]
 
 

@@ -493,7 +493,23 @@ class PagedCacheManager:
         hits cannot drift apart."""
         return _radix_chain_key(parent, chunk)
 
-    def _lookup(self, tokens: Tuple[int, ...]):
+    @staticmethod
+    def _root_key(ns: int):
+        """Chain root for namespace ``ns`` (many-adapter serving,
+        ISSUE 10): 0 is the unsalted legacy chain — byte-identical
+        keying for base-model traffic — while a non-zero namespace
+        (AdapterRegistry.ns_of, a fresh token per adapter LOAD) starts
+        the chain at a salted key.  An adapter changes wk/wv, so its
+        prefix KV is a different tensor than the base model's for the
+        SAME tokens; namespacing makes cross-adapter hits impossible by
+        construction, including after an evict+reload reuses a slot.
+        Ints only (no str) so the value stays deterministic across
+        processes, like the rest of utils/radixkey.py."""
+        if not ns:
+            return None
+        return _radix_chain_key(0x5A17ED, (int(ns),))
+
+    def _lookup(self, tokens: Tuple[int, ...], ns: int = 0):
         """Walk the cached chain: full-block hits, then at most one
         partial-tail hit (a cached child block whose chunk STARTS with
         the remaining < bs tokens — mappable read-only, CoW'd before
@@ -504,7 +520,7 @@ class PagedCacheManager:
         fresh device block before mapping)."""
         bs = self.bs
         hits: List[_CacheEntry] = []
-        key = None
+        key = self._root_key(ns)
         j = 0
         n = len(tokens)
         while (j + 1) * bs <= n:
@@ -542,7 +558,7 @@ class PagedCacheManager:
     # -- lane lifecycle ----------------------------------------------------
 
     def admit(self, slot: int, prompt,
-              max_suffix: Optional[int] = None
+              max_suffix: Optional[int] = None, ns: int = 0
               ) -> Tuple[int, List[Tuple[int, int]]]:
         """Map blocks for a new lane: radix hits read-only (refcounted),
         copy-on-write for any shared block the suffix/decode writes will
@@ -564,7 +580,7 @@ class PagedCacheManager:
         if self.mapped_count[slot]:
             raise AssertionError(f"slot {slot} still holds blocks")
         if self.prefix_cache:
-            hit_entries, hit_full, _partial = self._lookup(tokens)
+            hit_entries, hit_full, _partial = self._lookup(tokens, ns)
             self.stats["prefix_lookups"] += 1
             self.stats["prefix_lookup_tokens"] += n
             if (max_suffix is not None
@@ -665,7 +681,7 @@ class PagedCacheManager:
                     self._drop_host_entry(key)
         return hit_len, cow
 
-    def publish(self, slot: int, prompt) -> None:
+    def publish(self, slot: int, prompt, ns: int = 0) -> None:
         """Register the lane's FULL prompt blocks in the radix cache
         (called once the admission prefill is dispatched — later
         readers are later dispatches on the same stream, so they
@@ -676,7 +692,7 @@ class PagedCacheManager:
             return
         tokens = tuple(int(t) for t in prompt)
         bs = self.bs
-        key = None
+        key = self._root_key(ns)
         for j in range(len(tokens) // bs):
             chunk = tokens[j * bs:(j + 1) * bs]
             k2 = self._chain_key(key, chunk)
@@ -715,7 +731,7 @@ class PagedCacheManager:
         row[:] = TRASH_BLOCK
         self.mapped_count[slot] = 0
 
-    def scrub_host_chain(self, prompt) -> int:
+    def scrub_host_chain(self, prompt, ns: int = 0) -> int:
         """Quarantine hygiene (ISSUE 8): drop every HOST-tier payload
         on ``prompt``'s radix chain.  Device-side the quarantine scrub
         can prove published blocks clean (the lane only ever writes
@@ -727,7 +743,7 @@ class PagedCacheManager:
         if self.host is None:
             return 0
         tokens = tuple(int(t) for t in prompt)
-        key = None
+        key = self._root_key(ns)
         dropped = 0
         for j in range(len(tokens) // self.bs):
             chunk = tokens[j * self.bs:(j + 1) * self.bs]
@@ -1011,7 +1027,7 @@ def _attend_einsum(cfg: LlamaConfig, q: jax.Array, k_view: jax.Array,
 def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
                        tok: jax.Array, cache: Dict[str, jax.Array],
                        table: jax.Array, mesh=None, quant: bool = False,
-                       active: Optional[jax.Array] = None
+                       active: Optional[jax.Array] = None, lora=None
                        ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """batcher._ring_forward over the paged pool: tok [B] at per-lane
     cache['pos'] -> (logits [B, V], advanced cache).  The pools ride
@@ -1032,6 +1048,7 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     from paddle_operator_tpu.infer.executor import _qkv_ring
 
     pos = cache["pos"]
+    adp, aid = lora if lora is not None else (None, None)
     block_size = cache["k"].shape[3]
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[tok[:, None]]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
@@ -1044,7 +1061,18 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     if quant:
         return _paged_ring_forward_quant(
             cfg, params, x, cache, table, pos, block_size, cos, sin,
-            attn_impl, use_sharded, active, mesh)
+            attn_impl, use_sharded, active, mesh, lora=lora)
+    xs = ((params["layers"], adp, jnp.arange(cfg.n_layers))
+          if adp is not None
+          else (params["layers"], jnp.arange(cfg.n_layers)))
+
+    def _unpack(layer_in):
+        if adp is not None:
+            lp, adp_l, li = layer_in
+            return lp, li, (adp_l, aid)
+        lp, li = layer_in
+        return lp, li, None
+
     if use_sharded:
         from paddle_operator_tpu.ops.decode_attention import (
             sharded_paged_decode_attention,
@@ -1052,8 +1080,8 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc = _write_token_paged(kc, k.transpose(0, 2, 1, 3), li,
                                     table, pos, block_size)
             vc = _write_token_paged(vc, v.transpose(0, 2, 1, 3), li,
@@ -1075,8 +1103,8 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc = _write_token_paged(kc, k.transpose(0, 2, 1, 3), li,
                                     table, pos, block_size)
             vc = _write_token_paged(vc, v.transpose(0, 2, 1, 3), li,
@@ -1089,8 +1117,8 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
     else:
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc = _write_token_paged(kc, k.transpose(0, 2, 1, 3), li,
                                     table, pos, block_size)
             vc = _write_token_paged(vc, v.transpose(0, 2, 1, 3), li,
@@ -1101,8 +1129,7 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
             return (D._finish_layer(cfg, lp, x, out), kc, vc), ()
 
     (x, k_new, v_new), _ = jax.lax.scan(
-        body, (x, cache["k"], cache["v"]),
-        (params["layers"], jnp.arange(cfg.n_layers)))
+        body, (x, cache["k"], cache["v"]), xs)
     x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     logits = D._mm(x, params["lm_head"]["kernel"],
                    cfg.dtype).astype(jnp.float32)
@@ -1111,7 +1138,7 @@ def paged_ring_forward(cfg: LlamaConfig, params: Dict[str, Any],
 
 def _paged_ring_forward_quant(cfg, params, x, cache, table, pos,
                               block_size, cos, sin, attn_impl,
-                              use_sharded, active, mesh):
+                              use_sharded, active, mesh, lora=None):
     """The quantized-pool decode forward (split out of
     :func:`paged_ring_forward` so the bf16 path stays byte-identical):
     same layer math, with the token write going through the staging
@@ -1122,10 +1149,21 @@ def _paged_ring_forward_quant(cfg, params, x, cache, table, pos,
 
     b = x.shape[0]
     hq, d = cfg.n_heads, cfg.head_dim
+    adp, aid = lora if lora is not None else (None, None)
     trash_row = cache["kt"].shape[1] - 1
     lanes = jnp.arange(b)
     rows_idx = (jnp.where(active, lanes, trash_row)
                 if active is not None else lanes)
+    xs = ((params["layers"], adp, jnp.arange(cfg.n_layers))
+          if adp is not None
+          else (params["layers"], jnp.arange(cfg.n_layers)))
+
+    def _unpack(layer_in):
+        if adp is not None:
+            lp, adp_l, li = layer_in
+            return lp, li, (adp_l, aid)
+        lp, li = layer_in
+        return lp, li, None
 
     if use_sharded:
         from paddle_operator_tpu.ops.decode_attention import (
@@ -1134,8 +1172,8 @@ def _paged_ring_forward_quant(cfg, params, x, cache, table, pos,
 
         def body(carry, layer_in):
             x, kc, vc, ks, vs, kt, vt = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc, ks, kt = _write_token_quant(
                 kc, ks, kt, k.transpose(0, 2, 1, 3), li, table, pos,
                 rows_idx, block_size)
@@ -1158,8 +1196,8 @@ def _paged_ring_forward_quant(cfg, params, x, cache, table, pos,
 
         def body(carry, layer_in):
             x, kc, vc, ks, vs, kt, vt = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc, ks, kt = _write_token_quant(
                 kc, ks, kt, k.transpose(0, 2, 1, 3), li, table, pos,
                 rows_idx, block_size)
@@ -1178,8 +1216,8 @@ def _paged_ring_forward_quant(cfg, params, x, cache, table, pos,
 
         def body(carry, layer_in):
             x, kc, vc, ks, vs, kt, vt = carry
-            lp, li = layer_in
-            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos)
+            lp, li, lo = _unpack(layer_in)
+            q, k, v = _qkv_ring(cfg, lp, x, cos, sin, pos, lora=lo)
             kc, ks, kt = _write_token_quant(
                 kc, ks, kt, k.transpose(0, 2, 1, 3), li, table, pos,
                 rows_idx, block_size)
@@ -1194,8 +1232,7 @@ def _paged_ring_forward_quant(cfg, params, x, cache, table, pos,
 
     (x, k_new, v_new, ks_new, vs_new, kt_new, vt_new), _ = jax.lax.scan(
         body, (x, cache["k"], cache["v"], cache["ks"], cache["vs"],
-               cache["kt"], cache["vt"]),
-        (params["layers"], jnp.arange(cfg.n_layers)))
+               cache["kt"], cache["vt"]), xs)
     x = D._rms(x, params["final_norm"]["scale"], cfg.norm_eps, cfg.dtype)
     logits = D._mm(x, params["lm_head"]["kernel"],
                    cfg.dtype).astype(jnp.float32)
@@ -1229,7 +1266,9 @@ def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     trash tail (see paged_ring_forward)."""
     from paddle_operator_tpu.infer.executor import _sample_tokens
 
-    def step(params, cache, table, tok, temp, keys, active):
+    def step(params, cache, table, tok, temp, keys, active, *lora_args):
+        lora = tuple(lora_args) if lora_args else None
+
         def tick(carry, _):
             if check_finite:
                 cache, tok, ok = carry
@@ -1237,7 +1276,7 @@ def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
                 cache, tok = carry
             logits, new_cache = paged_ring_forward(
                 cfg, params, tok, cache, table, mesh=mesh, quant=quant,
-                active=active if quant else None)
+                active=active if quant else None, lora=lora)
             nxt = _sample_tokens(logits, temp, keys, cache["pos"],
                                  top_k, top_p)
             new_cache["pos"] = jnp.where(active, new_cache["pos"], 0)
@@ -1300,12 +1339,13 @@ def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
                          f"block size {block_size}")
 
     def insert(params, cache, table_row, tok, temp, keys, prompt,
-               prompt_len, slot, temp_val, seed):
+               prompt_len, slot, temp_val, seed, *lora_args):
+        lora = tuple(lora_args) if lora_args else None
         if quant:
             logits, new_cache, tail_k, tail_v = D.paged_prefill(
                 params, cfg, prompt, cache, table_row,
                 block_size=block_size, mesh=mesh, quant=True,
-                prompt_len=prompt_len)
+                prompt_len=prompt_len, lora=lora)
             new_cache["kt"] = jax.lax.dynamic_update_slice(
                 new_cache["kt"], tail_k, (0, slot, 0, 0, 0))
             new_cache["vt"] = jax.lax.dynamic_update_slice(
@@ -1314,7 +1354,7 @@ def make_paged_prefill_insert(cfg: LlamaConfig, bucket: int,
             logits, new_cache = D.paged_prefill(params, cfg, prompt,
                                                 cache, table_row,
                                                 block_size=block_size,
-                                                mesh=mesh)
+                                                mesh=mesh, lora=lora)
         logits = logits[0, prompt_len - 1]
         new_cache["pos"] = new_cache["pos"].at[slot].set(prompt_len)
         key = jax.random.PRNGKey(seed)
@@ -1383,7 +1423,7 @@ def make_paged_suffix_insert(cfg: LlamaConfig, suffix_bucket: int,
     from paddle_operator_tpu.infer.speculative import _multi_forward_paged
 
     def insert(params, cache, table_row, tok, temp, keys, suffix,
-               suffix_len, hit_len, slot, temp_val, seed):
+               suffix_len, hit_len, slot, temp_val, seed, *lora_args):
         prompt_len = hit_len + suffix_len
         lane_cache = {"k": cache["k"], "v": cache["v"],
                       "pos": jnp.reshape(hit_len, (1,))}
@@ -1393,7 +1433,8 @@ def make_paged_suffix_insert(cfg: LlamaConfig, suffix_bucket: int,
                 cache, slot)
         logits, new_lane = _multi_forward_paged(
             cfg, params, suffix, lane_cache, table_row[None, :],
-            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh, quant=quant)
+            limit=jnp.reshape(prompt_len, (1,)), mesh=mesh, quant=quant,
+            lora=tuple(lora_args) if lora_args else None)
         logits = logits[0, suffix_len - 1]
         new_cache = {"k": new_lane["k"], "v": new_lane["v"],
                      "pos": cache["pos"].at[slot].set(prompt_len)}
@@ -1499,15 +1540,17 @@ def make_paged_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
     """
     from paddle_operator_tpu.infer.speculative import _multi_forward_paged
 
-    def chunk(params, cache, table_row, toks, start, limit):
+    def chunk(params, cache, table_row, toks, start, limit, *lora_args):
         lane_cache = {"k": cache["k"], "v": cache["v"],
                       "pos": jnp.reshape(start, (1,)).astype(jnp.int32)}
         _, new = _multi_forward_paged(
             cfg, params, toks, lane_cache, table_row[None, :],
-            limit=jnp.reshape(limit, (1,)), mesh=mesh, head=False)
+            limit=jnp.reshape(limit, (1,)), mesh=mesh, head=False,
+            lora=tuple(lora_args) if lora_args else None)
         return {"k": new["k"], "v": new["v"], "pos": cache["pos"]}
 
-    def chunk_quant(params, cache, table_row, toks, start, limit, slot):
+    def chunk_quant(params, cache, table_row, toks, start, limit, slot,
+                    *lora_args):
         mk, mv = _slice_lane_tails(cache, slot)
         lane_cache = {"k": cache["k"], "v": cache["v"],
                       "ks": cache["ks"], "vs": cache["vs"],
@@ -1516,7 +1559,8 @@ def make_paged_prefill_chunk(cfg: LlamaConfig, slice_bucket: int,
         _, new = _multi_forward_paged(
             cfg, params, toks, lane_cache, table_row[None, :],
             limit=jnp.reshape(limit, (1,)), mesh=mesh, head=False,
-            quant=True)
+            quant=True,
+            lora=tuple(lora_args) if lora_args else None)
         kt, vt = _restore_lane_tails(cache, new, slot)
         return {"k": new["k"], "v": new["v"], "ks": new["ks"],
                 "vs": new["vs"], "kt": kt, "vt": vt,

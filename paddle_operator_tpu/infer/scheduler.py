@@ -40,6 +40,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from paddle_operator_tpu.infer import executor as X
+from paddle_operator_tpu.infer import qos as QOS
 from paddle_operator_tpu.infer.resilience import (
     DispatchWatchdog,
     LaneQuarantined,
@@ -77,7 +78,8 @@ class _Request:
     __slots__ = ("prompt", "max_new", "temperature", "seed", "eos",
                  "done", "out", "error", "_stream", "_cancel",
                  "dev_prompt", "bucket", "accepted", "drafted",
-                 "deadline", "deadline_exceeded")
+                 "deadline", "deadline_exceeded",
+                 "priority", "adapter", "adapter_idx", "ns", "preempts")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
                  wants_stream=False, deadline=None):
@@ -101,6 +103,15 @@ class _Request:
         # rate per response
         self.accepted = 0
         self.drafted = 0
+        # multi-tenant QoS (ISSUE 10, infer/qos.py): admission class
+        # (0 most urgent), the request's adapter (name, registry slot,
+        # and radix-cache namespace) and how many times it has been
+        # preemption-spilled (the per-request anti-thrash cap)
+        self.priority = 0
+        self.adapter: Optional[str] = None
+        self.adapter_idx = 0
+        self.ns = 0
+        self.preempts = 0
         # padded prompt, transferred to device on the SUBMIT thread
         # (batcher.submit): on relayed chips a host->device copy costs a
         # full round-trip, and paying it on the decode-ring thread
@@ -173,6 +184,23 @@ class _PrefillState:
         self.lane_v = lane_v
 
 
+class _ParkedLane:
+    """Host bookkeeping for one PREEMPTED lane (ISSUE 10): the
+    byte-exact device spill (RingExecutor.spill_lane) plus the host
+    mirrors a restore re-attaches — the request itself stays
+    unresolved, invisible to the client except as latency."""
+
+    __slots__ = ("req", "spill", "out", "left", "pos", "seq")
+
+    def __init__(self, req, spill, out, left, pos, seq):
+        self.req = req
+        self.spill = spill
+        self.out = out          # tokens emitted before the spill
+        self.left = left        # remaining token budget
+        self.pos = pos          # fill position at the spill boundary
+        self.seq = seq          # park order — FIFO within a class
+
+
 class ContinuousBatcher:
     """Slot scheduler over the resident chunk step.
 
@@ -222,7 +250,9 @@ class ContinuousBatcher:
                  prewarm: bool = False,
                  kv_quant: str = "none",
                  host_cache_blocks: int = 0,
-                 resilience: Optional[RingResilience] = None) -> None:
+                 resilience: Optional[RingResilience] = None,
+                 qos: Optional[QOS.QoSConfig] = None,
+                 adapters: Optional[QOS.AdapterRegistry] = None) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
                              f"{PREFILL_MODES}")
@@ -268,6 +298,14 @@ class ContinuousBatcher:
         # extra hidden round-trip saves (measured).
         self.pipeline_depth = max(1, pipeline_depth)
 
+        # multi-tenant QoS (ISSUE 10, infer/qos.py): priority classes,
+        # preemption knobs, and the optional adapter registry — the
+        # defaults (2 classes, everything defaulting to the least
+        # urgent one, no adapters) keep single-tenant behavior
+        # byte-identical to the pre-QoS ring
+        self.qos = qos if qos is not None else QOS.QoSConfig()
+        self.adapters = adapters
+
         # the device half: compiled programs + cache/pool/lane state
         self.executor = X.RingExecutor(
             params, cfg, slots=slots, max_len=self.max_len,
@@ -278,7 +316,7 @@ class ContinuousBatcher:
             num_blocks=num_blocks, prefix_cache=prefix_cache,
             prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
             check_finite=self._check_finite, kv_quant=kv_quant,
-            host_cache_blocks=host_cache_blocks)
+            host_cache_blocks=host_cache_blocks, adapters=adapters)
         self.mesh = mesh
         self.paged = self.executor.paged
         self.kv_quant = self.executor.kv_quant
@@ -306,15 +344,28 @@ class ContinuousBatcher:
 
         # bounded admission queue (max_queue > 0): submit() blocks up to
         # queue_timeout for a slot, then REJECTS (QueueFull) — saturation
-        # degrades into backpressure instead of unbounded request RAM
+        # degrades into backpressure instead of unbounded request RAM.
+        # The bound is PER CLASS (infer/qos.py MultiClassQueue): a
+        # lower-priority flood sheds its own overflow without eating the
+        # express class's admission budget.
         self.max_queue = int(max_queue)
         self._queue_timeout = queue_timeout
-        self._pending: "queue.Queue[_Request]" = queue.Queue(
-            maxsize=self.max_queue)
+        self._pending = QOS.MultiClassQueue(
+            self.qos.priorities, maxsize=self.max_queue)
+        # preemption-spilled lanes awaiting re-admission (ISSUE 10) +
+        # the rolling anti-thrash budget bounding how often residents
+        # may be spilled at all
+        self._parked: List[_ParkedLane] = []
+        self._preempt_budget = QOS.PreemptionBudget(
+            self.qos.preempt_budget, self.qos.preempt_window_s)
         self._wake = threading.Event()
         self._stop = threading.Event()
         self.stats = {"admitted": 0, "evicted": 0, "chunks": 0,
                       "max_active": 0, "rejected_queue_full": 0,
+                      # QoS accounting (ISSUE 10): lanes spilled for
+                      # more urgent work and spilled lanes resumed —
+                      # the tpujob_serve_lane_preemptions_total gauge
+                      "preempted_lanes": 0, "restored_lanes": 0,
                       "spec_accepted": 0, "spec_drafted": 0,
                       # prefill accounting: the prefix-cache acceptance
                       # gate — a full prefix hit admits with ZERO
@@ -458,7 +509,9 @@ class ContinuousBatcher:
                eos_token: Optional[int] = None,
                stream: bool = False,
                request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> _Request:
+               deadline_s: Optional[float] = None,
+               priority: Optional[int] = None,
+               adapter: Optional[str] = None) -> _Request:
         """Queue one generation request; returns a handle whose
         ``result()``/``stream()`` deliver the tokens.
 
@@ -494,6 +547,26 @@ class ContinuousBatcher:
             raise ValueError(f"max_new_tokens must be >= 1{rid}")
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0{rid}")
+        # QoS class (ISSUE 10): 0 most urgent; unannotated requests get
+        # the least urgent class (priorities are opt-in boosts)
+        prio = (self.qos.default_priority if priority is None
+                else int(priority))
+        if not 0 <= prio < self.qos.priorities:
+            raise ValueError(
+                f"priority {prio} outside [0, {self.qos.priorities}) — "
+                f"this ring serves {self.qos.priorities} class(es){rid}")
+        adapter_idx = adapter_ns = 0
+        if adapter is not None:
+            if self.spec_k:
+                raise ValueError(
+                    f"adapters are not supported on speculative rings "
+                    f"(the draft proposes base-only){rid}")
+            if self.adapters is None:
+                raise ValueError(
+                    f"no adapter registry on this ring (SERVE_ADAPTERS "
+                    f"unset) for adapter {adapter!r}{rid}")
+            adapter_idx, adapter_ns = \
+                self.adapters.resolve_ns(adapter)      # ValueError
         if self._draining:
             raise ShuttingDown("server draining; retry another replica")
         if self._stop.is_set() or not self._thread.is_alive():
@@ -531,27 +604,34 @@ class ContinuousBatcher:
         seed = int(seed)
         if not 0 <= seed < 0x80000000:
             seed = _fold_seed(seed)
-        if self.max_queue and self._pending.full():
+        if self.max_queue and self._pending.full(prio):
             # shed BEFORE the host->device prompt transfer below: the
             # rejection path is the overload path, and a full round-trip
             # device copy per shed request (relayed chips) would spend
             # exactly the bandwidth backpressure exists to protect.
             # Non-authoritative (racy) — the timed put below enforces
             # the bound; this only waits for space to appear first.
+            # Per-CLASS bound: a flooded batch class sheds its own
+            # overflow here while the other classes stay admittable.
             deadline = time.monotonic() + self._queue_timeout
-            while self._pending.full():
+            while self._pending.full(prio):
                 if self._stop.is_set() or self._draining:
                     raise ShuttingDown("batcher shutting down")
                 if time.monotonic() >= deadline:
                     self.stats["rejected_queue_full"] += 1
                     raise QueueFull(
                         f"request queue full (max_queue={self.max_queue},"
+                        f" priority {prio},"
                         f" waited {self._queue_timeout}s)")
                 time.sleep(0.005)
         req = _Request(prompt, max_new_tokens, temperature, seed,
                        eos_token, wants_stream=stream,
                        deadline=(time.monotonic() + deadline_s
                                  if deadline_s is not None else None))
+        req.priority = prio
+        req.adapter = adapter
+        req.adapter_idx = adapter_idx
+        req.ns = adapter_ns if adapter_idx else 0
         # pad + ship the prompt to the device HERE, on the caller's
         # thread — see _Request.dev_prompt
         req.bucket = self._bucket_for(len(prompt))
@@ -568,13 +648,14 @@ class ContinuousBatcher:
             if self._stop.is_set() or self._draining:
                 raise ShuttingDown("batcher shutting down")
             try:
-                self._pending.put(req, timeout=0.05)
+                self._pending.put(req, prio, timeout=0.05)
                 break
             except queue.Full:
                 if time.monotonic() >= deadline:
                     self.stats["rejected_queue_full"] += 1
                     raise QueueFull(
                         f"request queue full (max_queue={self.max_queue},"
+                        f" priority {prio},"
                         f" waited {self._queue_timeout}s)") from None
         if self._stop.is_set() and not req.done.is_set():
             # loop died between the liveness check above and the put:
@@ -643,6 +724,19 @@ class ContinuousBatcher:
             "chunkedPrefillTokenShare": (
                 round(self.stats["chunked_prefill_tokens"] / pf_tok, 4)
                 if pf_tok else 0.0),
+            # multi-tenant QoS (ISSUE 10): per-class queue depth (index
+            # = class, 0 most urgent), cumulative preemption spills,
+            # lanes currently parked awaiting re-admission, and the
+            # adapter registry's live set (names feed the router's
+            # adapter-affinity scrape; the count is the
+            # tpujob_serve_active_adapters gauge)
+            "priorityQueueDepth": self._pending.qsize_by_class(),
+            "preemptedLanes": self.stats["preempted_lanes"],
+            "parkedLanes": len(self._parked),
+            "activeAdapters": (len(self.adapters)
+                               if self.adapters is not None else 0),
+            "adapterNames": (self.adapters.names()
+                             if self.adapters is not None else []),
             # fault tolerance (infer/resilience.py): drain/rebuild
             # visibility for /readyz and the CRD's status.serving block
             "draining": self._draining,
@@ -673,14 +767,17 @@ class ContinuousBatcher:
         self._wake.set()
         deadline = time.monotonic() + budget_s
         while time.monotonic() < deadline and self._thread.is_alive():
-            if all(r is None for r in self.lane) and self._pending.empty():
+            if all(r is None for r in self.lane) \
+                    and self._pending.empty() and not self._parked:
                 break
             time.sleep(0.02)
         for req in list(self.lane):
             if req is not None:
                 req.cancel()            # partial flush at chunk boundary
+        for pk in list(self._parked):
+            pk.req.cancel()             # parked partials flush too
         grace = time.monotonic() + max(5.0, budget_s)
-        while (any(r is not None for r in self.lane)
+        while ((any(r is not None for r in self.lane) or self._parked)
                and self._thread.is_alive()
                and time.monotonic() < grace):
             time.sleep(0.02)
@@ -698,6 +795,11 @@ class ContinuousBatcher:
             if req is not None and not req.done.is_set():
                 req.out = req.prompt + self._lane_out[i]
                 self._finish(req)
+        for pk in self._parked:         # parked partials resolve too
+            if not pk.req.done.is_set():
+                pk.req.out = pk.req.prompt + pk.out
+                self._finish(pk.req)
+        self._parked.clear()
         self._shed_queue(error or ShuttingDown("server killed"))
 
     def close(self) -> None:
@@ -768,6 +870,13 @@ class ContinuousBatcher:
         for req in list(self.lane):
             if req is not None and not req.done.is_set():
                 self._finish(req, wrapped)
+        # parked lanes fail with the residents: their spills reference
+        # nothing device-side (host bytes), but their CLIENTS deserve
+        # the same retriable signal the rebuild sends everyone else
+        for pk in self._parked:
+            if not pk.req.done.is_set():
+                self._finish(pk.req, wrapped)
+        self._parked.clear()
         self.lane = [None] * self.slots
         self._lane_out = [[] for _ in range(self.slots)]
         self._lane_left = [0] * self.slots
@@ -791,6 +900,18 @@ class ContinuousBatcher:
                 req.deadline_exceeded = True
                 self.stats["deadline_exceeded"] += 1
                 self._evict(i)        # resolves with the partial tokens
+        # parked lanes keep their deadline semantics: an expired one
+        # resolves with the tokens it had at the spill boundary (the
+        # same 504-style partial a resident gets)
+        for pk in list(self._parked):
+            req = pk.req
+            if (req.deadline is not None and now >= req.deadline
+                    and not req.done.is_set()):
+                req.deadline_exceeded = True
+                self.stats["deadline_exceeded"] += 1
+                req.out = req.prompt + pk.out
+                self._finish(req)
+                self._parked.remove(pk)
 
     # -- admission ---------------------------------------------------------
 
@@ -871,7 +992,28 @@ class ContinuousBatcher:
         hits stay inline — the suffix insert is already cheap)."""
         ex = self.executor
         n = len(req.prompt)
+        # reserve the lane FIRST: the admin surface's in-use snapshot
+        # (serve.py lanes_in_use) reads lane/parked/queue from another
+        # thread, and a request popped from the queue but not yet
+        # lane-visible would otherwise slip through an evict guard
         self.lane[slot] = req
+        if req.adapter_idx and self.adapters is not None:
+            # re-validate at admission: the adapter could have been
+            # evicted (and its slot even reloaded with ANOTHER tenant's
+            # deltas) while this request sat queued — the load
+            # generation captured at submit is the identity check (the
+            # admission exception path releases the lane)
+            try:
+                live_ns = self.adapters.ns_of(req.adapter_idx)
+            except KeyError:
+                live_ns = -1
+            if live_ns != req.ns:
+                raise ValueError(
+                    f"adapter {req.adapter!r} was evicted/replaced "
+                    "while this request was queued; resubmit")
+        # the lane's adapter id (host mirror): every adapter-aware
+        # dispatch from here on gathers this lane's LoRA pair
+        ex.aid[slot] = req.adapter_idx
         # reset the lane's host mirrors NOW, not at activation: a
         # chunked/disagg lane evicted MID-PREFILL (cancel, deadline,
         # drain) resolves through ``req.prompt + _lane_out[slot]``, and
@@ -901,7 +1043,8 @@ class ContinuousBatcher:
                 ex.inserts[req.bucket](
                     ex.params, ex.cache, ex.tok, ex.temp,
                     ex.keys, req.dev_prompt, n, slot,
-                    float(req.temperature), req.seed)
+                    float(req.temperature), req.seed,
+                    *ex.lora_insert_tail(req.adapter_idx))
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += n
         # counted only once the insert dispatched: a NoFreeBlocks /
@@ -929,7 +1072,8 @@ class ContinuousBatcher:
         # allocator then maps fresh blocks instead of the cached ones
         # (never written over) when spec mode is off
         hit_len, cow = self.pool.admit(          # NoFreeBlocks -> req fails
-            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
+            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS,
+            ns=req.ns)
         self._dispatch_cow(slot, cow, hit_len)
         tbl_row = jnp.asarray(self.pool.table[slot])
         if self.spec_k:
@@ -947,12 +1091,15 @@ class ContinuousBatcher:
                 ex.inserts[req.bucket](
                     ex.params, ex.cache, tbl_row, ex.tok,
                     ex.temp, ex.keys, req.dev_prompt, n, slot,
-                    float(req.temperature), req.seed)
+                    float(req.temperature), req.seed,
+                    *ex.lora_insert_tail(req.adapter_idx))
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += n
         # register this lane's full prompt blocks for future admissions
-        # (content is valid for any later dispatch — same device stream)
-        self.pool.publish(slot, req.prompt)
+        # (content is valid for any later dispatch — same device stream;
+        # adapter lanes publish under their namespace, so reuse happens
+        # within a tenant's fine-tune and never across)
+        self.pool.publish(slot, req.prompt, ns=req.ns)
         return first
 
     def _suffix_admit(self, slot: int, req: _Request, tbl_row, hit_len):
@@ -968,7 +1115,8 @@ class ContinuousBatcher:
         ex.cache, ex.tok, ex.temp, ex.keys, first = ins(
             ex.params, ex.cache, tbl_row, ex.tok, ex.temp,
             ex.keys, jnp.asarray(padded), len(suffix), hit_len,
-            slot, float(req.temperature), req.seed)
+            slot, float(req.temperature), req.seed,
+            *ex.lora_insert_tail(req.adapter_idx))
         self.stats["prefill_calls"] += 1
         self.stats["prefill_tokens"] += len(suffix)
         return first
@@ -982,7 +1130,8 @@ class ContinuousBatcher:
         hit_len = 0
         if self.paged:
             hit_len, cow = self.pool.admit(
-                slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
+                slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS,
+                ns=req.ns)
             self._dispatch_cow(slot, cow, hit_len)
             lane_k = lane_v = None
         else:
@@ -1012,12 +1161,13 @@ class ContinuousBatcher:
                         st.start, st.start + sb)
                 if ex.quant:    # quant slices address the lane's tail
                     args += (slot,)
-                ex.cache = ex.chunk_prog(None)(*args)
+                ex.cache = ex.chunk_prog(None)(
+                    *args, *ex.lora_insert_tail(req.adapter_idx))
             else:
                 sl = ex.staging_len(req.bucket)
                 st.lane_k, st.lane_v = ex.chunk_prog(sl)(
                     ex.params, st.lane_k, st.lane_v, jnp.asarray(toks),
-                    st.start)
+                    st.start, *ex.lora_insert_tail(req.adapter_idx))
             st.start += sb
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += sb
@@ -1032,7 +1182,8 @@ class ContinuousBatcher:
             ex.cache, ex.tok, ex.temp, ex.keys, first = ins(
                 ex.params, ex.cache, jnp.asarray(self.pool.table[slot]),
                 ex.tok, ex.temp, ex.keys, toks, remaining, st.start,
-                slot, float(req.temperature), req.seed)
+                slot, float(req.temperature), req.seed,
+                *ex.lora_insert_tail(req.adapter_idx))
         elif self.paged:
             ins = ex.final_insert(None, req.bucket)
             (ex.cache, ex.dcache, ex.tok, ex.temp, ex.keys, first) = ins(
@@ -1054,13 +1205,14 @@ class ContinuousBatcher:
             ex.cache, ex.tok, ex.temp, ex.keys, first = ins(
                 ex.params, ex.cache, st.lane_k, st.lane_v, ex.tok,
                 ex.temp, ex.keys, toks, remaining, st.start, n, slot,
-                float(req.temperature), req.seed)
+                float(req.temperature), req.seed,
+                *ex.lora_insert_tail(req.adapter_idx))
         self.stats["prefill_calls"] += 1
         self.stats["prefill_tokens"] += remaining
         self.stats["chunked_prefill_tokens"] += remaining
         del self._prefilling[slot]
         if self.paged:
-            self.pool.publish(slot, req.prompt)
+            self.pool.publish(slot, req.prompt, ns=req.ns)
         self._activate(slot, req, first)
 
     def _admit_disagg(self, slot: int, req: _Request) -> None:
@@ -1071,12 +1223,13 @@ class ContinuousBatcher:
         fail on NoFreeBlocks) and ships the prefill to the executor
         thread; the loop attaches the lane when the result lands."""
         hit_len, cow = self.pool.admit(
-            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS)
+            slot, req.prompt, max_suffix=self.SUFFIX_PREFILL_MAX_ROWS,
+            ns=req.ns)
         if hit_len and not self.spec_k:
             self._dispatch_cow(slot, cow, hit_len)
             first = self._suffix_admit(
                 slot, req, jnp.asarray(self.pool.table[slot]), hit_len)
-            self.pool.publish(slot, req.prompt)
+            self.pool.publish(slot, req.prompt, ns=req.ns)
             self._activate(slot, req, first)
             return
         # cold: fresh blocks are already mapped by admit (hit_len == 0
@@ -1155,7 +1308,7 @@ class ContinuousBatcher:
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += n
             self.stats["disagg_prefills"] += 1
-            self.pool.publish(slot, req.prompt)
+            self.pool.publish(slot, req.prompt, ns=req.ns)
             self._activate(slot, req, first)
 
     # -- consume / evict ---------------------------------------------------
@@ -1200,6 +1353,7 @@ class ContinuousBatcher:
         req = self.lane[slot]
         self.lane[slot] = None
         self._lane_pos[slot] = 0        # retired lanes report no pos
+        self.executor.aid[slot] = 0     # adapter hygiene (host mirror)
         # a lane evicted MID-PREFILL (cancel, deadline, drain) drops its
         # slice/handoff state; a late disagg result is dropped by the
         # identity check in _drain_handoffs
@@ -1222,6 +1376,109 @@ class ContinuousBatcher:
             # from another thread): just release the lane state
             self._lane_first[slot] = None
 
+    # -- preemptive lane spill (ISSUE 10) ----------------------------------
+
+    def _best_parked(self) -> Optional[_ParkedLane]:
+        """The parked lane that should resume next: most urgent class
+        first, then park order (FIFO within a class)."""
+        if not self._parked:
+            return None
+        return min(self._parked, key=lambda p: (p.req.priority, p.seq))
+
+    def _waiting_class(self) -> Optional[int]:
+        """Most urgent class with WAITING work (queued head or parked
+        head) — the demand side of the preemption decision."""
+        cq = self._pending.peek_class()
+        pk = self._best_parked()
+        cp = pk.req.priority if pk is not None else None
+        if cq is None:
+            return cp
+        return cq if cp is None else min(cq, cp)
+
+    def _preempt_victim(self) -> Optional[int]:
+        """Pick the lane to spill for waiting more-urgent work, or None
+        when preemption should not fire: needs the paged pool (the
+        spill rides it), a fully busy ring, a STRICTLY less urgent
+        resident than the waiting head, anti-thrash budget headroom,
+        and a victim not already bounced past its per-request cap.
+        Lanes still mid-prefill are never victims (their spill state
+        is not yet well-defined — they finish their prefill first)."""
+        if (self.pool is None or not self.qos.preempt or self._draining
+                or any(r is None for r in self.lane)):
+            return None
+        demand = self._waiting_class()
+        if demand is None or not self._preempt_budget.ok():
+            return None
+        prefill_pending = self._pending_prefill_slots()
+        best, best_key = None, None
+        for i, r in enumerate(self.lane):
+            if (r is None or i in prefill_pending or r.done.is_set()
+                    or r.priority <= demand
+                    or r.preempts >= self.qos.max_preempts_per_request):
+                continue
+            # least urgent first; among equals the SHORTEST lane spills
+            # (smallest byte capture, least to re-upload)
+            key = (r.priority, -self._lane_pos[i])
+            if best_key is None or key > best_key:
+                best, best_key = i, key
+        return best
+
+    def _preempt(self, slot: int) -> None:
+        """Spill resident lane ``slot`` to host and free its lane and
+        blocks for more urgent work.  The caller has QUIESCED the
+        dispatch pipeline, so device state and host mirrors agree at a
+        chunk boundary — the spill captures exactly the consumed
+        stream, and the later restore resumes bit-identically
+        (tests/test_qos.py pins it against unpreempted oracles).  The
+        request stays UNRESOLVED: its client sees added latency, never
+        an error or a truncated stream."""
+        req = self.lane[slot]
+        self._materialize_first(slot, req)
+        if self._lane_left[slot] <= 0 or req.done.is_set():
+            self._evict(slot)       # finished at the boundary anyway
+            return
+        spill = self.executor.spill_lane(slot)
+        self._admit_seq += 1
+        self._parked.append(_ParkedLane(
+            req, spill, self._lane_out[slot], self._lane_left[slot],
+            self._lane_pos[slot], self._admit_seq))
+        self.lane[slot] = None
+        self._lane_out[slot] = []
+        self._lane_pos[slot] = 0
+        self._lane_first[slot] = None
+        self.executor.aid[slot] = 0
+        self.pool.retire(slot)      # blocks free for the preemptor
+        req.preempts += 1
+        self._preempt_budget.spend()
+        self.stats["preempted_lanes"] += 1
+
+    def _try_restore(self, pk: _ParkedLane) -> bool:
+        """Re-admit parked lane ``pk`` into a free slot: re-map fresh
+        blocks, upload the spilled bytes, re-attach the host mirrors.
+        Returns False (lane stays parked) when the pool cannot hold its
+        blocks right now — the next loop pass retries as blocks free."""
+        req = pk.req
+        if req._cancel or req.done.is_set():
+            self._parked.remove(pk)
+            if not req.done.is_set():
+                req.out = req.prompt + pk.out
+                self._finish(req)
+            return True
+        slot = self.lane.index(None)
+        try:
+            self.executor.restore_lane(slot, pk.spill)
+        except self.executor._pg.NoFreeBlocks:
+            self.pool.retire(slot)  # roll back ensure's partial mapping
+            return False
+        self._parked.remove(pk)
+        self.lane[slot] = req
+        self._lane_out[slot] = pk.out
+        self._lane_left[slot] = pk.left
+        self._lane_pos[slot] = pk.pos
+        self._lane_first[slot] = None
+        self.stats["restored_lanes"] += 1
+        return True
+
     def _loop(self) -> None:
         try:
             self._loop_body()
@@ -1235,11 +1492,14 @@ class ContinuousBatcher:
                 if req is not None:
                     self._finish(req, e)
             self.lane = [None] * self.slots
-        # drain: fail whatever is still queued or resident
+        # drain: fail whatever is still queued, resident or parked
         for i, req in enumerate(self.lane):
             if req is not None:
                 self._finish(req, ShuttingDown("batcher closed"))
                 self.lane[i] = None
+        for pk in self._parked:
+            self._finish(pk.req, ShuttingDown("batcher closed"))
+        self._parked.clear()
         self._shed_queue(ShuttingDown("batcher closed"))
 
     def _scrub_lane_blocks(self, slot: int, req=None) -> None:
@@ -1283,7 +1543,7 @@ class ContinuousBatcher:
             # host tier (ISSUE 8): demoted payloads on the quarantined
             # lane's prompt chain are opaque host bytes that cannot be
             # re-verified — drop them so the prefix re-prefills clean
-            self.pool.scrub_host_chain(req.prompt)
+            self.pool.scrub_host_chain(req.prompt, ns=req.ns)
 
     def _consume(self, chunk_reqs, toks, counts=None, ok=None) -> None:
         """Apply one finished chunk's tokens ([chunk, slots] on host).
@@ -1402,6 +1662,14 @@ class ContinuousBatcher:
             for i, r in enumerate(self.lane):
                 if r is not None and r._cancel:
                     self._evict(i)
+            # parked lanes honor cancel too — a disconnect-abandoned
+            # preempted request must not wait for a free lane to die
+            for pk in list(self._parked):
+                if pk.req._cancel or pk.req.done.is_set():
+                    self._parked.remove(pk)
+                    if not pk.req.done.is_set():
+                        pk.req.out = pk.req.prompt + pk.out
+                        self._finish(pk.req)
             # disaggregated prefills that completed since last pass:
             # block-copy handoff + lane attach (cheap dispatches).
             # Gated on the ENGINE, not on _disagg_waiting: a result
@@ -1414,8 +1682,23 @@ class ContinuousBatcher:
                 except Exception as e:
                     self._fault = e
                     continue
-            # admit into free lanes
-            while not self._draining and any(r is None for r in self.lane):
+            # admit into free lanes: parked (preempted) lanes resume
+            # ahead of queued work of the same class — they were
+            # admitted first and already hold tokens — and queued work
+            # pops in class-then-FIFO order (infer/qos.py).  Restores
+            # run even while DRAINING: a parked lane is admitted work
+            # the drain budget promises to finish.
+            while any(r is None for r in self.lane):
+                pk = self._best_parked()
+                cq = (None if self._draining
+                      else self._pending.peek_class())
+                if pk is not None and (cq is None
+                                       or pk.req.priority <= cq):
+                    if not self._try_restore(pk):
+                        break       # free blocks tight: retry next pass
+                    continue
+                if cq is None:
+                    break
                 try:
                     req = self._pending.get_nowait()
                 except queue.Empty:
@@ -1447,6 +1730,25 @@ class ContinuousBatcher:
                         # dispatch failed — unmap them (no-op when the
                         # allocator itself rejected)
                         self.pool.retire(slot)
+            # preemptive lane spill (ISSUE 10): more urgent work is
+            # waiting and every lane is busy — quiesce the dispatch
+            # pipeline (THE chunk boundary: device state and host
+            # mirrors agree), re-pick the victim (a consumed chunk may
+            # have evicted it, or freed a lane outright), spill it, and
+            # re-run admission with the freed lane/blocks
+            if self._preempt_victim() is not None:
+                while pending:
+                    try:
+                        self._consume_oldest(pending)
+                    except Exception as e:
+                        self._fault = e
+                        break
+                if self._fault is None:
+                    victim = self._preempt_victim()
+                    if victim is not None:
+                        self._preempt(victim)
+                continue
+
             # chunked prefill: advance exactly ONE slice per iteration
             # (oldest admission first) — the interleave that bounds how
             # long resident decode lanes ever wait
@@ -1554,7 +1856,7 @@ class ContinuousBatcher:
                 elif self.paged:
                     out = ex.step(
                         ex.params, ex.cache, tbl, ex.tok,
-                        ex.temp, ex.keys, active)
+                        ex.temp, ex.keys, active, *ex.lora_step_tail())
                     counts_dev = None
                     if self._check_finite:
                         ex.cache, ex.tok, toks_dev, ok_dev = out
@@ -1563,7 +1865,7 @@ class ContinuousBatcher:
                 else:
                     out = ex.step(
                         ex.params, ex.cache, ex.tok, ex.temp,
-                        ex.keys, active)
+                        ex.keys, active, *ex.lora_step_tail())
                     counts_dev = None
                     if self._check_finite:
                         ex.cache, ex.tok, toks_dev, ok_dev = out

@@ -126,7 +126,9 @@ class ContinuousGenerator:
                       top_p: Optional[float] = None,
                       eos_token: Optional[int] = None, seed: int = 0,
                       request_id: Optional[str] = None,
-                      deadline_s: Optional[float] = None):
+                      deadline_s: Optional[float] = None,
+                      priority: Optional[int] = None,
+                      adapter: Optional[str] = None):
         """Rows + per-row speculative accept rates (None entries when
         the ring is not speculative) + per-row deadline-exceeded flags
         (a flagged row carries the PARTIAL tokens produced before its
@@ -147,6 +149,7 @@ class ContinuousGenerator:
                     row, max_new_tokens=max_new_tokens,
                     temperature=temperature, seed=seed + i,
                     eos_token=eos_token, deadline_s=deadline_s,
+                    priority=priority, adapter=adapter,
                     request_id=(f"{request_id}/row{i}"
                                 if request_id is not None else None)))
             # ragged rows: sequences stop at eos, no rectangular array
@@ -222,6 +225,17 @@ class _Handler(BaseHTTPRequestHandler):
                     "reason": ("draining" if draining else "ring"),
                 }, headers={"Retry-After":
                             self.state.retry_after_s if self.state else 5})
+        elif self.path == "/v1/adapters":
+            # adapter registry surface (ISSUE 10): the loaded set, the
+            # pool's capacity/rank contract, and which are serving
+            b = self._batcher()
+            reg = getattr(b, "adapters", None) if b is not None else None
+            if reg is None:
+                self._send(200, {"adapters": [], "capacity": 0})
+            else:
+                self._send(200, {"adapters": reg.names(),
+                                 "capacity": reg.capacity,
+                                 "rank": reg.rank})
         elif self.path == "/statusz":
             # the serving_status block as JSON — what a fleet replica
             # publishes toward status.serving, self-served for
@@ -276,12 +290,15 @@ class _Handler(BaseHTTPRequestHandler):
         tokens = np.asarray(req["tokens"], np.int32)
         if tokens.ndim != 2 or tokens.shape[0] != 1:
             raise ValueError("streaming takes tokens [1, seq]")
+        prio = req.get("priority")
         handle = gen.batcher.submit(
             tokens[0], max_new_tokens=int(req.get("max_new_tokens", 32)),
             temperature=float(req.get("temperature", 0.0)),
             seed=int(req.get("seed", 0)), eos_token=req.get("eos_token"),
             stream=True, request_id=req.get("request_id"),
-            deadline_s=req.get("deadline_s"))
+            deadline_s=req.get("deadline_s"),
+            priority=int(prio) if prio is not None else None,
+            adapter=req.get("adapter"))
 
         def emit(obj) -> None:
             body = json.dumps(obj).encode() + b"\n"
@@ -323,6 +340,61 @@ class _Handler(BaseHTTPRequestHandler):
             # when the generation already finished.
             handle.cancel()
 
+    def _adapters_admin(self, body: bytes) -> None:
+        """POST /v1/adapters — runtime load/evict on the serve surface
+        (ISSUE 10): ``{"load": {"name": ..., "path"?: ..., "seed"?: ...}}``
+        installs (path: .npz deltas; seed/neither: deterministic random
+        smoke adapter), ``{"evict": "name"}`` removes — refused with 409
+        while a resident or parked lane is still serving it."""
+        b = self._batcher()
+        reg = getattr(b, "adapters", None) if b is not None else None
+        if reg is None:
+            self._send(400, {"error": "no adapter registry (set "
+                                      "SERVE_ADAPTERS to enable)"})
+            return
+        from paddle_operator_tpu.infer.qos import AdapterInUse
+
+        def lanes_in_use():
+            # resident + parked + QUEUED: a queued request already
+            # resolved its adapter slot at submit — evicting/replacing
+            # (and a later load reusing the slot) would serve it
+            # another tenant's deltas
+            in_use = {r.adapter_idx for r in b.lane if r is not None}
+            in_use |= {pk.req.adapter_idx for pk in b._parked}
+            in_use |= {r.adapter_idx for r in b._pending.items()}
+            return in_use
+
+        try:
+            req = json.loads(body)
+            if "load" in req:
+                spec = req["load"]
+                name = spec["name"]
+                if spec.get("path"):
+                    from paddle_operator_tpu.infer.qos import (
+                        load_adapter_file,
+                    )
+
+                    deltas = load_adapter_file(b.cfg, spec["path"],
+                                               reg.rank)
+                    idx = reg.load(name, deltas,
+                                   in_use=lanes_in_use())
+                else:
+                    idx = reg.load(name, seed=spec.get("seed"),
+                                   in_use=lanes_in_use())
+                self._send(200, {"loaded": name, "slot": idx})
+            elif "evict" in req:
+                reg.evict(req["evict"], in_use=lanes_in_use())
+                self._send(200, {"evicted": req["evict"]})
+            else:
+                raise ValueError("body must carry 'load' or 'evict'")
+        except AdapterInUse as e:
+            self._send(409, {"error": str(e)})
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            self._send(400, {"error": str(e)})
+        except OSError as e:
+            self._send(400, {"error": f"adapter file: {e}"})
+
     def do_POST(self):
         from paddle_operator_tpu.infer.resilience import (
             RetriableError,
@@ -333,6 +405,8 @@ class _Handler(BaseHTTPRequestHandler):
         # an unread body would be parsed as the next request's start line
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n) if n else b""
+        if self.path == "/v1/adapters":
+            return self._adapters_admin(body)
         if self.path != "/v1/generate":
             self._send(404, {})
             return
@@ -355,9 +429,20 @@ class _Handler(BaseHTTPRequestHandler):
             hdr = self.headers.get("X-Request-Deadline")
             if deadline_s is None and hdr is not None:
                 deadline_s = float(hdr)
+            # QoS class (ISSUE 10): the X-Request-Priority header (the
+            # router forwards it verbatim) or the body's ``priority``
+            # — body wins when both are set, like deadline_s.  0 is
+            # the most urgent class; unannotated requests get the
+            # server's default (least urgent) class.
+            priority = req.get("priority")
+            phdr = self.headers.get("X-Request-Priority")
+            if priority is None and phdr is not None:
+                priority = int(phdr)
             if req.get("stream"):
                 if deadline_s is not None:
                     req["deadline_s"] = float(deadline_s)
+                if priority is not None:
+                    req["priority"] = int(priority)
                 return self._stream_generate(req)
             tokens = np.asarray(req["tokens"], np.int32)
             if tokens.ndim != 2:
@@ -377,6 +462,9 @@ class _Handler(BaseHTTPRequestHandler):
                     tokens, request_id=req.get("request_id"),
                     deadline_s=(float(deadline_s)
                                 if deadline_s is not None else None),
+                    priority=(int(priority)
+                              if priority is not None else None),
+                    adapter=req.get("adapter"),
                     **opts)
                 resp = {"tokens": rows}
                 if getattr(gen.batcher, "spec_k", 0) > 0:
@@ -569,6 +657,35 @@ def main() -> int:
         # (the first long prompt then pays the per-bucket insert
         # compile — the lazy-compile cliff the prewarm exists to hide)
         ring_kw["prewarm"] = os.environ.get("SERVE_PREWARM", "1") == "1"
+        # Multi-tenant QoS (ISSUE 10, docs/serving.md):
+        # SERVE_PRIORITIES classes (0 most urgent; default 2, requests
+        # default to the least urgent — opt-in boosts only), and the
+        # preemption knobs: SERVE_PREEMPT=0 disables lane spill,
+        # SERVE_PREEMPT_MAX_PER_REQ / SERVE_PREEMPT_BUDGET /
+        # SERVE_PREEMPT_WINDOW_S bound thrash.  Defaults are
+        # byte-identical to the single-FIFO ring for unannotated
+        # traffic.
+        from paddle_operator_tpu.infer.qos import (
+            AdapterRegistry,
+            QoSConfig,
+        )
+
+        ring_kw["qos"] = QoSConfig.from_env()
+        # SERVE_ADAPTERS: comma list of LoRA adapters served off this
+        # ONE base param set (S-LoRA style) — ``name`` (deterministic
+        # random smoke adapter), ``name:seed:<int>``, or
+        # ``name:/path/to/deltas.npz``.  SERVE_ADAPTER_RANK /
+        # SERVE_MAX_ADAPTERS size the fixed-shape pool; per-request
+        # ``adapter`` (body key) selects one.  More load/evict at
+        # runtime via POST /v1/adapters.
+        if spec_k == 0:
+            adapters = AdapterRegistry.from_env(cfg)
+            if adapters is not None:
+                ring_kw["adapters"] = adapters
+        elif os.environ.get("SERVE_ADAPTERS", "").strip():
+            print("SERVE_ADAPTERS ignored: adapters are not supported "
+                  "on speculative rings (the draft proposes base-only)",
+                  flush=True)
         if spec_k > 0:
             # SERVE_SPEC_K=K: speculative decoding through the ring.
             # SERVE_DRAFT names the draft config — "auto" derives the

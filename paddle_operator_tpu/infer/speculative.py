@@ -99,9 +99,29 @@ def _write_rows(cache_l: jax.Array, kv: jax.Array,
     return cache_l
 
 
+def _proj_qkv(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
+              lora=None) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared multi-token projection block: norm -> q/k/v (+ the
+    per-row LoRA delta when ``lora=(adp_l, aid)`` — qos.lora_qkv, the
+    same rule every other projection site applies), reshaped to
+    [B, T, H, D] pre-RoPE."""
+    b, t, _ = x.shape
+    hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
+    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype)
+    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype)
+    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype)
+    if lora is not None:
+        from paddle_operator_tpu.infer.qos import lora_qkv
+
+        q, k, v = lora_qkv(h, lora[0], lora[1], q, k, v, cfg.dtype)
+    return (q.reshape(b, t, hq, d), k.reshape(b, t, hkv, d),
+            v.reshape(b, t, hkv, d))
+
+
 def _layer_multi(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
                  cos: jax.Array, sin: jax.Array, k_cache: jax.Array,
-                 v_cache: jax.Array, pos: jax.Array
+                 v_cache: jax.Array, pos: jax.Array, lora=None
                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer over [B, T] new tokens starting at PER-LANE
     offsets ``pos`` [B] — decode._layer's math with the scalar position
@@ -110,10 +130,7 @@ def _layer_multi(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
     and attends cache cols [0, pos[b]+j]."""
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
-    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
-    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    q, k, v = _proj_qkv(cfg, lp, x, lora)
     abs_pos = pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
     cos_b = cos[abs_pos][:, :, None, :]                      # [B, T, 1, d/2]
     sin_b = sin[abs_pos][:, :, None, :]
@@ -145,7 +162,7 @@ def _layer_multi(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
 def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
                    toks: jax.Array, cache: Dict[str, jax.Array],
-                   mesh=None, head: bool = True
+                   mesh=None, head: bool = True, lora=None
                    ) -> Tuple[Optional[jax.Array], Dict[str, jax.Array]]:
     """[B, T] new tokens at per-lane cache['pos'] -> ([B, T, vocab]
     logits, advanced cache).  The chunked-verify forward: every einsum
@@ -158,17 +175,26 @@ def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
     (executor.make_prefill_chunk) only appends KV, and head logits
     over a whole slice are the biggest tensor in the prefill path."""
     pos = cache["pos"]
+    adp, aid = lora if lora is not None else (None, None)
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
 
     def body(x, layer_in):
-        lp, k_c, v_c = layer_in
-        y, k_c, v_c = _layer_multi(cfg, lp, x, cos, sin, k_c, v_c, pos)
+        if adp is not None:
+            lp, adp_l, k_c, v_c = layer_in
+            lo = (adp_l, aid)
+        else:
+            lp, k_c, v_c = layer_in
+            lo = None
+        y, k_c, v_c = _layer_multi(cfg, lp, x, cos, sin, k_c, v_c, pos,
+                                   lora=lo)
         return y, (k_c, v_c)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"]))
+    xs = ((params["layers"], adp, cache["k"], cache["v"])
+          if adp is not None
+          else (params["layers"], cache["k"], cache["v"]))
+    x, (k_new, v_new) = jax.lax.scan(body, x, xs)
     new_cache = {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
     if not head:
         return None, new_cache
@@ -181,7 +207,8 @@ def _multi_forward(cfg: LlamaConfig, params: Dict[str, Any],
 def _layer_multi_paged(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
                        cos: jax.Array, sin: jax.Array, k_pool: jax.Array,
                        v_pool: jax.Array, li: jax.Array, table: jax.Array,
-                       pos: jax.Array, limit: Optional[jax.Array]
+                       pos: jax.Array, limit: Optional[jax.Array],
+                       lora=None
                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """:func:`_layer_multi` over the PAGED pool (infer/paged.py): new
     rows land in whatever pool block the lane's table maps for their
@@ -196,10 +223,7 @@ def _layer_multi_paged(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
-    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
-    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    q, k, v = _proj_qkv(cfg, lp, x, lora)
     abs_pos = pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
     cos_b = cos[abs_pos][:, :, None, :]
     sin_b = sin[abs_pos][:, :, None, :]
@@ -240,7 +264,7 @@ def _layer_multi_paged_quant(cfg: LlamaConfig, lp: Dict[str, Any],
                              vs: jax.Array, kt: jax.Array, vt: jax.Array,
                              li: jax.Array, table: jax.Array,
                              pos: jax.Array, limit: Optional[jax.Array],
-                             lane_mask: Optional[jax.Array]):
+                             lane_mask: Optional[jax.Array], lora=None):
     """:func:`_layer_multi_paged` over the QUANTIZED pool
     (SERVE_KV_QUANT=int8): each new row accumulates EXACT in the lane's
     bf16 staging tail; a row completing its block quantizes the whole
@@ -260,10 +284,7 @@ def _layer_multi_paged_quant(cfg: LlamaConfig, lp: Dict[str, Any],
 
     b, t, _ = x.shape
     hq, hkv, d = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    h = D._rms(x, lp["attn_norm"]["scale"], cfg.norm_eps, cfg.dtype)
-    q = D._mm(h, lp["attn"]["wq"]["kernel"], cfg.dtype).reshape(b, t, hq, d)
-    k = D._mm(h, lp["attn"]["wk"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
-    v = D._mm(h, lp["attn"]["wv"]["kernel"], cfg.dtype).reshape(b, t, hkv, d)
+    q, k, v = _proj_qkv(cfg, lp, x, lora)
     abs_pos = pos[:, None] + jnp.arange(t)[None, :]          # [B, T]
     cos_b = cos[abs_pos][:, :, None, :]
     sin_b = sin[abs_pos][:, :, None, :]
@@ -354,7 +375,8 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
                          limit: Optional[jax.Array] = None,
                          mesh=None, head: bool = True,
                          quant: bool = False,
-                         lane_mask: Optional[jax.Array] = None
+                         lane_mask: Optional[jax.Array] = None,
+                         lora=None
                          ) -> Tuple[Optional[jax.Array],
                                     Dict[str, jax.Array]]:
     """:func:`_multi_forward` with the target cache PAGED: the
@@ -372,39 +394,49 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
     writes to the trash tail — their tail rows may be live prefill
     state (see :func:`_layer_multi_paged_quant`)."""
     pos = cache["pos"]
+    adp, aid = lora if lora is not None else (None, None)
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
     cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                 cfg.rope_theta)
+    xs = ((params["layers"], adp, jnp.arange(cfg.n_layers))
+          if adp is not None
+          else (params["layers"], jnp.arange(cfg.n_layers)))
+
+    def _unpack(layer_in):
+        if adp is not None:
+            lp, adp_l, li = layer_in
+            return lp, li, (adp_l, aid)
+        lp, li = layer_in
+        return lp, li, None
 
     if quant:
         def body_q(carry, layer_in):
             x, kc, vc, ks, vs, kt, vt = carry
-            lp, li = layer_in
+            lp, li, lo = _unpack(layer_in)
             y, kc, vc, ks, vs, kt, vt = _layer_multi_paged_quant(
                 cfg, lp, x, cos, sin, kc, vc, ks, vs, kt, vt, li,
-                table, pos, limit, lane_mask)
+                table, pos, limit, lane_mask, lora=lo)
             return (y, kc, vc, ks, vs, kt, vt), ()
 
         (x, k_new, v_new, ks_new, vs_new, kt_new, vt_new), _ = \
             jax.lax.scan(
                 body_q,
                 (x, cache["k"], cache["v"], cache["ks"], cache["vs"],
-                 cache["kt"], cache["vt"]),
-                (params["layers"], jnp.arange(cfg.n_layers)))
+                 cache["kt"], cache["vt"]), xs)
         new_cache = {"k": k_new, "v": v_new, "ks": ks_new, "vs": vs_new,
                      "kt": kt_new, "vt": vt_new,
                      "pos": pos + toks.shape[1]}
     else:
         def body(carry, layer_in):
             x, kc, vc = carry
-            lp, li = layer_in
+            lp, li, lo = _unpack(layer_in)
             y, kc, vc = _layer_multi_paged(cfg, lp, x, cos, sin, kc, vc,
-                                           li, table, pos, limit)
+                                           li, table, pos, limit,
+                                           lora=lo)
             return (y, kc, vc), ()
 
         (x, k_new, v_new), _ = jax.lax.scan(
-            body, (x, cache["k"], cache["v"]),
-            (params["layers"], jnp.arange(cfg.n_layers)))
+            body, (x, cache["k"], cache["v"]), xs)
         new_cache = {"k": k_new, "v": v_new, "pos": pos + toks.shape[1]}
     if not head:
         return None, new_cache

@@ -71,6 +71,24 @@ _GAUGE_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{[^}]*\})?\s+"
     r"(?P<value>[-+0-9.eEnaif]+)\s*$")
 
+# tpujob_serve_adapter_loaded{...,adapter="name"} marker gauges
+# (ISSUE 10): the per-replica loaded-adapter SET the router's
+# adapter-affinity policy reads — scraped from the same /metrics pass
+# as the load gauges, no extra endpoint
+_ADAPTER_RE = re.compile(
+    r'^tpujob_serve_adapter_loaded\{[^}]*adapter="(?P<name>[^"]*)"[^}]*\}'
+    r"\s+1(?:\.0)?\s*$")
+
+
+def parse_adapter_gauges(text: str) -> set:
+    """The adapter names a replica's /metrics declares loaded."""
+    out = set()
+    for line in text.splitlines():
+        m = _ADAPTER_RE.match(line.strip())
+        if m:
+            out.add(m.group("name"))
+    return out
+
 
 def parse_serve_gauges(text: str) -> Dict[str, float]:
     """Parse prometheus exposition text into {camelCase key: value}
@@ -112,11 +130,23 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
                 "tokensTotal", "activeLanes", "kvPoolBytes",
                 "hostCacheBlocks", "promotedBlocks", "deadlineExceeded",
                 "watchdogRestarts", "quarantinedLanes",
-                "prefillQueueDepth"):
+                "prefillQueueDepth",
+                # multi-tenant QoS counters (ISSUE 10) — without them
+                # the fleet gauges read 0 while replicas preempt
+                "preemptedLanes", "parkedLanes", "activeAdapters"):
         vals = [b.get(key) for b in blocks if b.get(key) is not None]
         if vals:
             total = sum(float(v) for v in vals)
             agg[key] = round(total, 2) if total % 1 else int(total)
+    # per-class queue depth sums element-wise (classes align by index;
+    # a ragged fleet pads the shorter lists with 0)
+    depths = [b.get("priorityQueueDepth") for b in blocks
+              if isinstance(b.get("priorityQueueDepth"), list)]
+    if depths:
+        width = max(len(d) for d in depths)
+        agg["priorityQueueDepth"] = [
+            int(sum(float(d[i]) if i < len(d) else 0.0 for d in depths))
+            for i in range(width)]
     weights = [max(float(b.get("tokensTotal", 0) or 0), 0.0)
                for b in blocks]
     if not sum(weights):
@@ -143,6 +173,7 @@ class ReplicaState:
     endpoint: str                       # "host:port"
     ready: bool = False
     gauges: Dict[str, float] = field(default_factory=dict)
+    adapters: set = field(default_factory=set)   # loaded LoRA adapters
     last_ok: float = 0.0                # monotonic time of last scrape
     consecutive_failures: int = 0
 
@@ -199,7 +230,8 @@ class FleetRouter:
         self._inflight: set = set()
         self.counters: Dict[str, float] = {
             "routed_affinity": 0, "routed_spill": 0,
-            "routed_least_loaded": 0, "dedupe_replays": 0,
+            "routed_least_loaded": 0, "routed_adapter": 0,
+            "dedupe_replays": 0,
             "upstream_errors": 0, "no_ready_replica": 0,
         }
         self._stop = threading.Event()
@@ -269,7 +301,9 @@ class FleetRouter:
                 st.ready = code == 200
                 code, body = self._http_get(st.endpoint, "/metrics")
                 if code == 200:
-                    st.gauges = parse_serve_gauges(body.decode())
+                    text = body.decode()
+                    st.gauges = parse_serve_gauges(text)
+                    st.adapters = parse_adapter_gauges(text)
                 st.last_ok = time.monotonic()
                 st.consecutive_failures = 0
             except (OSError, socket.timeout, ValueError):
@@ -353,15 +387,33 @@ class FleetRouter:
             st.ready = False
             st.consecutive_failures += 1
 
-    def choose(self, tokens) -> Tuple[Optional[str], str]:
+    def choose(self, tokens,
+               adapter: Optional[str] = None) -> Tuple[Optional[str], str]:
         """Pick the replica for a prompt.  Returns ``(endpoint,
-        reason)`` with reason in {"affinity", "spill", "least_loaded"}
-        — or ``(None, "no_ready_replica")``."""
+        reason)`` with reason in {"adapter", "affinity", "spill",
+        "least_loaded"} — or ``(None, "no_ready_replica")``.
+
+        ``adapter`` (ISSUE 10): prefer the least-loaded READY replica
+        whose scraped /metrics declare the adapter loaded — the request
+        then needs no runtime load, and that replica's radix cache is
+        where the adapter's prefixes live (the chain namespace is
+        per-replica state).  No holder -> fall through to the normal
+        prefix-affinity/least-loaded policy (the replica will 400 an
+        unknown adapter, which the client surfaces — loading is an
+        operator action, not a routing side effect)."""
         with self._lock:
             ready = self._ready_endpoints()
             if not ready:
                 self.counters["no_ready_replica"] += 1
                 return None, "no_ready_replica"
+            if adapter is not None:
+                holders = [ep for ep in ready
+                           if adapter in self.replicas[ep].adapters]
+                if holders:
+                    ep = min(holders,
+                             key=lambda e: self.replicas[e].load_rank())
+                    self.counters["routed_adapter"] += 1
+                    return ep, "adapter"
             if self.affinity_blocks > 0 and tokens is not None:
                 key, _ = prefix_chain_key(tokens, self.block_size,
                                           self.affinity_blocks)
@@ -550,7 +602,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
         status, result = 0, None
         try:
             try:
-                ep, reason = r.choose(first_row)
+                ep, reason = r.choose(first_row,
+                                      adapter=req.get("adapter"))
             except (ValueError, TypeError) as e:
                 # malformed tokens (non-int elements): the replica
                 # would 400 this — so must the router, or the client
@@ -584,6 +637,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
             hdr = self.headers.get("X-Request-Deadline")
             if hdr:
                 headers["X-Request-Deadline"] = hdr
+            # QoS class rides through untouched (ISSUE 10) — the body's
+            # priority/adapter keys are already forwarded verbatim; the
+            # header form must survive the hop too
+            phdr = self.headers.get("X-Request-Priority")
+            if phdr:
+                headers["X-Request-Priority"] = phdr
             conn.request("POST", "/v1/generate", body=body,
                          headers=headers)
             resp = conn.getresponse()

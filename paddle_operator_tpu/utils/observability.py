@@ -95,6 +95,7 @@ def serving_gauges(status_serving: dict, job: str,
     fleet aggregate's top-level keys render exactly as a single pod's
     block always did, so existing dashboards keep reading."""
     out = _serving_gauges_one(status_serving, job, replica)
+    _qos_gauges(out, status_serving, job, replica)
     for rid, blk in sorted(
             (status_serving.get("replicas") or {}).items()):
         if isinstance(blk, dict):
@@ -117,6 +118,29 @@ def serving_gauges(status_serving: dict, job: str,
         out[f"tpujob_serve_fleet_replica_restarts{lbl}"] = \
             float(fleet.get("replicaRestarts", 0))
     return out
+
+
+def _qos_gauges(out: dict, status_serving: dict, job: str,
+                replica: str = None) -> None:
+    """Multi-tenant QoS gauges (ISSUE 10), rendered for the top-level
+    block only (per-replica QoS reads ride each replica's own
+    /metrics): per-class queue depth labeled ``prio``, cumulative lane
+    preemption spills, the loaded-adapter count, and one
+    ``adapter_loaded`` marker gauge per adapter NAME — the labeled
+    shape the fleet router scrapes to prefer replicas that already
+    hold a request's adapter."""
+    rep = f',replica="{replica}"' if replica else ""
+    depths = status_serving.get("priorityQueueDepth") or [0.0]
+    for prio, depth in enumerate(depths):
+        out[("tpujob_serve_priority_queue_depth"
+             f'{{job="{job}"{rep},prio="{prio}"}}')] = float(depth)
+    out[f'tpujob_serve_lane_preemptions_total{{job="{job}"{rep}}}'] = \
+        float(status_serving.get("preemptedLanes", 0.0))
+    out[f'tpujob_serve_active_adapters{{job="{job}"{rep}}}'] = \
+        float(status_serving.get("activeAdapters", 0.0))
+    for name in status_serving.get("adapterNames") or ():
+        out[("tpujob_serve_adapter_loaded"
+             f'{{job="{job}"{rep},adapter="{name}"}}')] = 1.0
 
 
 def _serving_gauges_one(status_serving: dict, job: str,

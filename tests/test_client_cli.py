@@ -145,3 +145,21 @@ class TestIdempotentRequestId:
                                  backoff_base_s=0.01,
                                  sleep=lambda s: None)
         assert "request_id" not in payload
+
+    def test_priority_and_adapter_flags_ride_every_retry(
+            self, flaky, capsys):
+        """ISSUE 10 satellite: ``--priority``/``--adapter`` thread
+        into the request BODY before the first attempt, so the 503
+        retry carries them verbatim alongside the once-minted
+        request_id (the router forwards both untouched)."""
+        base, handler = flaky
+        rc = client_cli.main([
+            "generate", base, json.dumps({"tokens": [[5]]}),
+            "--priority", "0", "--adapter", "acme"])
+        assert rc == 0
+        assert len(handler.bodies) == 2         # 503 then 200
+        for b in handler.bodies:
+            assert b["priority"] == 0
+            assert b["adapter"] == "acme"
+        ids = [b["request_id"] for b in handler.bodies]
+        assert ids[0] == ids[1]

@@ -21,6 +21,7 @@ from paddle_operator_tpu.router.router import (
     ReplicaState,
     aggregate_fleet_serving,
     make_router_server,
+    parse_adapter_gauges,
     parse_serve_gauges,
 )
 from paddle_operator_tpu.utils.radixkey import (
@@ -223,6 +224,54 @@ class TestRoutingPolicy:
                                       "kvBlocksFree": 9.0,
                                       "tokensPerSec": 5.0})
         assert d.load_rank() < c.load_rank()
+
+
+class TestAdapterAffinity:
+    """ISSUE 10: the router prefers replicas whose scraped /metrics
+    declare a request's adapter loaded, falling through to the normal
+    policy when nobody holds it."""
+
+    def test_adapter_prefers_holder(self):
+        router = _router_with({"a:1": {}, "b:1": {}, "c:1": {}})
+        router.replicas["b:1"].adapters = {"acme"}
+        ep, reason = router.choose([1, 2, 3, 4], adapter="acme")
+        assert (ep, reason) == ("b:1", "adapter")
+        assert router.counters["routed_adapter"] == 1
+
+    def test_multiple_holders_pick_least_loaded(self):
+        router = _router_with({"a:1": {"queueDepth": 5.0},
+                               "b:1": {"queueDepth": 0.0},
+                               "c:1": {}})
+        router.replicas["a:1"].adapters = {"acme"}
+        router.replicas["b:1"].adapters = {"acme"}
+        ep, reason = router.choose([1, 2, 3, 4], adapter="acme")
+        assert (ep, reason) == ("b:1", "adapter")
+
+    def test_no_holder_falls_through(self):
+        router = _router_with({"a:1": {}, "b:1": {}})
+        ep, reason = router.choose([1, 2, 3, 4], adapter="nobody")
+        assert reason in ("affinity", "spill", "least_loaded")
+        assert router.counters["routed_adapter"] == 0
+
+    def test_unready_holder_not_picked(self):
+        router = _router_with({"a:1": {}, "b:1": {}}, ready=["a:1"])
+        router.replicas["b:1"].adapters = {"acme"}
+        ep, reason = router.choose([1, 2, 3, 4], adapter="acme")
+        assert ep == "a:1" and reason != "adapter"
+
+    def test_parse_adapter_gauges_round_trip(self):
+        from paddle_operator_tpu.utils.observability import (
+            serving_gauges,
+        )
+
+        st = {"queueDepth": 1, "activeAdapters": 2,
+              "adapterNames": ["acme", "zen-2"]}
+        text = "".join(
+            f"{k} {v}\n"
+            for k, v in sorted(serving_gauges(st, "ns/j",
+                                              replica="0").items()))
+        assert parse_adapter_gauges(text) == {"acme", "zen-2"}
+        assert parse_adapter_gauges("garbage\n") == set()
 
 
 class TestDedupe:

@@ -102,6 +102,42 @@ class TestFleetMaterialization:
                    for v in pod["spec"]["volumes"])
         assert c0["command"][-1] == "paddle_operator_tpu.router"
 
+    def test_qos_spec_maps_to_serve_env(self):
+        """ISSUE 10: the ServingSpec QoS/adapter knobs reach every
+        replica as SERVE_* env (user template still overrides), and
+        round-trip through to_dict/from_dict."""
+        from paddle_operator_tpu.api.types import ServingSpec
+
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        job = TPUJob(name="qj", namespace=NS, spec=TPUJobSpec(
+            serving=ServingSpec(
+                replicas=1, template=TMPL, priorities=3,
+                preemption=False, adapters=["acme", "zen:seed:7"],
+                adapter_rank=16, max_adapters=4)))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "qj")
+        pod = api.get("Pod", NS, "qj-serve-0")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["SERVE_PRIORITIES"] == "3"
+        assert env["SERVE_PREEMPT"] == "0"
+        assert env["SERVE_ADAPTERS"] == "acme,zen:seed:7"
+        assert env["SERVE_ADAPTER_RANK"] == "16"
+        assert env["SERVE_MAX_ADAPTERS"] == "4"
+        # round-trip: the spec survives the apiserver dict form
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "qj"))
+        sv = got.spec.serving
+        assert (sv.priorities, sv.preemption) == (3, False)
+        assert sv.adapters == ["acme", "zen:seed:7"]
+        assert (sv.adapter_rank, sv.max_adapters) == (16, 4)
+        # unset knobs emit NO env (server defaults stay in charge)
+        api2, rec2, _ = _setup(replicas=1)
+        pod2 = api2.get("Pod", NS, "fj-serve-0")
+        names = {e["name"] for e in pod2["spec"]["containers"][0]["env"]}
+        assert "SERVE_PRIORITIES" not in names
+        assert "SERVE_ADAPTERS" not in names
+
     def test_user_env_wins_over_injected_defaults(self):
         api = FakeAPI()
         rec = TPUJobReconciler(api)

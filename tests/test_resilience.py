@@ -83,6 +83,7 @@ def _pace(b, delay):
 
 
 class TestDeadlines:
+    @pytest.mark.slow   # pinned by dryrun serve-chaos (tier-1 budget, ISSUE 10)
     def test_resident_deadline_partial_and_blocks_freed(self, setup):
         """An expired lane retires mid-generation: the request RESOLVES
         with a prefix of the fault-free stream, the flag set, and (paged)
@@ -318,6 +319,7 @@ class TestWatchdogSelfHeal:
 
 
 class TestNanQuarantine:
+    @pytest.mark.slow   # pinned by dryrun serve-chaos (tier-1 budget, ISSUE 10)
     def test_nan_lane_fails_one_request_not_the_ring(self, setup):
         """Poisoned lane -> LaneQuarantined for ITS request only; the
         other resident lane's stream is bit-identical to fault-free
@@ -350,6 +352,7 @@ class TestNanQuarantine:
         finally:
             b.close()
 
+    @pytest.mark.slow   # pinned by dryrun serve-chaos (tier-1 budget, ISSUE 10)
     def test_paged_nan_blocks_scrubbed_before_reuse(self, setup):
         """Paged quarantine must SCRUB the lane's private blocks: a NaN
         row re-mapped under a later lane would poison it through the

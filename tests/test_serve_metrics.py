@@ -27,7 +27,9 @@ SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "chunkedPrefillTokenShare": 0.85,
            "kvQuantMode": "int8", "kvPoolBytes": 4096,
            "hostCacheBlocks": 5, "hostHitRate": 0.12,
-           "promotedBlocks": 42}
+           "promotedBlocks": 42,
+           "priorityQueueDepth": [1, 2], "preemptedLanes": 3,
+           "activeAdapters": 2, "adapterNames": ["acme", "zen"]}
 
 
 class TestGaugeNaming:
@@ -56,6 +58,23 @@ class TestGaugeNaming:
         assert g['tpujob_serve_host_hit_rate{job="default/j"}'] == 0.12
         assert g['tpujob_serve_promoted_blocks_total'
                  '{job="default/j"}'] == 42.0
+        # multi-tenant QoS gauges (ISSUE 10): per-class queue depth
+        # with the class as a label, cumulative preemption spills, the
+        # loaded-adapter count, and one marker gauge per adapter NAME
+        # (the labeled shape the fleet router's adapter affinity
+        # scrapes)
+        assert g['tpujob_serve_priority_queue_depth'
+                 '{job="default/j",prio="0"}'] == 1.0
+        assert g['tpujob_serve_priority_queue_depth'
+                 '{job="default/j",prio="1"}'] == 2.0
+        assert g['tpujob_serve_lane_preemptions_total'
+                 '{job="default/j"}'] == 3.0
+        assert g['tpujob_serve_active_adapters'
+                 '{job="default/j"}'] == 2.0
+        assert g['tpujob_serve_adapter_loaded'
+                 '{job="default/j",adapter="acme"}'] == 1.0
+        assert g['tpujob_serve_adapter_loaded'
+                 '{job="default/j",adapter="zen"}'] == 1.0
 
     def test_prefill_mode_label_defaults_inline(self):
         g = serving_gauges({}, "ns/x")
@@ -88,6 +107,19 @@ class TestGaugeNaming:
             'tpujob_serve_host_cache_blocks{job="default/j"}',
             'tpujob_serve_host_hit_rate{job="default/j"}',
             'tpujob_serve_promoted_blocks_total{job="default/j"}',
+            # multi-tenant QoS shape (ISSUE 10): one queue-depth gauge
+            # per class in the block, preemptions, adapter count + one
+            # marker per loaded adapter name
+            'tpujob_serve_priority_queue_depth'
+            '{job="default/j",prio="0"}',
+            'tpujob_serve_priority_queue_depth'
+            '{job="default/j",prio="1"}',
+            'tpujob_serve_lane_preemptions_total{job="default/j"}',
+            'tpujob_serve_active_adapters{job="default/j"}',
+            'tpujob_serve_adapter_loaded'
+            '{job="default/j",adapter="acme"}',
+            'tpujob_serve_adapter_loaded'
+            '{job="default/j",adapter="zen"}',
             'tpujob_serve_deadline_exceeded{job="default/j"}',
             'tpujob_serve_watchdog_restarts{job="default/j"}',
             'tpujob_serve_quarantined_lanes{job="default/j"}',
@@ -250,6 +282,10 @@ class TestBatcherServingStatus:
                            # hierarchical-cache block (ISSUE 8)
                            "hostCacheBlocks", "hostHitRate",
                            "promotedBlocks",
+                           # multi-tenant QoS block (ISSUE 10)
+                           "priorityQueueDepth", "preemptedLanes",
+                           "parkedLanes", "activeAdapters",
+                           "adapterNames",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
@@ -259,6 +295,9 @@ class TestBatcherServingStatus:
         assert st["hostCacheBlocks"] == 0      # tier off by default
         assert st["hostHitRate"] == 0.0
         assert st["promotedBlocks"] == 0
+        assert st["priorityQueueDepth"] == [0, 0]   # 2 classes default
+        assert st["preemptedLanes"] == 0
+        assert st["activeAdapters"] == 0       # no registry by default
         assert st["kvPoolBytes"] > 0
         assert st["tokensTotal"] == 4
         assert st["tokensPerSec"] > 0

@@ -74,6 +74,7 @@ class TestDraftConfig:
 
 
 class TestGreedyParity:
+    @pytest.mark.slow   # pinned by dryrun serve-spec (tier-1 budget, ISSUE 10)
     def test_greedy_token_identical_to_generate(self, setup):
         """The core exactness claim, across K and batch: a random-init
         draft rejects nearly everything, yet the output must equal
@@ -197,6 +198,7 @@ class TestSpeculativeRing:
         return ContinuousBatcher(params, cfg, draft_params=dparams,
                                  draft_cfg=dcfg, spec_k=3, **kw)
 
+    @pytest.mark.slow   # pinned by dryrun serve-spec (tier-1 budget, ISSUE 10)
     def test_ragged_lanes_divergent_accepts_match_generate(self, setup):
         cfg, params, dcfg, dparams = setup
         b = self._ring(cfg, params, dcfg, dparams)
@@ -217,6 +219,7 @@ class TestSpeculativeRing:
         finally:
             b.close()
 
+    @pytest.mark.slow   # pinned by dryrun serve-spec (tier-1 budget, ISSUE 10)
     def test_mixed_accept_lengths_in_one_wave(self, setup):
         """One lane rides a SELF-draft-agreeing request while another
         diverges: submit the same ring a prompt whose draft is the
