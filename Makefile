@@ -19,7 +19,20 @@ test: native
 # changes no outcome but makes the slow spec/paged serving tests
 # visible in CI logs) — the bar every PR must keep no worse than the
 # seed.
+#
+# Preflight: orphaned `infer.serve` / `router` processes leaked by a
+# previous session each burn ~5% CPU and ~700MB RSS FOREVER and
+# corrupt tier-1 timing on this contended box (ROADMAP budget note) —
+# detect them BEFORE the timed run and fail loudly with their PIDs so
+# the operator kills them instead of chasing a phantom slowdown.
 tier1:
+	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.router' || true); \
+	if [ -n "$$pids" ]; then \
+		echo "tier1 preflight FAILED: orphaned serve/router process(es) from a previous session:"; \
+		ps -o pid,etime,rss,args -p $$pids || true; \
+		echo "kill them (kill $$pids) before timing tier-1 — each burns CPU and ~700MB RSS and skews the 870s budget"; \
+		exit 1; \
+	fi
 	set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --durations=15 --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=$${PIPESTATUS[0]}; echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); exit $$rc
 
 # Run the controller locally against the current kube context
@@ -49,7 +62,7 @@ bench:
 # (all training parallelism axes, plus the serving parity lines:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
 # serve-disagg, serve-kvquant, serve-hostcache, serve-fleet,
-# serve-qos, ft-drain)
+# serve-qos, serve-megastep, ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

@@ -1183,6 +1183,153 @@ def measure_speculative(cfg, dcfg, params, dparams, *,
     return out
 
 
+def measure_megastep(cfg, params, *, dcfg=None, dparams=None,
+                     n_steps=(1, 4, 8), batches=(1, 8), spec_k: int = 4,
+                     prompt_len: int = 16, new_tokens: int = 96,
+                     max_len: int = 128, block_size: int = 8,
+                     chunk: int = 2, repeats: int = 2,
+                     host_load_threads: int = 2,
+                     include_spec: bool = True) -> list:
+    """Device-resident megastep sweep (ISSUE 11, docs/serving.md
+    "Megastep execution"): saturated decode tok/s and measured
+    dispatches-per-token at N fused iterations per dispatch x batch,
+    spec off and on.
+
+    THE REGIME — the acceptance bar targets HOST-BOUND serving, where
+    the Python thread (not the kernel) paces the ring: on TPU that is
+    simply production traffic (per-chunk device time under the host
+    round-trip — the vLLM multi-step / NanoFlow argument); on an idle
+    CPU box the depth-2 pipeline still hides the host tax behind
+    device compute, so the bench recreates the loaded-server regime
+    DELIBERATELY with ``host_load_threads`` pure-Python busy threads
+    competing for the GIL — the HTTP handlers, tokenization and
+    router-scrape traffic a production pod actually runs (and what
+    this box's ±20% contention swings did by accident in the ROADMAP
+    re-anchor measurements).  Every boundary the ring thread crosses
+    costs GIL turns against that load; fusing N iterations buys N x
+    fewer of them, which is exactly the effect the sweep measures.
+    Every row records the host core count so the artifact reads in
+    regime (2-core box: the load threads own the GIL whenever the
+    ring thread sleeps in a dispatch)."""
+    import os as _os
+    import threading as _th
+
+    import numpy as np
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+
+    rng = np.random.default_rng(7)
+    rows = []
+    stop = _th.Event()
+
+    def _gil_load():
+        # pure-Python arithmetic: holds the GIL (unlike hashlib/numpy
+        # bulk ops, which release it and would model the wrong thing)
+        x = 1
+        while not stop.is_set():
+            for _ in range(2048):
+                x = (x * 1103515245 + 12345) & 0xFFFFFFFF
+
+    loaders = [_th.Thread(target=_gil_load, daemon=True)
+               for _ in range(max(0, host_load_threads))]
+    for t in loaders:
+        t.start()
+    spec_modes = (False, True) if include_spec and dcfg is not None \
+        else (False,)
+    try:
+        for spec in spec_modes:
+            for batch in batches:
+                prompts = [rng.integers(0, cfg.vocab_size,
+                                        (prompt_len,)).tolist()
+                           for _ in range(batch)]
+                for n in n_steps:
+                    rows.append(_megastep_cell(
+                        cfg, params, dcfg, dparams, prompts, n, batch,
+                        spec, spec_k, chunk, max_len, prompt_len,
+                        new_tokens, block_size, repeats,
+                        host_load_threads))
+    finally:
+        stop.set()
+        for t in loaders:
+            t.join(timeout=5)
+    return rows
+
+
+def _megastep_cell(cfg, params, dcfg, dparams, prompts, n, batch, spec,
+                   spec_k, chunk, max_len, prompt_len, new_tokens,
+                   block_size, repeats, host_load_threads):
+    import os as _os
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+
+    kw = dict(slots=batch, max_len=max_len, chunk_tokens=chunk,
+              prefill_buckets=(prompt_len, max_len), paged=True,
+              block_size=block_size, megastep=n)
+    if spec:
+        kw.update(draft_params=dparams, draft_cfg=dcfg, spec_k=spec_k)
+    b = ContinuousBatcher(params, cfg, **kw)
+    try:
+        # warmup: compile insert + the N-step program
+        b.submit(prompts[0], max_new_tokens=chunk).result(timeout=600)
+        # best-of-repeats: this box shows +-20% run-to-run contention
+        # (ROADMAP note) — a hiccup vanishes on retry, a real
+        # regression reproduces
+        dt = 1e9
+        for _ in range(repeats):
+            warm_chunks = b.stats["chunks"]
+            t0 = time.perf_counter()
+            hs = [b.submit(p, max_new_tokens=new_tokens)
+                  for p in prompts]
+            outs = [h.result(timeout=600) for h in hs]
+            dt = min(dt, time.perf_counter() - t0)
+            dispatches = b.stats["chunks"] - warm_chunks
+    finally:
+        b.close()
+    generated = sum(len(o) - prompt_len for o in outs)
+    return {
+        "megastep_n": n, "megastep_batch": batch,
+        "megastep_spec": bool(spec),
+        "megastep_chunk": chunk,
+        "megastep_new_tokens": new_tokens,
+        "megastep_host_load_threads": host_load_threads,
+        "megastep_tok_s": round(generated / dt, 1),
+        "megastep_dispatches": dispatches,
+        "megastep_dispatches_per_token": round(
+            dispatches / generated, 5),
+        # regime marker (PR 9's fleet_host_cores pattern): the
+        # host-bound win reads against the core count
+        "megastep_host_cores": _os.cpu_count(),
+    }
+
+
+def _fold_megastep_summary(rows, summary, emit) -> None:
+    """Summary keys: tok/s ratio of N=4/N=8 vs the N=1 baseline at the
+    largest non-spec batch (the host-bound headline), plus the measured
+    dispatches/token at the deepest fusion."""
+    if not isinstance(rows, list):
+        emit("megastep_sweep", rows)
+        return
+    for entry in rows:
+        emit("megastep_sweep", entry)
+    plain = [r for r in rows if not r["megastep_spec"]]
+    if not plain:
+        return
+    top_batch = max(r["megastep_batch"] for r in plain)
+    cells = {r["megastep_n"]: r for r in plain
+             if r["megastep_batch"] == top_batch}
+    base = cells.get(1)
+    if base and base["megastep_tok_s"]:
+        for n in (4, 8):
+            if n in cells:
+                summary[f"megastep_tok_s_ratio_n{n}"] = round(
+                    cells[n]["megastep_tok_s"] / base["megastep_tok_s"],
+                    2)
+    deepest = max(cells) if cells else None
+    if deepest:
+        summary["megastep_dispatches_per_token"] = \
+            cells[deepest]["megastep_dispatches_per_token"]
+
+
 def measure_fleet(*, replica_counts=(1, 2, 4), n_groups=8,
                   per_group=8, prefix_blocks=2, block_size=8,
                   suffix_len=4, new_tokens=24, slots=4,
@@ -2092,6 +2239,30 @@ def main() -> int:
                         summary[key] = entry[key]
         else:
             emit("qos_sweep", qos_rows)
+
+        # megastep sweep on CPU (ISSUE 11): the tiny-model ring IS the
+        # host-bound regime the fusion targets (device ticks are
+        # microseconds, the Python dispatch tax is ~ms), so the
+        # N=4/N=8 tok/s ratios and dispatches/token here are the
+        # acceptance signal; absolute tok/s is CPU physics
+        def cpu_megastep():
+            import dataclasses as _dc
+
+            from paddle_operator_tpu.infer.quant import serving_params
+
+            tcfg = _dc.replace(L.CONFIGS["tiny"], max_seq_len=128)
+            tparams = serving_params(L.Llama(tcfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"], tcfg.dtype)
+            tdcfg = tcfg.draft()
+            tdparams = serving_params(L.Llama(tdcfg).init(
+                jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+            )["params"], tdcfg.dtype)
+            return measure_megastep(tcfg, tparams, dcfg=tdcfg,
+                                    dparams=tdparams)
+
+        _fold_megastep_summary(guarded("megastep", cpu_megastep),
+                               summary, emit)
 
         # speculative sweep on CPU: tiny pattern-trained pair — speeds
         # are meaningless but accept-rate and the greedy-parity path run

@@ -244,6 +244,10 @@ def _spec_schema() -> Dict[str, Any]:
                     },
                     "adapterRank": _int(0),
                     "maxAdapters": _int(0),
+                    # device-resident megastep (ISSUE 11): fused ring
+                    # iterations per compiled dispatch (SERVE_MEGASTEP;
+                    # 0/unset = the server's single-step default)
+                    "megastep": _int(0),
                 },
             },
             "tpu": {

@@ -306,6 +306,15 @@ class ServingSpec:
       request's adapter;
     - ``adapter_rank`` / ``max_adapters``  size the fixed-shape
       adapter pool (SERVE_ADAPTER_RANK / SERVE_MAX_ADAPTERS).
+
+    Device-resident megastep (ISSUE 11):
+
+    - ``megastep``         fused ring iterations per compiled dispatch
+      on every replica (0/unset keeps the server default of 1, the
+      byte-identical single-step oracle) -> SERVE_MEGASTEP.  Raising
+      it amortizes the per-dispatch host tax ~N x at the cost of
+      admission/preemption granularity (a queued request waits up to
+      N iterations for a lane — docs/serving.md has the tradeoff).
     """
 
     replicas: int = 1
@@ -319,6 +328,7 @@ class ServingSpec:
     adapters: List[str] = field(default_factory=list)
     adapter_rank: int = 0
     max_adapters: int = 0
+    megastep: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"replicas": self.replicas}
@@ -342,6 +352,8 @@ class ServingSpec:
             d["adapterRank"] = self.adapter_rank
         if self.max_adapters:
             d["maxAdapters"] = self.max_adapters
+        if self.megastep:
+            d["megastep"] = self.megastep
         return d
 
     @classmethod
@@ -362,6 +374,7 @@ class ServingSpec:
             adapters=[str(a) for a in (d.get("adapters") or [])],
             adapter_rank=int(d.get("adapterRank", 0)),
             max_adapters=int(d.get("maxAdapters", 0)),
+            megastep=int(d.get("megastep", 0)),
         )
 
 

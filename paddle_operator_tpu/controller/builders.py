@@ -629,6 +629,10 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
         _env_setdefault(env, "SERVE_ADAPTER_RANK", str(sv.adapter_rank))
     if sv.max_adapters:
         _env_setdefault(env, "SERVE_MAX_ADAPTERS", str(sv.max_adapters))
+    if sv.megastep:
+        # device-resident megastep (ISSUE 11): fused iterations per
+        # compiled dispatch — spec.serving.megastep -> SERVE_MEGASTEP
+        _env_setdefault(env, "SERVE_MEGASTEP", str(sv.megastep))
     if job.spec.checkpoint_path:
         _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
                         job.spec.checkpoint_path)

@@ -23,10 +23,12 @@ Schedule syntax (also the ``TPUJOB_CHAOS`` env var)::
     client_drop@7            cancel a resident request before #7
     pool_oom@3:2             next 2 pool allocations raise NoFreeBlocks
 
-The injector wraps the batcher's resident step fn(s) in place
-(:meth:`ChaosInjector.install`), so admission, consume bookkeeping, and
-the self-healing machinery all run their REAL code — only the device
-dispatch lies.
+The injector wraps the executor's PLAN REPLAYER in place
+(:meth:`ChaosInjector.install` — RingExecutor.replay, the one seam
+every resident decode dispatch passes through, 1-step or fused
+megastep), so admission, consume bookkeeping, and the self-healing
+machinery all run their REAL code — only the device dispatch lies.
+One replay == one dispatch index, whatever SERVE_MEGASTEP is.
 """
 
 from __future__ import annotations
@@ -92,15 +94,14 @@ class ChaosInjector:
     # -- wiring ------------------------------------------------------------
 
     def install(self, batcher) -> "ChaosInjector":
-        """Replace the batcher's compiled step attribute(s) with the
-        faulting wrapper.  Call BEFORE submitting work; the wrapper
-        survives ring rebuilds (self-healing re-uses the same compiled
-        program objects)."""
+        """Replace the executor's plan replayer
+        (RingExecutor.replay) with the faulting wrapper — the ONE path
+        every resident dispatch takes (ISSUE 11), so the schedule means
+        the same thing on a 1-step and an N-step ring.  Call BEFORE
+        submitting work; the wrapper survives ring rebuilds
+        (self-healing rebuilds state, not the executor object)."""
         self.batcher = batcher
-        if getattr(batcher, "spec_k", 0):
-            batcher._spec_step = self._wrap(batcher._spec_step)
-        else:
-            batcher._step = self._wrap(batcher._step)
+        batcher.executor.replay = self._wrap(batcher.executor.replay)
         return self
 
     def _wrap(self, real):

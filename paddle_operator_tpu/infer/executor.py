@@ -52,6 +52,66 @@ from paddle_operator_tpu.infer import decode as D
 from paddle_operator_tpu.models.llama import LlamaConfig, rope_frequencies
 
 
+class ExecPlan:
+    """One resident ring dispatch, fully described host-side
+    (ISSUE 11).  The scheduler FILLS a plan (which lanes step, the
+    block table snapshot, the adapter tail, how many fused iterations,
+    and the per-lane continuation budgets) and the executor REPLAYS it
+    (:meth:`RingExecutor.replay`) — one code path serving N=1 (the
+    byte-identical legacy dispatch, the oracle) and N>1 (the fused
+    megastep).  Admission, preemption, promotions, CoW and handoffs
+    all happen BETWEEN plans, so a replay is a pure function of ring
+    state + plan — which is what lets the chaos injector and the
+    dispatch watchdog wrap it as a unit.
+
+    - ``n_steps``  fused ring iterations (1 = today's dispatch);
+    - ``active``   per-lane participation (host bools, [slots]);
+    - ``table``    block-table snapshot (np [slots, M]; None on the
+      contiguous ring) — prefill-pending rows already trash-masked;
+    - ``lora``     trailing adapter operands (lora_step_tail());
+    - ``eos``      per-lane eos token id, -1 for none (np int32);
+    - ``left``     per-lane remaining token budget — what the device
+      may still emit (the admission-sampled first token, if still
+      unmaterialized, is already subtracted);
+    - ``steps``    per-lane max fused iterations this dispatch (the
+      deadline-tick budget; ``n_steps`` when unconstrained).
+
+    ``eos``/``left``/``steps`` are only consulted when ``n_steps > 1``
+    — the N=1 replay is operand-for-operand today's dispatch."""
+
+    __slots__ = ("n_steps", "active", "table", "lora", "eos", "left",
+                 "steps")
+
+    def __init__(self, n_steps, active, table=None, lora=(),
+                 eos=None, left=None, steps=None):
+        self.n_steps = int(n_steps)
+        self.active = active
+        self.table = table
+        self.lora = tuple(lora)
+        self.eos = eos
+        self.left = left
+        self.steps = steps
+
+
+class DispatchResult:
+    """Device futures one :meth:`RingExecutor.replay` returns — what
+    the scheduler's pipelining queue holds until the consume boundary.
+    ``toks`` is [chunk, B] at n_steps=1 and [n, chunk(|K+1), B] fused;
+    ``counts`` the host-consumable row counts ([B] spec at N=1,
+    [n, B] fused, None plain-1-step); ``raw`` the spec rounds' device
+    commit counts (acceptance telemetry); ``ok`` the isfinite
+    verdicts (check_finite only)."""
+
+    __slots__ = ("toks", "counts", "ok", "raw", "n_steps")
+
+    def __init__(self, toks, counts, ok, raw, n_steps):
+        self.toks = toks
+        self.counts = counts
+        self.ok = ok
+        self.raw = raw
+        self.n_steps = n_steps
+
+
 # ---------------------------------------------------------------------------
 # Per-lane-position forward step (moved verbatim from infer/batcher.py)
 # ---------------------------------------------------------------------------
@@ -355,6 +415,137 @@ def make_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
         return cache, tok, toks
 
     return jax.jit(step, donate_argnums=(1,))
+
+
+def _mega_advance(toks, raw, live, left, eos):
+    """On-device continuation bookkeeping at one fused-iteration
+    boundary of a megastep (ISSUE 11) — the EXACT decision the host
+    makes between two 1-step dispatches, in compiled form so N ring
+    iterations can run without a host round-trip.
+
+    ``toks`` [T, B] is the boundary's emitted tokens (a chunk's ticks,
+    or a spec round's committed block), ``raw`` [B] the device-valid
+    row count per lane (``chunk`` for plain chunks, ``n_commit`` for
+    spec rounds, 0 for lanes that sat the iteration out), ``live`` [B]
+    the continuation mask at the iteration's START, ``left`` [B] the
+    per-lane remaining token budget and ``eos`` [B] the per-lane eos id
+    (-1: none).  Returns ``(count, live', left')``: the tokens the host
+    will actually consume for this boundary (up to and INCLUDING an
+    eos, capped by the budget — the same walk scheduler._consume runs),
+    and the advanced continuation state.  A lane that saw eos or
+    exhausted its budget goes dead and free-runs masked until the
+    megastep ends."""
+    t = toks.shape[0]
+    idx = jnp.arange(t)[:, None]
+    hitv = (eos[None, :] >= 0) & (toks == eos[None, :])
+    hit = hitv.astype(jnp.int32)
+    eos_before = (jnp.cumsum(hit, axis=0) - hit) > 0
+    valid = ((idx < raw[None, :]) & ~eos_before
+             & (idx < left[None, :]) & live[None, :])
+    count = valid.sum(axis=0).astype(jnp.int32)
+    saw_eos = (hitv & valid).any(axis=0)
+    left2 = left - count
+    live2 = live & ~saw_eos & (left2 > 0)
+    return count, live2, left2
+
+
+def _mega_continue(toks, raw, live, left, steps, eos):
+    """The WHOLE per-boundary continuation update, shared by every
+    megastep builder (contiguous, paged, spec) so the token-budget walk
+    and the step-budget decrement can never drift between them:
+    :func:`_mega_advance` plus the deadline-tick step accounting.
+    Returns ``(count, live', left', steps')``."""
+    count, live2, left2 = _mega_advance(toks, raw, live, left, eos)
+    steps2 = steps - live.astype(jnp.int32)
+    live2 = live2 & (steps2 > 0)
+    return count, live2, left2, steps2
+
+
+def make_megastep(cfg: LlamaConfig, chunk_tokens: int, n_steps: int,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None, mesh=None,
+                  check_finite: bool = False):
+    """N fused ring iterations in ONE compiled dispatch (ISSUE 11): the
+    contiguous ring's ``make_chunk_step`` body scanned ``n_steps``
+    times with the host's boundary decisions — eos detection, token
+    budget, step budget — carried ON DEVICE (:func:`_mega_advance`).
+    A lane that finishes mid-megastep free-runs masked: its position
+    stops advancing (the pos a live lane would carry is restored from
+    the pre-chunk snapshot, so a step-budget-frozen lane could resume)
+    and its writes land at its own row 0 exactly like an inactive
+    lane's in the 1-step program — which the next admission's splice
+    overwrites.  NOTE the contiguous ring must only freeze lanes it
+    will EVICT at the boundary (eos / budget exhausted): the masked
+    row-0 writes make a frozen-and-resumed lane unsound here (they
+    overwrite the first prompt row), so the scheduler never hands a
+    contiguous ring a per-lane step budget below ``n_steps`` — the
+    paged megastep (trash-block redirect) is the resumable one.
+
+    ``mega(params, cache, tok, temp, keys, active, eos, left, steps,
+    *lora) -> (cache', tok', toks [n, chunk, B], counts [n, B]
+    [, oks [n, B]])``
+
+    ``counts[r, b]`` is the number of ``toks[r, :, b]`` rows the host
+    consumes for iteration ``r`` (0 once the lane is dead); ``oks``
+    (check_finite) is the per-iteration isfinite verdict, forced True
+    for masked lanes (a free-running dead lane's garbage must not
+    quarantine it)."""
+
+    def mega(params, cache, tok, temp, keys, active, eos, left, steps,
+             *lora_args):
+        lora = tuple(lora_args) if lora_args else None
+
+        def outer(carry, _):
+            cache, tok, live, lleft, lsteps = carry
+            p0 = cache["pos"]
+
+            def tick(c, _):
+                if check_finite:
+                    cache, tok, ok = c
+                else:
+                    cache, tok = c
+                logits, new_cache = _ring_forward(cfg, params, tok,
+                                                  cache, mesh=mesh,
+                                                  lora=lora)
+                nxt = _sample_tokens(logits, temp, keys, cache["pos"],
+                                     top_k, top_p)
+                new_cache["pos"] = jnp.where(live, new_cache["pos"], 0)
+                nxt = jnp.where(live, nxt, tok)
+                if check_finite:
+                    ok = ok & (jnp.all(jnp.isfinite(logits), axis=-1)
+                               | ~live)
+                    return (new_cache, nxt, ok), nxt
+                return (new_cache, nxt), nxt
+
+            if check_finite:
+                (cache, tok, ok), toks = jax.lax.scan(
+                    tick, (cache, tok, jnp.ones(tok.shape, bool)), None,
+                    length=chunk_tokens)
+            else:
+                (cache, tok), toks = jax.lax.scan(
+                    tick, (cache, tok), None, length=chunk_tokens)
+            raw = jnp.where(live, chunk_tokens, 0).astype(jnp.int32)
+            count, live2, left2, lsteps2 = _mega_continue(
+                toks, raw, live, lleft, lsteps, eos)
+            # a lane frozen THIS boundary keeps the position it earned
+            # (the tick zeroed it); lanes dead from the start stay at
+            # their (zeroed) entry position
+            cache["pos"] = jnp.where(live, cache["pos"], p0)
+            out = (toks, count, ok) if check_finite else (toks, count)
+            return (cache, tok, live2, left2, lsteps2), out
+
+        live0 = active & (left > 0) & (steps > 0)
+        if check_finite:
+            (cache, tok, _, _, _), (toks, counts, oks) = jax.lax.scan(
+                outer, (cache, tok, live0, left, steps), None,
+                length=n_steps)
+            return cache, tok, toks, counts, oks
+        (cache, tok, _, _, _), (toks, counts) = jax.lax.scan(
+            outer, (cache, tok, live0, left, steps), None,
+            length=n_steps)
+        return cache, tok, toks, counts
+
+    return jax.jit(mega, donate_argnums=(1,))
 
 
 def _splice_lane(ring: Dict[str, jax.Array], lane: Dict[str, jax.Array],
@@ -861,7 +1052,8 @@ class RingExecutor:
                  check_finite: bool = False,
                  kv_quant: str = "none",
                  host_cache_blocks: int = 0,
-                 adapters=None) -> None:
+                 adapters=None,
+                 megastep: int = 1) -> None:
         # many-adapter serving (ISSUE 10, infer/qos.py AdapterRegistry):
         # stacked LoRA deltas served off the one base param set.  The
         # registry's arrays ride every dispatch as trailing operands
@@ -945,6 +1137,13 @@ class RingExecutor:
             self.block_size = int(block_size)
             self.prefix_cache = False
             self.host_cache_blocks = 0
+        # device-resident megastep (ISSUE 11): SERVE_MEGASTEP fused
+        # ring iterations per dispatch.  Programs are compiled per N
+        # (megastep_prog) so the scheduler can drop to N=1 (the
+        # byte-identical oracle) at any time; ``megastep`` here is the
+        # configured default the prewarm compiles ahead.
+        self.megastep = max(1, int(megastep))
+        self._mega: Dict[int, Any] = {}
         self._suffix_inserts: Dict[int, Any] = {}
         # chunked-prefill compile caches: intermediate slice + final
         # insert programs, keyed by staging length (contiguous) or just
@@ -1089,6 +1288,92 @@ class RingExecutor:
             return ()
         return (self.adapters.arrays(),
                 jnp.full((1,), int(aid_val), jnp.int32))
+
+    # -- plan replay: the ONE resident dispatch path (ISSUE 11) ------------
+
+    def megastep_prog(self, n: int):
+        """The compiled N-fused-iteration program for this ring's mode
+        (contiguous / paged / quant / spec), compiled once per N."""
+        prog = self._mega.get(n)
+        if prog is None:
+            if self.spec_k:
+                from paddle_operator_tpu.infer.speculative import (
+                    make_spec_megastep,
+                )
+
+                prog = make_spec_megastep(
+                    self.cfg, self.draft_cfg, self.spec_k, n,
+                    self.top_k, self.top_p, mesh=self.mesh,
+                    paged=self.paged, quant=self.quant)
+            elif self.paged:
+                prog = self._pg.make_paged_megastep(
+                    self.cfg, self.chunk, n, self.top_k, self.top_p,
+                    mesh=self.mesh, check_finite=self.check_finite,
+                    quant=self.quant)
+            else:
+                prog = make_megastep(
+                    self.cfg, self.chunk, n, self.top_k, self.top_p,
+                    mesh=self.mesh, check_finite=self.check_finite)
+            self._mega[n] = prog
+        return prog
+
+    def replay(self, plan: ExecPlan) -> DispatchResult:
+        """THE plan replayer: execute one scheduler-filled
+        :class:`ExecPlan` against the ring's device state.  Every
+        resident decode dispatch — 1-step or fused — enters the device
+        through here, which is the seam the chaos injector wraps and
+        the watchdog brackets.  At ``n_steps == 1`` the dispatch is
+        operand-for-operand the pre-plan code path (the traced
+        programs are the SAME objects — ``self.step``/``self.spec_step``
+        — so pacing/chaos wrappers installed on them keep working and
+        the N=1 stream is byte-identical to the pre-refactor ring)."""
+        active = jnp.asarray(plan.active, bool)
+        tbl = jnp.asarray(plan.table) if plan.table is not None else None
+        if plan.n_steps == 1:
+            if self.spec_k:
+                spec_args = (self.params, self.draft_params, self.cache,
+                             self.dcache)
+                if self.paged:
+                    spec_args += (tbl,)
+                (self.cache, self.dcache, self.tok, toks,
+                 counts) = self.spec_step(
+                    *spec_args, self.tok, self.temp, self.keys, active)
+                return DispatchResult(toks, counts, None, counts, 1)
+            if self.paged:
+                out = self.step(self.params, self.cache, tbl, self.tok,
+                                self.temp, self.keys, active, *plan.lora)
+            else:
+                out = self.step(self.params, self.cache, self.tok,
+                                self.temp, self.keys, active, *plan.lora)
+            if self.check_finite:
+                self.cache, self.tok, toks, ok = out
+            else:
+                (self.cache, self.tok, toks), ok = out, None
+            return DispatchResult(toks, None, ok, None, 1)
+        prog = self.megastep_prog(plan.n_steps)
+        eos = jnp.asarray(plan.eos, jnp.int32)
+        left = jnp.asarray(plan.left, jnp.int32)
+        steps = jnp.asarray(plan.steps, jnp.int32)
+        if self.spec_k:
+            spec_args = (self.params, self.draft_params, self.cache,
+                         self.dcache)
+            if self.paged:
+                spec_args += (tbl,)
+            (self.cache, self.dcache, self.tok, toks, raw,
+             counts) = prog(*spec_args, self.tok, self.temp, self.keys,
+                            active, eos, left, steps)
+            return DispatchResult(toks, counts, None, raw, plan.n_steps)
+        if self.paged:
+            out = prog(self.params, self.cache, tbl, self.tok, self.temp,
+                       self.keys, active, eos, left, steps, *plan.lora)
+        else:
+            out = prog(self.params, self.cache, self.tok, self.temp,
+                       self.keys, active, eos, left, steps, *plan.lora)
+        if self.check_finite:
+            self.cache, self.tok, toks, counts, oks = out
+        else:
+            (self.cache, self.tok, toks, counts), oks = out, None
+        return DispatchResult(toks, counts, oks, None, plan.n_steps)
 
     # -- lazily-compiled admission programs --------------------------------
 
@@ -1435,6 +1720,28 @@ class RingExecutor:
             out = self.step(self.params, cache, tok, temp, keys, active,
                             *st)
             cache, tok = out[0], out[1]
+        if self.megastep > 1:
+            # the configured megastep program (ISSUE 11): without this
+            # the FIRST loaded moment after boot pays the N-step compile
+            prog = self.megastep_prog(self.megastep)
+            eos = jnp.full((slots,), -1, jnp.int32)
+            left = jnp.ones((slots,), jnp.int32)
+            stp = jnp.full((slots,), self.megastep, jnp.int32)
+            if self.spec_k:
+                args = (self.params, self.draft_params, cache, dcache)
+                if self.paged:
+                    args += (tbl,)
+                out = prog(*args, tok, temp, keys, active, eos, left,
+                           stp)
+                cache, dcache, tok = out[0], out[1], out[2]
+            elif self.paged:
+                out = prog(self.params, cache, tbl, tok, temp, keys,
+                           active, eos, left, stp, *st)
+                cache, tok = out[0], out[1]
+            else:
+                out = prog(self.params, cache, tok, temp, keys, active,
+                           eos, left, stp, *st)
+                cache, tok = out[0], out[1]
         for b in self.buckets:
             prompt = jnp.zeros((1, b), jnp.int32)
             if self.spec_k and self.paged:

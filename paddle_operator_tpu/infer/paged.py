@@ -1298,6 +1298,92 @@ def make_paged_chunk_step(cfg: LlamaConfig, chunk_tokens: int,
     return jax.jit(step, donate_argnums=(1,))
 
 
+def make_paged_megastep(cfg: LlamaConfig, chunk_tokens: int,
+                        n_steps: int, top_k: Optional[int] = None,
+                        top_p: Optional[float] = None, mesh=None,
+                        check_finite: bool = False,
+                        quant: bool = False):
+    """N fused PAGED ring iterations in one compiled dispatch
+    (ISSUE 11): ``make_paged_chunk_step``'s tick scanned ``n_steps``
+    chunks with the host's boundary decisions — eos, token budget,
+    step budget — carried on device (executor._mega_advance).  The
+    paged pool is what makes a mid-megastep finish SAFE without host
+    help: each fused chunk runs against an EFFECTIVE table whose dead
+    lanes' rows are replaced wholesale by the trash block (the same
+    redirect ``retire`` performs host-side by zeroing the row), so a
+    dead lane's free-running writes — pool rows, quantize-on-completion
+    commits, staging-tail rows (``active=live`` steers those to the
+    trash tail under quant) — can never touch a real block.  Its fill
+    position is restored from the pre-chunk snapshot at each boundary,
+    which is what makes a lane frozen by its STEP budget (deadline
+    ticks) resumable bit-identically in a later dispatch: its blocks,
+    tail and position are exactly as its last consumed token left them.
+
+    ``mega(params, cache, table, tok, temp, keys, active, eos, left,
+    steps, *lora) -> (cache', tok', toks [n, chunk, B], counts [n, B]
+    [, oks [n, B]])`` — the same output contract as
+    executor.make_megastep, table operand added."""
+    from paddle_operator_tpu.infer.executor import (
+        _mega_continue,
+        _sample_tokens,
+    )
+
+    def mega(params, cache, table, tok, temp, keys, active, eos, left,
+             steps, *lora_args):
+        lora = tuple(lora_args) if lora_args else None
+
+        def outer(carry, _):
+            cache, tok, live, lleft, lsteps = carry
+            p0 = cache["pos"]
+            tbl_eff = jnp.where(live[:, None], table, TRASH_BLOCK)
+
+            def tick(c, _):
+                if check_finite:
+                    cache, tok, ok = c
+                else:
+                    cache, tok = c
+                logits, new_cache = paged_ring_forward(
+                    cfg, params, tok, cache, tbl_eff, mesh=mesh,
+                    quant=quant, active=live if quant else None,
+                    lora=lora)
+                nxt = _sample_tokens(logits, temp, keys, cache["pos"],
+                                     top_k, top_p)
+                new_cache["pos"] = jnp.where(live, new_cache["pos"], 0)
+                nxt = jnp.where(live, nxt, tok)
+                if check_finite:
+                    ok = ok & (jnp.all(jnp.isfinite(logits), axis=-1)
+                               | ~live)
+                    return (new_cache, nxt, ok), nxt
+                return (new_cache, nxt), nxt
+
+            if check_finite:
+                (cache, tok, ok), toks = jax.lax.scan(
+                    tick, (cache, tok, jnp.ones(tok.shape, bool)), None,
+                    length=chunk_tokens)
+            else:
+                (cache, tok), toks = jax.lax.scan(
+                    tick, (cache, tok), None, length=chunk_tokens)
+            raw = jnp.where(live, chunk_tokens, 0).astype(jnp.int32)
+            count, live2, left2, lsteps2 = _mega_continue(
+                toks, raw, live, lleft, lsteps, eos)
+            cache["pos"] = jnp.where(live, cache["pos"], p0)
+            out = (toks, count, ok) if check_finite else (toks, count)
+            return (cache, tok, live2, left2, lsteps2), out
+
+        live0 = active & (left > 0) & (steps > 0)
+        if check_finite:
+            (cache, tok, _, _, _), (toks, counts, oks) = jax.lax.scan(
+                outer, (cache, tok, live0, left, steps), None,
+                length=n_steps)
+            return cache, tok, toks, counts, oks
+        (cache, tok, _, _, _), (toks, counts) = jax.lax.scan(
+            outer, (cache, tok, live0, left, steps), None,
+            length=n_steps)
+        return cache, tok, toks, counts
+
+    return jax.jit(mega, donate_argnums=(1,))
+
+
 def _scatter_prompt_blocks(pool: jax.Array, lane: jax.Array,
                            table_row: jax.Array,
                            block_size: int) -> jax.Array:

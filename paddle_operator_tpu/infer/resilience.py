@@ -203,6 +203,14 @@ class DispatchWatchdog:
         self._p95 = RollingQuantile(0.95)
         self._lock = threading.Lock()
         self._start: Optional[float] = None
+        # megastep awareness (ISSUE 11): a region covering N fused ring
+        # iterations legitimately takes ~N x a 1-step one.  Samples are
+        # NORMALIZED to per-iteration time at end() and the threshold
+        # multiplies back by the in-flight region's scale — so the p95
+        # stays meaningful across N changes and enabling SERVE_MEGASTEP
+        # cannot trip spurious stall rebuilds.  scale 1 (the default)
+        # is byte-identical to the pre-megastep watchdog.
+        self._scale = 1.0
         self._gen = 0                 # region id, so a stall fires once
         self._stalled_gen = -1
         self._hard_gen = -1
@@ -213,10 +221,13 @@ class DispatchWatchdog:
 
     # -- ring-thread side --------------------------------------------------
 
-    def begin(self) -> None:
+    def begin(self, scale: float = 1.0) -> None:
+        """``scale``: how many fused ring iterations this region covers
+        (SERVE_MEGASTEP; 1 for ordinary dispatches)."""
         with self._lock:
             self._gen += 1
             self._start = time.monotonic()
+            self._scale = max(1.0, float(scale))
 
     def end(self) -> None:
         with self._lock:
@@ -227,7 +238,7 @@ class DispatchWatchdog:
             # one 100s wedge would drag the threshold to factor x 100s
             # and blind the watchdog to every later stall
             if self._gen != self._stalled_gen:
-                self._p95.add(dur)
+                self._p95.add(dur / self._scale)   # per-iteration time
             self._start = None
 
     class _Watch:
@@ -247,10 +258,17 @@ class DispatchWatchdog:
     # -- monitor side ------------------------------------------------------
 
     def threshold(self) -> float:
+        """Stall threshold for the IN-FLIGHT region: the factor term
+        scales with the region's fused iteration count (its per-
+        iteration p95 budget x N); the floor stays absolute — it
+        guards first-dispatch compiles, which do not scale with N."""
+        with self._lock:
+            scale = self._scale
         p95 = self._p95.value()
         if p95 is None:
             return self.cfg.stall_floor_s
-        return max(self.cfg.stall_floor_s, self.cfg.stall_factor * p95)
+        return max(self.cfg.stall_floor_s,
+                   scale * self.cfg.stall_factor * p95)
 
     def _monitor(self) -> None:
         while not self._stop.wait(self.cfg.poll_s):

@@ -252,7 +252,8 @@ class ContinuousBatcher:
                  host_cache_blocks: int = 0,
                  resilience: Optional[RingResilience] = None,
                  qos: Optional[QOS.QoSConfig] = None,
-                 adapters: Optional[QOS.AdapterRegistry] = None) -> None:
+                 adapters: Optional[QOS.AdapterRegistry] = None,
+                 megastep: int = 1) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
                              f"{PREFILL_MODES}")
@@ -265,6 +266,21 @@ class ContinuousBatcher:
         self.max_len = max_len or cfg.max_seq_len
         self.chunk = chunk_tokens
         self.prefill_mode = prefill_mode
+        # device-resident megastep (ISSUE 11, SERVE_MEGASTEP): fuse N
+        # ring iterations into ONE compiled dispatch, with eos /
+        # token-budget / deadline-tick continuation carried on device.
+        # Admission, preemption, promotions, CoW and handoff attaches
+        # happen only at megastep boundaries; N=1 (default) dispatches
+        # the byte-identical legacy program (the oracle).
+        self.megastep = int(megastep)
+        if self.megastep < 1:
+            raise ValueError(f"megastep must be >= 1 (got {megastep})")
+        # rolling per-iteration wall estimate (EMA over consumed
+        # dispatches): the deadline-tick budget converts a request's
+        # remaining seconds into fused iterations with it.  0 = no
+        # estimate yet (deadlines then bind at megastep boundaries
+        # only, exactly like N=1 binds at chunk boundaries).
+        self._step_s_est = 0.0
         # fault tolerance (infer/resilience.py): with a RingResilience a
         # ring-level dispatch fault fails the RESIDENT requests with a
         # retriable 503 and rebuilds the ring from scratch (fresh
@@ -316,7 +332,8 @@ class ContinuousBatcher:
             num_blocks=num_blocks, prefix_cache=prefix_cache,
             prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
             check_finite=self._check_finite, kv_quant=kv_quant,
-            host_cache_blocks=host_cache_blocks, adapters=adapters)
+            host_cache_blocks=host_cache_blocks, adapters=adapters,
+            megastep=self.megastep)
         self.mesh = mesh
         self.paged = self.executor.paged
         self.kv_quant = self.executor.kv_quant
@@ -737,6 +754,13 @@ class ContinuousBatcher:
                                if self.adapters is not None else 0),
             "adapterNames": (self.adapters.names()
                              if self.adapters is not None else []),
+            # device-resident megastep (ISSUE 11): fused iterations per
+            # dispatch and the measured host-dispatch amortization —
+            # the tpujob_serve_megastep_n / _dispatches_per_token gauges
+            "megastepN": self.megastep,
+            "dispatchesPerToken": (
+                round(self.stats["chunks"] / self._tokens_emitted, 4)
+                if self._tokens_emitted else 0.0),
             # fault tolerance (infer/resilience.py): drain/rebuild
             # visibility for /readyz and the CRD's status.serving block
             "draining": self._draining,
@@ -1545,20 +1569,27 @@ class ContinuousBatcher:
             # re-verified — drop them so the prefix re-prefills clean
             self.pool.scrub_host_chain(req.prompt, ns=req.ns)
 
-    def _consume(self, chunk_reqs, toks, counts=None, ok=None) -> None:
+    def _consume(self, chunk_reqs, toks, counts=None, ok=None,
+                 spec_raw=None) -> None:
         """Apply one finished chunk's tokens ([chunk, slots] on host).
         ``chunk_reqs`` pins each lane to the REQUEST the chunk was
         dispatched for: under pipelining a lane may have been evicted
         (and even re-admitted) since dispatch — such in-flight tokens
         belong to the old request and are dropped.
 
-        ``counts`` (speculative mode): per-lane count of VALID rows in
-        ``toks`` — the variable accept-length advance.  Lane i takes
-        ``toks[:counts[i], i]`` (its accepted drafts + the correction
-        token); None means every row is valid (plain chunk mode).  The
-        budget/eos walk below is shared, so an eos landing mid-
-        speculated-block truncates exactly like one landing mid-chunk —
-        no tokens after eos ever reach the result or the stream.
+        ``counts`` (speculative mode, and every fused megastep
+        boundary): per-lane count of VALID rows in ``toks``.  Lane i
+        takes ``toks[:counts[i], i]``; None means every row is valid
+        (plain 1-step chunk mode).  The budget/eos walk below is
+        shared, so an eos landing mid-speculated-block truncates
+        exactly like one landing mid-chunk — no tokens after eos ever
+        reach the result or the stream.
+
+        ``spec_raw`` (speculative mode only): per-lane DEVICE commit
+        counts — the acceptance-telemetry numbers and the device
+        position advance (a megastep boundary's ``counts`` may be
+        eos/budget-truncated below it; a raw count of 0 marks a fused
+        round the lane sat out, which must not feed the stats).
 
         ``ok`` (nan_check mode): per-lane isfinite verdict for this
         chunk — a False lane is QUARANTINED: its request fails
@@ -1581,14 +1612,24 @@ class ContinuousBatcher:
                 continue
             self._materialize_first(i, req)
             n = toks.shape[0] if counts is None else int(counts[i])
-            # the host fill-position mirror advances exactly like the
-            # device pos (chunk ticks, or the spec round's commit count)
-            self._lane_pos[i] += n
-            if counts is not None:
+            if spec_raw is not None:
+                n_raw = int(spec_raw[i])
+                if n_raw == 0:
+                    continue    # fused round the (dead) lane sat out
+                # the host fill-position mirror advances like the
+                # device pos: the round's full commit count, even when
+                # the eos/budget walk below stops earlier (the lane is
+                # then evicted and its pos zeroed regardless)
+                self._lane_pos[i] += n_raw
                 self.stats["spec_drafted"] += self.spec_k
-                self.stats["spec_accepted"] += max(0, n - 1)
+                self.stats["spec_accepted"] += max(0, n_raw - 1)
                 req.drafted += self.spec_k
-                req.accepted += max(0, n - 1)
+                req.accepted += max(0, n_raw - 1)
+            else:
+                # plain chunks advance chunk ticks while the lane runs
+                # (a fused boundary's count is the device advance: full
+                # chunks while live, 0 once dead)
+                self._lane_pos[i] += n
             for t in toks[:n, i]:
                 if self._lane_left[i] <= 0:
                     break
@@ -1603,24 +1644,50 @@ class ContinuousBatcher:
                 self._evict(i)
 
     def _consume_oldest(self, pending: List[tuple]) -> None:
-        """Pop + apply the oldest in-flight chunk.  The blocking
-        device->host completion wait sits under the watchdog: a wedged
-        dispatch surfaces HERE on real chips (dispatches are async), and
-        the monitor fails the waiting clients while this thread is still
-        stuck."""
-        chunk_reqs, toks_dev, counts_dev, ok_dev = pending.pop(0)
+        """Pop + apply the oldest in-flight dispatch (one chunk, or one
+        megastep's N fused boundaries).  The blocking device->host
+        completion wait sits under the watchdog: a wedged dispatch
+        surfaces HERE on real chips (dispatches are async), and the
+        monitor fails the waiting clients while this thread is still
+        stuck.  The watchdog region scales with the dispatch's fused
+        iteration count — a legal N-step wait is ~N x a 1-step one."""
+        chunk_reqs, res, t0 = pending.pop(0)
         wd = self._watchdog
         if wd is not None:
-            wd.begin()
+            wd.begin(scale=res.n_steps)
         try:
-            toks = np.asarray(toks_dev)
-            counts = None if counts_dev is None else np.asarray(counts_dev)
-            ok = None if ok_dev is None else np.asarray(ok_dev)
+            toks = np.asarray(res.toks)
+            counts = None if res.counts is None else np.asarray(res.counts)
+            ok = None if res.ok is None else np.asarray(res.ok)
+            raw = None if res.raw is None else np.asarray(res.raw)
         finally:
             if wd is not None:
                 wd.end()
-        if self._fault is None:     # stall-failed chunks must not apply
-            self._consume(chunk_reqs, toks, counts, ok)
+        # per-iteration wall estimate for the deadline-tick budget:
+        # dispatch->consume covers the pipeline wait too, so the EMA
+        # overestimates — conservative (a lane freezes a little early
+        # and resumes next dispatch, never late)
+        per = (time.monotonic() - t0) / res.n_steps
+        self._step_s_est = (per if not self._step_s_est
+                            else 0.8 * self._step_s_est + 0.2 * per)
+        if self._fault is not None:
+            return              # stall-failed chunks must not apply
+        if res.n_steps == 1:
+            if self.spec_k:
+                self._consume(chunk_reqs, toks, counts=counts, ok=ok,
+                              spec_raw=counts)
+            else:
+                self._consume(chunk_reqs, toks, ok=ok)
+            return
+        # fused megastep: apply the N boundaries in order — each is
+        # exactly one 1-step consume, with the eos/budget walk the
+        # device precomputed (counts) and the spec telemetry counts
+        # (raw).  A lane evicted at boundary r drops out of rounds
+        # r+1.. through the chunk_reqs identity guard.
+        for r in range(res.n_steps):
+            self._consume(chunk_reqs, toks[r], counts=counts[r],
+                          ok=None if ok is None else ok[r],
+                          spec_raw=None if raw is None else raw[r])
 
     def _pending_prefill_slots(self) -> set:
         """Lanes reserved but not yet decode-active."""
@@ -1791,27 +1858,36 @@ class ContinuousBatcher:
             self.stats["max_active"] = max(self.stats["max_active"],
                                            len(active_idx))
 
-            tbl = None
+            n_mega = self.megastep
+            advance = (self.spec_k + 1) if self.spec_k else self.chunk
+            tbl_np = None
             if self.paged:
                 # on-demand block mapping: grow each active lane's table
                 # to cover this dispatch PLUS every chunk already in
                 # flight for it (the host pos mirror lags dispatched-
                 # but-unconsumed work; spec rounds advance a
-                # data-dependent 1..K+1, so the bound is the worst case).
+                # data-dependent 1..K+1, so the bound is the worst case;
+                # a fused megastep advances up to n_steps iterations,
+                # capped by the lane's own remaining token budget — the
+                # pipelining-aware projection extended to N steps).
                 # An UNDERSIZED pool (num_blocks oversubscription) can
                 # run dry mid-generation: only the lane that cannot
                 # grow fails — evicting it (its request resolves with
                 # the error) frees its blocks for the rest of the ring,
                 # which must keep serving.
-                advance = (self.spec_k + 1) if self.spec_k else self.chunk
                 for i in list(active_idx):
                     inflight = sum(
-                        1 for chunk_reqs, _, _, _ in pending
+                        entry_res.n_steps
+                        for chunk_reqs, entry_res, _ in pending
                         for j, r in chunk_reqs
                         if j == i and r is self.lane[i])
+                    left_i = max(1, self._lane_left[i])
+                    my_steps = (min(n_mega, left_i) if self.spec_k
+                                else min(n_mega, -(-left_i // self.chunk)))
                     try:
                         self.pool.ensure(
-                            i, self._lane_pos[i] + (inflight + 1) * advance)
+                            i, self._lane_pos[i]
+                            + (inflight + my_steps) * advance)
                     except self.executor._pg.NoFreeBlocks as e:
                         r = self.lane[i]
                         if r is not None and r.error is None:
@@ -1830,47 +1906,56 @@ class ContinuousBatcher:
                     tbl_np = tbl_np.copy()
                     tbl_np[sorted(prefill_pending)] = \
                         self.executor._pg.TRASH_BLOCK
-                tbl = jnp.asarray(tbl_np)
-            active = jnp.asarray(
+            # fill the plan (ISSUE 11): which lanes step, the table
+            # snapshot, the adapter tail, the fused iteration count and
+            # — N>1 — the per-lane continuation budgets the device
+            # carries across boundaries (eos id, remaining tokens, and
+            # the deadline-tick step budget)
+            eos_v = left_v = steps_v = None
+            if n_mega > 1:
+                eos_v = np.full((self.slots,), -1, np.int32)
+                left_v = np.zeros((self.slots,), np.int32)
+                steps_v = np.full((self.slots,), n_mega, np.int32)
+                now = time.monotonic()
+                for i in active_idx:
+                    r = self.lane[i]
+                    if r.eos is not None:
+                        eos_v[i] = int(r.eos)
+                    # the device budget EXCLUDES the admission-sampled
+                    # first token when it is still unmaterialized — the
+                    # host consumes it out of the same max_new
+                    left_v[i] = max(
+                        0, self._lane_left[i]
+                        - (1 if self._lane_first[i] is not None else 0))
+                    if (self.paged and r.deadline is not None
+                            and self._step_s_est > 0):
+                        # deadline-tick budget: stop the lane at the
+                        # boundary nearest its deadline instead of
+                        # free-running the whole megastep past it.
+                        # Paged only — a step-frozen lane resumes
+                        # through the trash-redirect invariants the
+                        # contiguous ring does not have.
+                        remaining = r.deadline - now
+                        steps_v[i] = max(1, min(
+                            n_mega, int(remaining / self._step_s_est)))
+            plan = X.ExecPlan(
+                n_mega,
                 [r is not None and i not in prefill_pending
-                 for i, r in enumerate(self.lane)], bool)
-            # async dispatch: returns device futures immediately.  The
-            # watchdog brackets it anyway — a chaos-injected host-side
-            # hang (and a synchronous-dispatch backend) wedges HERE —
-            # and any raise becomes a ring fault handled at the loop top
-            # (fail resident requests retriably, rebuild, back off).
+                 for i, r in enumerate(self.lane)],
+                table=tbl_np, lora=ex.lora_step_tail(),
+                eos=eos_v, left=left_v, steps=steps_v)
+            # async dispatch through THE plan replayer: returns device
+            # futures immediately.  The watchdog brackets it (scaled by
+            # the fused iteration count — a legal N-step dispatch is
+            # ~N x a 1-step one) — a chaos-injected host-side hang (and
+            # a synchronous-dispatch backend) wedges HERE — and any
+            # raise becomes a ring fault handled at the loop top (fail
+            # resident requests retriably, rebuild, back off).
             wd = self._watchdog
             if wd is not None:
-                wd.begin()
+                wd.begin(scale=n_mega)
             try:
-                ok_dev = None
-                if self.spec_k:
-                    spec_args = (ex.params, ex.draft_params,
-                                 ex.cache, ex.dcache)
-                    if self.paged:
-                        spec_args += (tbl,)
-                    (ex.cache, ex.dcache, ex.tok, toks_dev,
-                     counts_dev) = ex.spec_step(
-                        *spec_args, ex.tok, ex.temp, ex.keys,
-                        active)
-                elif self.paged:
-                    out = ex.step(
-                        ex.params, ex.cache, tbl, ex.tok,
-                        ex.temp, ex.keys, active, *ex.lora_step_tail())
-                    counts_dev = None
-                    if self._check_finite:
-                        ex.cache, ex.tok, toks_dev, ok_dev = out
-                    else:
-                        ex.cache, ex.tok, toks_dev = out
-                else:
-                    out = ex.step(
-                        ex.params, ex.cache, ex.tok, ex.temp,
-                        ex.keys, active, *ex.lora_step_tail())
-                    counts_dev = None
-                    if self._check_finite:
-                        ex.cache, ex.tok, toks_dev, ok_dev = out
-                    else:
-                        ex.cache, ex.tok, toks_dev = out
+                res = ex.replay(plan)
             except Exception as e:
                 self._fault = e
                 continue
@@ -1882,13 +1967,13 @@ class ContinuousBatcher:
             # by consume time the tokens are already on the wire and
             # np.asarray is a cheap completion wait instead of a full
             # round-trip on the ring's critical path
-            for dev in (toks_dev, counts_dev, ok_dev):
+            for dev in (res.toks, res.counts, res.ok, res.raw):
                 try:
                     dev.copy_to_host_async()
                 except AttributeError:  # None / interpret-mode ndarray
                     pass
             pending.append(([(i, self.lane[i]) for i in active_idx],
-                            toks_dev, counts_dev, ok_dev))
+                            res, time.monotonic()))
             if len(pending) >= self.pipeline_depth:
                 try:
                     self._consume_oldest(pending)

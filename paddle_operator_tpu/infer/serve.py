@@ -653,6 +653,23 @@ def main() -> int:
         if os.environ.get("SERVE_PREFILL_CHUNK"):
             ring_kw["prefill_chunk"] = int(
                 os.environ["SERVE_PREFILL_CHUNK"])
+        # SERVE_MEGASTEP=N (ISSUE 11, docs/serving.md "Megastep
+        # execution"): fuse N ring iterations into ONE compiled
+        # dispatch, with eos / token-budget / deadline-tick
+        # continuation carried on device — amortizes the Python
+        # dispatch tax ~N x on host-bound rings.  Admission,
+        # preemption, promotions and handoff attaches move to megastep
+        # boundaries, so a queued request can wait up to N iterations
+        # for a lane (the TTFT-granularity tradeoff; keep N=1, the
+        # byte-identical default, for latency-critical single-tenant
+        # rings).
+        # 0/unset = the server's single-step default (the CRD contract:
+        # spec.serving.megastep 0 means "server default", and an
+        # explicit SERVE_MEGASTEP=0 must disable fusion, not crash-loop
+        # the pod on the >=1 constructor validation)
+        megastep = int(os.environ.get("SERVE_MEGASTEP", "0") or 0)
+        if megastep > 1:
+            ring_kw["megastep"] = megastep
         # SERVE_PREWARM=0 opts out of the off-thread compile prewarm
         # (the first long prompt then pays the per-bucket insert
         # compile — the lazy-compile cliff the prewarm exists to hide)
@@ -736,6 +753,7 @@ def main() -> int:
           f"tp={tp}, spec_k={spec_k if continuous else 0}, "
           f"prefill={ring_kw.get('prefill_mode', 'inline') if continuous else '-'}, "
           f"kv_quant={ring_kw.get('kv_quant', 'none') if continuous else '-'}, "
+          f"megastep={ring_kw.get('megastep', 1) if continuous else '-'}, "
           f"mode={'continuous' if continuous else 'batch'}) on :{env.port}",
           flush=True)
     srv = make_server("0.0.0.0", env.port, params, cfg,

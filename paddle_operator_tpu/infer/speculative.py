@@ -488,6 +488,31 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
     overwritten before they become attendable, the standard rollback
     invariant).  Lanes whose frontier block never completed keep their
     live tail untouched."""
+    _round = _build_spec_round(cfg, dcfg, spec_k, top_k, top_p, mesh,
+                               paged, quant)
+
+    if paged:
+        def round_fn(params, dparams, tcache, dcache, table, tok, temp,
+                     keys, active):
+            return _round(params, dparams, tcache, dcache, tok, temp,
+                          keys, active, table)
+    else:
+        def round_fn(params, dparams, tcache, dcache, tok, temp, keys,
+                     active):
+            return _round(params, dparams, tcache, dcache, tok, temp,
+                          keys, active, None)
+
+    return jax.jit(round_fn, donate_argnums=(2, 3))
+
+
+def _build_spec_round(cfg, dcfg, spec_k, top_k, top_p, mesh, paged,
+                      quant):
+    """The RAW (un-jitted) speculative round body behind
+    :func:`make_spec_round_fn` — extracted so the megastep
+    (:func:`make_spec_megastep`) can scan it N times inside one
+    compiled program.  The op sequence is exactly what the jitted
+    1-round program traced before the extraction; nothing about the
+    round changed."""
     from paddle_operator_tpu.infer.executor import _ring_forward
 
     kk = spec_k
@@ -633,18 +658,77 @@ def make_spec_round_fn(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
                 (tcache2["kt"], tcache2["vt"]))
         return tcache2, dcache2, tok_out, committed.T, n_commit
 
-    if paged:
-        def round_fn(params, dparams, tcache, dcache, table, tok, temp,
-                     keys, active):
-            return _round(params, dparams, tcache, dcache, tok, temp,
-                          keys, active, table)
-    else:
-        def round_fn(params, dparams, tcache, dcache, tok, temp, keys,
-                     active):
-            return _round(params, dparams, tcache, dcache, tok, temp,
-                          keys, active, None)
+    return _round
 
-    return jax.jit(round_fn, donate_argnums=(2, 3))
+
+def make_spec_megastep(cfg: LlamaConfig, dcfg: LlamaConfig, spec_k: int,
+                       n_steps: int, top_k: Optional[int] = None,
+                       top_p: Optional[float] = None, mesh=None,
+                       paged: bool = False, quant: bool = False):
+    """N fused SPECULATIVE rounds in one compiled dispatch (ISSUE 11):
+    the raw round body (:func:`_build_spec_round`) scanned ``n_steps``
+    times with the host's between-round decisions — eos inside a
+    committed block, token budget, step budget — carried on device
+    (executor._mega_advance over each round's committed tokens).  A
+    lane that finishes mid-megastep free-runs masked: under paging its
+    verify writes go through an effective table whose row is replaced
+    by the trash block, its draft writes land past its frozen draft
+    frontier (the rows a rollback already leaves there), and both
+    positions are restored from the pre-round snapshot each boundary —
+    so a lane frozen by its STEP budget resumes bit-identically later.
+
+    ``mega(params, dparams, tcache, dcache[, table], tok, temp, keys,
+    active, eos, left, steps) -> (tcache', dcache', tok',
+    committed [n, K+1, B], raw [n, B], counts [n, B])``
+
+    ``raw[r, b]`` is the round's device commit count (the oracle's
+    acceptance-telemetry number; 0 for dead rounds), ``counts[r, b]``
+    the rows of ``committed[r, :, b]`` the host consumes (eos/budget
+    truncated — scheduler._consume's walk, precomputed)."""
+    from paddle_operator_tpu.infer.executor import _mega_continue
+    from paddle_operator_tpu.infer.paged import TRASH_BLOCK
+
+    _round = _build_spec_round(cfg, dcfg, spec_k, top_k, top_p, mesh,
+                               paged, quant)
+
+    def _mega(params, dparams, tcache, dcache, tok, temp, keys, active,
+              eos, left, steps, table):
+
+        def outer(carry, _):
+            tcache, dcache, tok, live, lleft, lsteps = carry
+            tp0, dp0 = tcache["pos"], dcache["pos"]
+            tbl_eff = (jnp.where(live[:, None], table, TRASH_BLOCK)
+                       if paged else None)
+            tcache, dcache, tok, committed, n_commit = _round(
+                params, dparams, tcache, dcache, tok, temp, keys, live,
+                tbl_eff)
+            count, live2, left2, lsteps2 = _mega_continue(
+                committed, n_commit, live, lleft, lsteps, eos)
+            # frozen/dead lanes keep the positions their last consumed
+            # token earned (the round zeroed them via the active mask)
+            tcache["pos"] = jnp.where(live, tcache["pos"], tp0)
+            dcache["pos"] = jnp.where(live, dcache["pos"], dp0)
+            return ((tcache, dcache, tok, live2, left2, lsteps2),
+                    (committed, n_commit, count))
+
+        live0 = active & (left > 0) & (steps > 0)
+        (tcache, dcache, tok, _, _, _), (committed, raws, counts) = \
+            jax.lax.scan(outer, (tcache, dcache, tok, live0, left, steps),
+                         None, length=n_steps)
+        return tcache, dcache, tok, committed, raws, counts
+
+    if paged:
+        def mega(params, dparams, tcache, dcache, table, tok, temp,
+                 keys, active, eos, left, steps):
+            return _mega(params, dparams, tcache, dcache, tok, temp,
+                         keys, active, eos, left, steps, table)
+    else:
+        def mega(params, dparams, tcache, dcache, tok, temp, keys,
+                 active, eos, left, steps):
+            return _mega(params, dparams, tcache, dcache, tok, temp,
+                         keys, active, eos, left, steps, None)
+
+    return jax.jit(mega, donate_argnums=(2, 3))
 
 
 @functools.lru_cache(maxsize=16)

@@ -192,6 +192,16 @@ def _serving_gauges_one(status_serving: dict, job: str,
             float(status_serving.get("hostHitRate", 0.0)),
         f"tpujob_serve_promoted_blocks_total{lbl}":
             float(status_serving.get("promotedBlocks", 0.0)),
+        # device-resident megastep (ISSUE 11, SERVE_MEGASTEP): fused
+        # ring iterations per compiled dispatch and the measured
+        # resident dispatches per emitted token — dispatches_per_token
+        # ~ 1/(N*chunk) when the fusion is doing its job, and a value
+        # drifting toward 1/chunk under N>1 means lanes are dying
+        # early (eos/deadline) and burning fused iterations masked
+        f"tpujob_serve_megastep_n{lbl}":
+            float(status_serving.get("megastepN", 0.0)),
+        f"tpujob_serve_dispatches_per_token{lbl}":
+            float(status_serving.get("dispatchesPerToken", 0.0)),
         # serving fault tolerance (infer/resilience.py): deadline
         # partials served, self-healing ring rebuilds, NaN-quarantined
         # lanes, and the drain flag (1 while the pod sheds admissions)

@@ -784,6 +784,43 @@ class TestWatchdogUnit:
         finally:
             wd.close()
 
+    def test_threshold_scales_with_megastep(self):
+        """Regression (ISSUE 11 satellite): a LEGAL N-step dispatch is
+        ~N x a 1-step one — without the scale-aware threshold, a p95
+        learned on 1-step dispatches would flag the first SERVE_MEGASTEP
+        dispatch as a stall and trigger a spurious rebuild."""
+        cfg = RingResilience(stall_factor=2.0, stall_floor_s=0.001,
+                             poll_s=10.0)
+        wd = DispatchWatchdog(cfg, lambda e: None)
+        try:
+            for _ in range(8):          # learned 1-step p95 ~ 0.1s
+                wd._p95.add(0.1)
+            wd.begin()                  # 1-step region: old behavior
+            assert wd.threshold() == pytest.approx(0.2)
+            wd.end()
+            wd.begin(scale=8)           # 8-step region
+            # a legal 8-step dispatch (~0.8s) sits well under the
+            # scaled threshold (8 x factor x p95 = 1.6s); the UNscaled
+            # threshold (0.2s) would have called it a stall
+            assert wd.threshold() == pytest.approx(1.6)
+            wd.end()
+        finally:
+            wd.close()
+
+    def test_scaled_regions_feed_per_iteration_p95(self):
+        """An N-step region's duration is normalized to per-iteration
+        time before entering the p95 — so the threshold stays correct
+        when SERVE_MEGASTEP changes (or drops back to 1) at runtime."""
+        cfg = RingResilience(poll_s=10.0)   # floor 60s: nothing stalls
+        wd = DispatchWatchdog(cfg, lambda e: None)
+        try:
+            wd.begin(scale=4)
+            wd._start = time.monotonic() - 0.4   # legal 4-step region
+            wd.end()
+            assert 0.05 < wd._p95.value() < 0.2  # ~0.1 per iteration
+        finally:
+            wd.close()
+
 
 class TestServingStatus:
     def test_status_and_gauges_carry_ft_fields(self, setup):

@@ -29,7 +29,8 @@ SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "hostCacheBlocks": 5, "hostHitRate": 0.12,
            "promotedBlocks": 42,
            "priorityQueueDepth": [1, 2], "preemptedLanes": 3,
-           "activeAdapters": 2, "adapterNames": ["acme", "zen"]}
+           "activeAdapters": 2, "adapterNames": ["acme", "zen"],
+           "megastepN": 4, "dispatchesPerToken": 0.0313}
 
 
 class TestGaugeNaming:
@@ -75,6 +76,11 @@ class TestGaugeNaming:
                  '{job="default/j",adapter="acme"}'] == 1.0
         assert g['tpujob_serve_adapter_loaded'
                  '{job="default/j",adapter="zen"}'] == 1.0
+        # device-resident megastep gauges (ISSUE 11): fused iterations
+        # per dispatch + measured host-dispatch amortization
+        assert g['tpujob_serve_megastep_n{job="default/j"}'] == 4.0
+        assert g['tpujob_serve_dispatches_per_token'
+                 '{job="default/j"}'] == 0.0313
 
     def test_prefill_mode_label_defaults_inline(self):
         g = serving_gauges({}, "ns/x")
@@ -116,6 +122,9 @@ class TestGaugeNaming:
             '{job="default/j",prio="1"}',
             'tpujob_serve_lane_preemptions_total{job="default/j"}',
             'tpujob_serve_active_adapters{job="default/j"}',
+            # megastep shape (ISSUE 11)
+            'tpujob_serve_megastep_n{job="default/j"}',
+            'tpujob_serve_dispatches_per_token{job="default/j"}',
             'tpujob_serve_adapter_loaded'
             '{job="default/j",adapter="acme"}',
             'tpujob_serve_adapter_loaded'
@@ -286,6 +295,8 @@ class TestBatcherServingStatus:
                            "priorityQueueDepth", "preemptedLanes",
                            "parkedLanes", "activeAdapters",
                            "adapterNames",
+                           # megastep block (ISSUE 11)
+                           "megastepN", "dispatchesPerToken",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
@@ -298,6 +309,8 @@ class TestBatcherServingStatus:
         assert st["priorityQueueDepth"] == [0, 0]   # 2 classes default
         assert st["preemptedLanes"] == 0
         assert st["activeAdapters"] == 0       # no registry by default
+        assert st["megastepN"] == 1            # single-step default
+        assert st["dispatchesPerToken"] > 0
         assert st["kvPoolBytes"] > 0
         assert st["tokensTotal"] == 4
         assert st["tokensPerSec"] > 0
