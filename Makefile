@@ -62,7 +62,7 @@ bench:
 # (all training parallelism axes, plus the serving parity lines:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
 # serve-disagg, serve-kvquant, serve-hostcache, serve-fleet,
-# serve-qos, serve-megastep, ft-drain)
+# serve-qos, serve-megastep, serve-fleetkv, ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

@@ -1508,6 +1508,183 @@ def _fold_fleet_summary(rows, summary, emit) -> None:
                 / max(on[top]["fleet_ttft_p50_ms"], 1e-9), 2)
 
 
+def measure_fleet_kv(*, drain_new_tokens=240, step_delay_s=0.04,
+                     n_groups=4, prefix_blocks=2, block_size=8,
+                     suffix_len=4) -> list:
+    """Fleet-level KV sweep (ISSUE 12): what migrating KV between
+    replicas buys over the pod-local baseline.
+
+    **Drain cells** (migrate on x quant off/on, plus the
+    completion-wait control): two in-process replicas behind the real
+    router, two long-budget residents on the victim, and the measured
+    number is the DRAIN WALL TIME — SIGTERM to every resident
+    resolved.  With migration the victim parks at one chunk boundary
+    and POSTs envelopes (~1 chunk + 1 RTT per lane); without it the
+    drain waits out every completion.  The resident step carries a
+    deliberate per-dispatch delay, the measure_megastep trick: an
+    idle-box tiny model decodes its whole budget in milliseconds,
+    which is not the regime the drain bar describes — production
+    completions take seconds to minutes, and the delay recreates that
+    shape while keeping the migrate path's cost honest (its spill,
+    encode, POST and restore are all real).  Each migrate row also
+    reports the measured LANE ENVELOPE wire bytes — int8 pool lanes
+    ship codes + scale planes at roughly half the bf16 bytes.
+
+    **Peer-fetch cells** (fetch on / off): tenant prefixes warmed on
+    replica A and pressure-demoted to its host tier, then ONE
+    first-of-group request per tenant lands on cold replica B (the
+    affinity-spillover shape).  With peer fetch those admissions
+    host-hit the fetched blocks; without, they re-prefill from
+    scratch — the reported rate is B's prefix hit rate over exactly
+    those spilled first requests."""
+    import time as _time
+
+    import numpy as _np
+
+    from paddle_operator_tpu.router.simfleet import SimFleet
+    from paddle_operator_tpu.utils import fleetkv as FK
+
+    rows = []
+
+    def throttle(b, delay):
+        real = b._step
+
+        def slow(*a, **k):
+            _time.sleep(delay)
+            return real(*a, **k)
+
+        b._step = slow
+
+    def record_wire(b, sizes):
+        orig = b.migrate_out
+
+        def wrapped(meta, spill):
+            sizes.append(len(FK.encode_lane(meta, spill)))
+            return orig(meta, spill)
+
+        b.migrate_out = wrapped
+
+    # -- drain cells -------------------------------------------------------
+    for migrate, kv_quant in ((True, "none"), (True, "int8"),
+                              (False, "none")):
+        extra = {"host_cache_blocks": 16}
+        if kv_quant != "none":
+            extra["kv_quant"] = kv_quant
+        fleet = SimFleet(2, fleet_kv=migrate, slots=2,
+                         max_len=16 + drain_new_tokens + 8,
+                         prefill_buckets=(16,), ring_extra=extra)
+        try:
+            victim = fleet.replicas[0].batcher
+            sizes = []
+            for rep in fleet.replicas:
+                throttle(rep.batcher, step_delay_s)
+                if migrate and rep.batcher.migrate_out is not None:
+                    record_wire(rep.batcher, sizes)
+            handles = [victim.submit(
+                list(range(1, 13)), max_new_tokens=drain_new_tokens,
+                request_id=f"fkv-{kv_quant}-{i}/row0")
+                for i in range(2)]
+            # let both lanes go resident before the SIGTERM
+            deadline = _time.monotonic() + 60
+            while victim.stats["chunks"] < 2:
+                assert _time.monotonic() < deadline
+                _time.sleep(0.005)
+            t0 = _time.perf_counter()
+            fleet.drain_replica(0, budget_s=600)
+            drain_s = _time.perf_counter() - t0
+            del handles
+            rows.append({
+                "fleetkv_cell": "drain",
+                "fleetkv_migrate": migrate,
+                "fleetkv_kv_quant": kv_quant,
+                "fleetkv_drain_s": round(drain_s, 3),
+                "fleetkv_residents": 2,
+                "fleetkv_budget_tokens": drain_new_tokens,
+                "fleetkv_step_delay_s": step_delay_s,
+                "fleetkv_lane_wire_bytes": (int(_np.mean(sizes))
+                                            if sizes else 0),
+                "fleetkv_migrations": (
+                    fleet.router.counters["migrations_brokered"]),
+            })
+        finally:
+            fleet.close()
+
+    # -- peer-fetch cells --------------------------------------------------
+    bs = block_size
+    for fetch in (True, False):
+        fleet = SimFleet(2, fleet_kv=False, slots=2, num_blocks=8,
+                         block_size=bs, prefill_buckets=(16, 64),
+                         ring_extra={"host_cache_blocks": 64})
+        try:
+            if fetch:
+                fleet.enable_fleet_kv(migrate=False, peer_fetch=True)
+            A = fleet.replicas[0].batcher
+            B = fleet.replicas[1].batcher
+            rng = _np.random.default_rng(9)
+            groups = []
+            for g in range(n_groups):
+                prefix = [int(t) for t in rng.integers(
+                    1, 250, (prefix_blocks * bs,))]
+                groups.append(prefix)
+                # warm A then pressure-demote the chain to host
+                A.submit(prefix + [int(t) for t in rng.integers(
+                    1, 250, (suffix_len,))],
+                    max_new_tokens=2).result(timeout=600)
+            filler = [int(t) for t in rng.integers(1, 250, (56,))]
+            A.submit(filler, max_new_tokens=2).result(timeout=600)
+            assert A.pool.stats["host_demotions"] >= 1
+            lk0 = B.pool.stats["prefix_lookup_tokens"]
+            ht0 = B.pool.stats["prefix_hit_tokens"]
+            for g, prefix in enumerate(groups):
+                # the spillover shape: first-of-group lands COLD on B
+                B.submit(prefix + [int(t) for t in rng.integers(
+                    1, 250, (suffix_len,))],
+                    max_new_tokens=2,
+                    request_id=f"spill-{g}/row0").result(timeout=600)
+            lk = B.pool.stats["prefix_lookup_tokens"] - lk0
+            ht = B.pool.stats["prefix_hit_tokens"] - ht0
+            rows.append({
+                "fleetkv_cell": "peer_fetch",
+                "fleetkv_fetch": fetch,
+                "fleetkv_spill_hit_rate": round(ht / max(lk, 1), 4),
+                "fleetkv_peer_fetches": B.stats[
+                    "peer_prefix_fetches"],
+                "fleetkv_blocks_imported": B.pool.stats[
+                    "peer_blocks_imported"],
+            })
+        finally:
+            fleet.close()
+    return rows
+
+
+def _fold_fleet_kv_summary(rows, summary, emit) -> None:
+    for entry in rows if isinstance(rows, list) else [rows]:
+        emit("fleetkv_sweep", entry)
+    if not isinstance(rows, list):
+        return
+    drain = {(r["fleetkv_migrate"], r["fleetkv_kv_quant"]): r
+             for r in rows if r.get("fleetkv_cell") == "drain"}
+    mig = drain.get((True, "none"))
+    wait = drain.get((False, "none"))
+    if mig and wait and mig.get("fleetkv_drain_s"):
+        # the headline: drain-by-migration vs completion-wait
+        summary["fleetkv_drain_latency_ratio"] = round(
+            wait["fleetkv_drain_s"] / mig["fleetkv_drain_s"], 2)
+    q = drain.get((True, "int8"))
+    if mig and q and mig.get("fleetkv_lane_wire_bytes"):
+        summary["fleetkv_wire_bytes_ratio_int8"] = round(
+            q["fleetkv_lane_wire_bytes"]
+            / mig["fleetkv_lane_wire_bytes"], 3)
+    fetch = {r["fleetkv_fetch"]: r for r in rows
+             if r.get("fleetkv_cell") == "peer_fetch"}
+    if True in fetch:
+        summary["fleetkv_spill_hit_rate"] = \
+            fetch[True]["fleetkv_spill_hit_rate"]
+    if False in fetch:
+        summary["fleetkv_spill_hit_rate_cold"] = \
+            fetch[False]["fleetkv_spill_hit_rate"]
+
+
 def _fold_disagg_summary(disagg, summary, emit) -> None:
     """Emit the prefill-mode sweep rows and fold the acceptance keys:
     chunked/disagg cold-TTFT p95 and the disagg decode-throughput
@@ -2290,6 +2467,14 @@ def main() -> int:
     # (fleet_tok_s_ratio_4x / fleet_affinity_hit_rate summary keys)
     _fold_fleet_summary(guarded("fleet", lambda: measure_fleet()),
                         summary, emit)
+
+    # fleet-level KV sweep (ISSUE 12): drain-by-migration wall time vs
+    # completion-wait (fleetkv_drain_latency_ratio), int8 vs bf16 lane
+    # envelope wire bytes, and the spilled-traffic prefix hit rate
+    # with/without peer fetch (fleetkv_spill_hit_rate[_cold])
+    _fold_fleet_kv_summary(guarded("fleetkv",
+                                   lambda: measure_fleet_kv()),
+                           summary, emit)
 
     latency = guarded("latency", measure_submit_latency)
     # submit->ConfigMap anomaly guard, same rationale as first_step_s:

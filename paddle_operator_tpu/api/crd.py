@@ -248,6 +248,17 @@ def _spec_schema() -> Dict[str, Any]:
                     # iterations per compiled dispatch (SERVE_MEGASTEP;
                     # 0/unset = the server's single-step default)
                     "megastep": _int(0),
+                    # fleet-level KV (ISSUE 12): drain-by-migration +
+                    # router-brokered lane migration
+                    # (SERVE_KV_MIGRATE), peer prefix fetch from the
+                    # hashring owner's host tier (SERVE_KV_PEER_FETCH
+                    # — needs hostCacheMb), the per-replica host spill
+                    # tier size (SERVE_HOST_CACHE_MB), and the parked-
+                    # lane migration patience (SERVE_MIGRATE_PARKED_S)
+                    "kvMigration": {"type": "boolean"},
+                    "peerPrefixFetch": {"type": "boolean"},
+                    "hostCacheMb": _int(0),
+                    "migrateParkedS": {"type": "number", "minimum": 0},
                 },
             },
             "tpu": {
@@ -333,9 +344,11 @@ def _status_schema() -> Dict[str, Any]:
             # (ISSUE 9): per-replica blocks under ``replicas`` plus
             # the reconciler-owned ``fleet`` sub-block
             # (replicasDesired/replicasReady/routerReady/
-            # drainedReplicas/replicaRestarts) — schemaless on purpose
-            # (preserve-unknown-fields) so the workload can grow
-            # telemetry without a CRD rev.
+            # drainedReplicas/replicaRestarts) — and the fleet-level
+            # KV keys (ISSUE 12): laneMigrations, adoptedLanes,
+            # peerPrefixFetches, hostCacheEvictions — schemaless on
+            # purpose (preserve-unknown-fields) so the workload can
+            # grow telemetry without a CRD rev.
             "serving": {
                 "type": "object",
                 "x-kubernetes-preserve-unknown-fields": True,

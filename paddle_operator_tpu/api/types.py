@@ -315,6 +315,23 @@ class ServingSpec:
       it amortizes the per-dispatch host tax ~N x at the cost of
       admission/preemption granularity (a queued request waits up to
       N iterations for a lane — docs/serving.md has the tradeoff).
+
+    Fleet-level KV (ISSUE 12, docs/serving.md "Fleet-level KV"):
+
+    - ``kv_migration``     drain-by-migration + router-brokered lane
+      migration: a scale-down victim's resident lanes spill and POST
+      to a peer instead of waiting out completions (completion-wait
+      stays the fallback) -> SERVE_KV_MIGRATE + SERVE_KV_BROKER (the
+      fleet service, injected);
+    - ``peer_prefix_fetch``  a replica whose radix walk misses asks
+      the prefix's hashring owner for demoted blocks and promotes
+      them through the host-hit path -> SERVE_KV_PEER_FETCH (needs a
+      host tier — size one with ``host_cache_mb``);
+    - ``host_cache_mb``    host-RAM spill tier size per replica (the
+      ISSUE 8 hierarchical cache) -> SERVE_HOST_CACHE_MB;
+    - ``migrate_parked_s`` preemption-parked lanes older than this
+      also migrate to an idle peer OUTSIDE a drain (0 disables) ->
+      SERVE_MIGRATE_PARKED_S.
     """
 
     replicas: int = 1
@@ -329,6 +346,10 @@ class ServingSpec:
     adapter_rank: int = 0
     max_adapters: int = 0
     megastep: int = 0
+    kv_migration: Optional[bool] = None
+    peer_prefix_fetch: Optional[bool] = None
+    host_cache_mb: int = 0
+    migrate_parked_s: float = 0.0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"replicas": self.replicas}
@@ -354,6 +375,14 @@ class ServingSpec:
             d["maxAdapters"] = self.max_adapters
         if self.megastep:
             d["megastep"] = self.megastep
+        if self.kv_migration is not None:
+            d["kvMigration"] = self.kv_migration
+        if self.peer_prefix_fetch is not None:
+            d["peerPrefixFetch"] = self.peer_prefix_fetch
+        if self.host_cache_mb:
+            d["hostCacheMb"] = self.host_cache_mb
+        if self.migrate_parked_s:
+            d["migrateParkedS"] = self.migrate_parked_s
         return d
 
     @classmethod
@@ -375,6 +404,13 @@ class ServingSpec:
             adapter_rank=int(d.get("adapterRank", 0)),
             max_adapters=int(d.get("maxAdapters", 0)),
             megastep=int(d.get("megastep", 0)),
+            kv_migration=(bool(d["kvMigration"])
+                          if d.get("kvMigration") is not None else None),
+            peer_prefix_fetch=(bool(d["peerPrefixFetch"])
+                               if d.get("peerPrefixFetch") is not None
+                               else None),
+            host_cache_mb=int(d.get("hostCacheMb", 0)),
+            migrate_parked_s=float(d.get("migrateParkedS", 0.0)),
         )
 
 

@@ -633,6 +633,25 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
         # device-resident megastep (ISSUE 11): fused iterations per
         # compiled dispatch — spec.serving.megastep -> SERVE_MEGASTEP
         _env_setdefault(env, "SERVE_MEGASTEP", str(sv.megastep))
+    # fleet-level KV (ISSUE 12): spec knobs -> SERVE_* surface.  The
+    # broker is the fleet's stable client Service — it fronts the
+    # router pod, whose /v1/kv/migrate picks adopters from its scrape
+    # directory and whose /v1/kv/prefix forwards to the hashring owner.
+    if sv.kv_migration or sv.peer_prefix_fetch:
+        _env_setdefault(env, "SERVE_KV_BROKER",
+                        f"{job.name}-{RESOURCE_SERVE}:{sv.port}")
+    if sv.kv_migration is not None:
+        _env_setdefault(env, "SERVE_KV_MIGRATE",
+                        "1" if sv.kv_migration else "0")
+    if sv.peer_prefix_fetch is not None:
+        _env_setdefault(env, "SERVE_KV_PEER_FETCH",
+                        "1" if sv.peer_prefix_fetch else "0")
+    if sv.host_cache_mb:
+        _env_setdefault(env, "SERVE_HOST_CACHE_MB",
+                        str(sv.host_cache_mb))
+    if sv.migrate_parked_s:
+        _env_setdefault(env, "SERVE_MIGRATE_PARKED_S",
+                        str(sv.migrate_parked_s))
     if job.spec.checkpoint_path:
         _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
                         job.spec.checkpoint_path)

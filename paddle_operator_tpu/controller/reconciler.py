@@ -825,7 +825,19 @@ class TPUJobReconciler:
 
     def _drain_serve_victim(self, job: TPUJob, raw: Dict[str, Any],
                             pod: Dict[str, Any]) -> Result:
-        """One step of the scale-down drain for a single victim pod."""
+        """One step of the scale-down drain for a single victim pod.
+
+        The pod-side protocol is MIGRATION-FIRST when
+        ``spec.serving.kvMigration`` is on (ISSUE 12): the victim's
+        ServingDrain parks its resident lanes at a dispatch boundary
+        and POSTs their spill envelopes to peers through the router,
+        so the drain completes in roughly one chunk + one RTT per lane
+        instead of waiting out every completion; lanes no peer adopts
+        fall back to the classic completion-wait inside
+        SERVE_DRAIN_BUDGET_S.  The operator-side steps here — advance
+        notice, SIGTERM via delete, exit-83 preempted accounting — are
+        IDENTICAL either way; only the latency collapses
+        (docs/fault-tolerance.md "Drain by migration")."""
         meta = pod["metadata"]
         phase = pod.get("status", {}).get("phase", "")
         if meta.get("deletionTimestamp"):

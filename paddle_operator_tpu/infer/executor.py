@@ -1513,7 +1513,15 @@ class RingExecutor:
         only reads.  This is the generic preemption/handoff primitive
         ROADMAP items 4 (priority preemption) and 5 (hot swap via lane
         handoff) consume — tested for exactness in
-        tests/test_hostcache.py."""
+        tests/test_hostcache.py.
+
+        The capture is plain host bytes on purpose: ISSUE 12 wraps it
+        in a self-describing wire envelope (utils/fleetkv.encode_lane)
+        and a PEER replica restores it through this same
+        spill-dict contract (``ContinuousBatcher.adopt``) —
+        cross-replica lane migration is this method plus HTTP.  The
+        gather is full (unsharded) host bytes, so a tp=1 spill may
+        restore onto a tp=2 ring: the promote scatter re-shards."""
         pm = self.pool
         m = pm.mapped_count[slot]
         ids = jnp.asarray([int(pm.table[slot][j]) for j in range(m)],

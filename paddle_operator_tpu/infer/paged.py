@@ -220,6 +220,13 @@ class HostCacheTier:
         self.stats["promoted"] += 1
         return payload
 
+    def peek(self, key) -> Optional[Dict[str, Any]]:
+        """Read a payload WITHOUT removing it — the peer prefix-fetch
+        export (ISSUE 12): cross-replica fetch is a COPY (the wire
+        serializer np.asarray's the values), so the one-canonical-
+        location rule above still holds within this replica."""
+        return self._data.get(key)
+
     def drop(self, key) -> None:
         self._data.pop(key, None)
 
@@ -310,6 +317,10 @@ class PagedCacheManager:
             # payloads (the hostHitRate numerator)
             "host_demotions": 0, "host_promotions": 0,
             "host_hit_tokens": 0,
+            # fleet-level KV (ISSUE 12): demoted blocks imported from a
+            # PEER replica's host tier (they promote through the normal
+            # host-hit path on the next admission)
+            "peer_blocks_imported": 0,
         }
 
     # -- allocation --------------------------------------------------------
@@ -756,6 +767,97 @@ class PagedCacheManager:
                 self._drop_host_entry(key)
                 dropped += 1
         return dropped
+
+    # -- fleet-level KV: peer prefix export/import (ISSUE 12) --------------
+
+    def host_evictions(self) -> int:
+        """Cumulative dropped-oldest tier overflows — previously
+        invisible (the ``tpujob_serve_host_cache_evictions_total``
+        gauge)."""
+        return (self.host.stats["overflow_drops"]
+                if self.host is not None else 0)
+
+    def export_host_chain(self, prompt, ns: int = 0):
+        """The peer-fetch EXPORT: walk ``prompt``'s radix chain and
+        collect every HOST-resident (demoted) full block along it —
+        ``(chunks, block_idx, payloads)`` where ``chunks`` lists EVERY
+        full block's tokens from the chain start (the importer needs
+        them to recompute parent keys) and ``block_idx``/``payloads``
+        the demoted subset that actually travels.  Device-resident
+        blocks are skipped but the walk continues: the importer may
+        already hold the head locally, in which case a host-resident
+        tail alone completes its chain.  Only demoted payloads ship —
+        device blocks would need a ring-thread fetch against buffers
+        the resident step donates, and host bytes are already exactly
+        what the importer's promote path uploads.
+
+        Called from an HTTP handler thread while the ring thread
+        mutates the radix — callers must treat any exception as
+        "nothing to export" (the serve handler returns 204)."""
+        if self.host is None:
+            return [], [], []
+        tokens = tuple(int(t) for t in prompt)
+        bs = self.bs
+        chunks = []
+        block_idx = []
+        payloads = []
+        key = self._root_key(ns)
+        for j in range(len(tokens) // bs):
+            chunk = tokens[j * bs:(j + 1) * bs]
+            key = self._chain_key(key, chunk)
+            e = self.entries.get(key)
+            if e is None or e.chunk != chunk:
+                break               # chain cold from here on
+            chunks.append(list(chunk))
+            if e.block is None:
+                payload = self.host.peek(key)
+                if payload is not None:
+                    block_idx.append(j)
+                    payloads.append(payload)
+        return chunks, block_idx, payloads
+
+    def import_host_blocks(self, chunks, block_idx, payloads,
+                           ns: int = 0) -> int:
+        """The peer-fetch IMPORT (ring thread only): insert fetched
+        demoted payloads into OUR host tier + radix, exactly as if this
+        replica had demoted them — the next admission's radix walk
+        host-hits them and promotes through the normal batched upload
+        (byte-exact, the ISSUE 8 path).  Keys already present (device-
+        or host-resident) are left alone; tier overflow drops the
+        oldest as usual.  Returns the number of blocks imported."""
+        if self.host is None or not self.prefix_cache:
+            return 0
+        bs = self.bs
+        keys = []
+        key = self._root_key(ns)
+        for chunk in chunks:
+            if len(chunk) != bs:
+                return 0            # malformed: full blocks only
+            key = self._chain_key(key, tuple(int(t) for t in chunk))
+            keys.append(key)
+        imported = 0
+        for j, payload in zip(block_idx, payloads):
+            if not 0 <= j < len(keys) or keys[j] in self.entries:
+                continue
+            if j and keys[j - 1] not in self.entries:
+                # _lookup walks the chain from the root and stops at
+                # the first missing key: a block whose parent is
+                # present neither locally nor in this import would be
+                # UNREACHABLE — stored host bytes no admission could
+                # ever hit.  (Earlier imported blocks are already in
+                # self.entries, so contiguous imports chain through.)
+                continue
+            parent = keys[j - 1] if j else self._root_key(ns)
+            chunk = tuple(int(t) for t in chunks[j])
+            e = _CacheEntry(keys[j], None, chunk, parent)
+            self.entries[keys[j]] = e
+            self.children.setdefault(parent, set()).add(keys[j])
+            for dropped in self.host.put(keys[j], payload,
+                                         pinned=self._pinned_host_keys):
+                self._drop_host_entry(dropped)
+            imported += 1
+        self.stats["peer_blocks_imported"] += imported
+        return imported
 
     def device_table(self) -> jax.Array:
         return jnp.asarray(self.table)

@@ -88,6 +88,17 @@ class LaneQuarantined(RetriableError):
     chip) often do not reproduce."""
 
 
+class LaneMigrated(RetriableError):
+    """This lane migrated to a peer replica (ISSUE 12 fleet-level KV:
+    drain-by-migration or parked-lane shed).  The peer resumes the
+    stream bit-identically from the spilled bytes; the client's retry
+    — same idempotent ``request_id``, through the router — lands on
+    the adopter and collects the FULL result (the router's migration
+    table pins the id to the adopter before this error is ever
+    raised).  serve.py maps it to 503 + ``Retry-After`` like every
+    retriable."""
+
+
 # ---------------------------------------------------------------------------
 # Config
 # ---------------------------------------------------------------------------

@@ -33,6 +33,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -43,6 +44,7 @@ from paddle_operator_tpu.infer import executor as X
 from paddle_operator_tpu.infer import qos as QOS
 from paddle_operator_tpu.infer.resilience import (
     DispatchWatchdog,
+    LaneMigrated,
     LaneQuarantined,
     RestartBudget,
     RetriableError,
@@ -79,7 +81,8 @@ class _Request:
                  "done", "out", "error", "_stream", "_cancel",
                  "dev_prompt", "bucket", "accepted", "drafted",
                  "deadline", "deadline_exceeded",
-                 "priority", "adapter", "adapter_idx", "ns", "preempts")
+                 "priority", "adapter", "adapter_idx", "ns", "preempts",
+                 "request_id", "migrate_state")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
                  wants_stream=False, deadline=None):
@@ -112,6 +115,12 @@ class _Request:
         self.adapter_idx = 0
         self.ns = 0
         self.preempts = 0
+        # fleet-level KV (ISSUE 12): the client's idempotent id (the
+        # migration retrieval key) and this request's migration state —
+        # None (never offered), "inflight" (envelope on the wire) or
+        # "failed" (peer refused; never re-offered, resumes locally)
+        self.request_id: Optional[str] = None
+        self.migrate_state: Optional[str] = None
         # padded prompt, transferred to device on the SUBMIT thread
         # (batcher.submit): on relayed chips a host->device copy costs a
         # full round-trip, and paying it on the decode-ring thread
@@ -190,7 +199,8 @@ class _ParkedLane:
     mirrors a restore re-attaches — the request itself stays
     unresolved, invisible to the client except as latency."""
 
-    __slots__ = ("req", "spill", "out", "left", "pos", "seq")
+    __slots__ = ("req", "spill", "out", "left", "pos", "seq",
+                 "migrating", "t_parked")
 
     def __init__(self, req, spill, out, left, pos, seq):
         self.req = req
@@ -199,6 +209,10 @@ class _ParkedLane:
         self.left = left        # remaining token budget
         self.pos = pos          # fill position at the spill boundary
         self.seq = seq          # park order — FIFO within a class
+        # fleet-level KV (ISSUE 12): envelope on the wire to a peer —
+        # the restore path must not resume a lane mid-migration
+        self.migrating = False
+        self.t_parked = time.monotonic()
 
 
 class ContinuousBatcher:
@@ -375,6 +389,30 @@ class ContinuousBatcher:
         self._parked: List[_ParkedLane] = []
         self._preempt_budget = QOS.PreemptionBudget(
             self.qos.preempt_budget, self.qos.preempt_window_s)
+        # fleet-level KV (ISSUE 12).  ``migrate_out(meta, spill)`` —
+        # wired by serve.py to a utils/fleetkv.FleetKVClient — offers a
+        # parked lane's envelope to the fleet (router-brokered);
+        # ``peer_fetch(tokens, ns)`` asks the fleet for demoted prefix
+        # blocks.  Both default None = the pod-local pre-fleet ring.
+        self.migrate_out = None
+        self.peer_fetch = None
+        # drain-by-migration: SIGTERM/scale-down drain parks residents
+        # and migrates them out instead of waiting out completions
+        # (completion-wait remains the fallback for lanes no peer takes)
+        self._migrate_on_drain = False
+        # parked lanes older than this migrate to an idle peer even
+        # outside a drain (None/<=0 disables)
+        self.migrate_parked_s: Optional[float] = None
+        # cross-thread handoffs, all drained by the ring loop: lanes
+        # adopted FROM peers (HTTP thread -> loop), migration-attempt
+        # completions (worker thread -> loop), and fetched peer prefix
+        # payloads awaiting radix import (submit thread -> loop)
+        self._adopt_q: "queue.Queue[_ParkedLane]" = queue.Queue()
+        self._migr_done: "queue.Queue[tuple]" = queue.Queue()
+        self._host_imports: "queue.Queue[tuple]" = queue.Queue()
+        # chains already asked of the fleet (hit or miss) — a cold
+        # prefix must not trigger one fetch per request in a burst
+        self._peer_fetch_seen: "OrderedDict[Any, bool]" = OrderedDict()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self.stats = {"admitted": 0, "evicted": 0, "chunks": 0,
@@ -383,6 +421,12 @@ class ContinuousBatcher:
                       # more urgent work and spilled lanes resumed —
                       # the tpujob_serve_lane_preemptions_total gauge
                       "preempted_lanes": 0, "restored_lanes": 0,
+                      # fleet-level KV (ISSUE 12): lanes migrated OUT
+                      # to a peer (completed handoffs), lanes adopted
+                      # IN from peers, and prefix chains fetched from
+                      # a peer's host tier
+                      "lane_migrations": 0, "adopted_lanes": 0,
+                      "peer_prefix_fetches": 0,
                       "spec_accepted": 0, "spec_drafted": 0,
                       # prefill accounting: the prefix-cache acceptance
                       # gate — a full prefix hit admits with ZERO
@@ -649,6 +693,20 @@ class ContinuousBatcher:
         req.adapter = adapter
         req.adapter_idx = adapter_idx
         req.ns = adapter_ns if adapter_idx else 0
+        req.request_id = request_id
+        # fleet-level KV (ISSUE 12): a cold prefix may be warm in a
+        # PEER's host tier — fetch its demoted blocks now, on the
+        # caller's thread, so the admission below host-hits them.
+        # Base-namespace chains only: adapter namespaces are salted
+        # per-LOAD per-replica, so their chain keys never agree across
+        # pods by design.
+        if (self.peer_fetch is not None and req.ns == 0
+                and self.pool is not None
+                and self.pool.host is not None):
+            try:
+                self._maybe_peer_fetch(prompt)
+            except Exception:
+                pass    # fetch is an optimization, never a failure
         # pad + ship the prompt to the device HERE, on the caller's
         # thread — see _Request.dev_prompt
         req.bucket = self._bucket_for(len(prompt))
@@ -750,6 +808,17 @@ class ContinuousBatcher:
             "priorityQueueDepth": self._pending.qsize_by_class(),
             "preemptedLanes": self.stats["preempted_lanes"],
             "parkedLanes": len(self._parked),
+            # fleet-level KV (ISSUE 12): lanes migrated out / adopted
+            # in, peer prefix-chain fetches, and the previously
+            # invisible host-tier dropped-oldest overflows — the
+            # tpujob_serve_lane_migrations_total /
+            # _peer_prefix_fetches_total / _host_cache_evictions_total
+            # gauges
+            "laneMigrations": self.stats["lane_migrations"],
+            "adoptedLanes": self.stats["adopted_lanes"],
+            "peerPrefixFetches": self.stats["peer_prefix_fetches"],
+            "hostCacheEvictions": (self.pool.host_evictions()
+                                   if self.pool is not None else 0),
             "activeAdapters": (len(self.adapters)
                                if self.adapters is not None else 0),
             "adapterNames": (self.adapters.names()
@@ -926,9 +995,16 @@ class ContinuousBatcher:
                 self._evict(i)        # resolves with the partial tokens
         # parked lanes keep their deadline semantics: an expired one
         # resolves with the tokens it had at the spill boundary (the
-        # same 504-style partial a resident gets)
+        # same 504-style partial a resident gets).  A lane whose
+        # envelope is ON THE WIRE is left alone until the outcome
+        # lands: expiring it here while a peer adopts would deliver a
+        # 504 partial the dedupe LRU records as final AND decode the
+        # full stream on the adopter (the deadline travels in the
+        # envelope, so the adopter enforces it after a success).
         for pk in list(self._parked):
             req = pk.req
+            if pk.migrating:
+                continue
             if (req.deadline is not None and now >= req.deadline
                     and not req.done.is_set()):
                 req.deadline_exceeded = True
@@ -1404,10 +1480,14 @@ class ContinuousBatcher:
 
     def _best_parked(self) -> Optional[_ParkedLane]:
         """The parked lane that should resume next: most urgent class
-        first, then park order (FIFO within a class)."""
-        if not self._parked:
+        first, then park order (FIFO within a class).  Lanes whose
+        envelope is on the wire to a peer (ISSUE 12) are not
+        restorable — resuming one locally while a peer adopts it would
+        decode the same stream twice."""
+        candidates = [p for p in self._parked if not p.migrating]
+        if not candidates:
             return None
-        return min(self._parked, key=lambda p: (p.req.priority, p.seq))
+        return min(candidates, key=lambda p: (p.req.priority, p.seq))
 
     def _waiting_class(self) -> Optional[int]:
         """Most urgent class with WAITING work (queued head or parked
@@ -1502,6 +1582,273 @@ class ContinuousBatcher:
         self._lane_first[slot] = None
         self.stats["restored_lanes"] += 1
         return True
+
+    # -- fleet-level KV: migration + peer prefix fetch (ISSUE 12) ----------
+
+    def _fingerprint(self) -> Dict[str, Any]:
+        """The ring geometry an envelope must match byte-layout-wise.
+        tp is deliberately ABSENT: spills are full host bytes (the
+        capture gathers across shards) and restores re-shard through
+        the promote scatter, so a tp=1 lane may adopt onto a tp=2 ring
+        and vice versa."""
+        ex = self.executor
+        return {"layers": int(self.cfg.n_layers),
+                "kvHeads": int(self.cfg.n_kv_heads),
+                "headDim": int(self.cfg.head_dim),
+                "blockSize": int(ex.block_size),
+                "quant": ex.kv_quant,
+                "specK": int(ex.spec_k)}
+
+    def _migration_meta(self, pk: _ParkedLane) -> Dict[str, Any]:
+        """The JSON half of a lane envelope: request identity + stream
+        state + the ring fingerprint the adopter validates against."""
+        req = pk.req
+        return {"requestId": req.request_id,
+                "prompt": [int(t) for t in req.prompt],
+                "out": [int(t) for t in pk.out],
+                "left": int(pk.left),
+                "maxNew": int(req.max_new),
+                "temperature": float(req.temperature),
+                "seed": int(req.seed),
+                "eos": req.eos,
+                "priority": int(req.priority),
+                "adapter": req.adapter,
+                # the REMAINING deadline budget travels (absolute
+                # monotonic stamps are process-local): the adopter
+                # re-anchors it, so a migrated lane keeps the PR 10
+                # 504-partial-at-deadline contract
+                "deadlineS": (round(req.deadline - time.monotonic(), 3)
+                              if req.deadline is not None else None),
+                "fingerprint": self._fingerprint()}
+
+    def adopt(self, meta: Dict[str, Any],
+              spill: Dict[str, Any]) -> _Request:
+        """Adopt a migrated lane from a peer (the ``/v1/kv/restore``
+        entry point, called on an HTTP handler thread): validate the
+        envelope against THIS ring, re-resolve the adapter by name,
+        and park it — the ring loop re-admits it through the exact
+        promote-scatter + attach path a local preemption uses, so the
+        resumed stream is bit-identical to the unmigrated one.
+        Raises :class:`~paddle_operator_tpu.utils.fleetkv.
+        EnvelopeError` (409 upstream) on any mismatch — a refused
+        migration falls back to completion-wait at the origin, never
+        to a corrupted lane here."""
+        from paddle_operator_tpu.utils import fleetkv as FK
+
+        if self.pool is None:
+            raise FK.EnvelopeError(
+                "lane adoption requires the paged ring (the spill is "
+                "block-granular); this replica is contiguous")
+        FK.check_fingerprint(meta, self._fingerprint())
+        if self._draining or self._stop.is_set() or not self.healthy:
+            raise ShuttingDown("replica not accepting migrations")
+        left = int(meta["left"])
+        if left <= 0:
+            raise FK.EnvelopeError(
+                "migrated lane has no remaining token budget")
+        ex = self.executor
+        m = int(spill["n_blocks"])
+        exp = (self.cfg.n_layers, m, self.cfg.n_kv_heads,
+               ex.block_size, self.cfg.head_dim)
+        for name in ("k", "v"):
+            if tuple(spill[name].shape) != exp:
+                raise FK.EnvelopeError(
+                    f"lane payload {name} shape "
+                    f"{tuple(spill[name].shape)} != expected {exp}")
+        if ex.quant and not all(k in spill for k in
+                                ("ks", "vs", "kt", "vt")):
+            raise FK.EnvelopeError(
+                "int8 ring: lane envelope missing scale/tail planes")
+        if ex.spec_k and not all(k in spill for k in
+                                 ("dk", "dv", "dpos")):
+            raise FK.EnvelopeError(
+                "speculative ring: lane envelope missing draft lane")
+        adapter = meta.get("adapter")
+        aidx = ns = 0
+        if adapter:
+            if self.adapters is None:
+                raise FK.EnvelopeError(
+                    f"adapter {adapter!r} is not served here "
+                    "(no registry)")
+            try:
+                aidx, ns = self.adapters.resolve_ns(adapter)
+            except ValueError as e:
+                raise FK.EnvelopeError(str(e)) from None
+        prompt = [int(t) for t in meta["prompt"]]
+        out = [int(t) for t in meta.get("out", ())]
+        dl = meta.get("deadlineS")
+        req = _Request(prompt,
+                       int(meta.get("maxNew", left + len(out))),
+                       float(meta.get("temperature", spill["temp"])),
+                       int(meta.get("seed", 0)), meta.get("eos"),
+                       deadline=(time.monotonic() + max(0.0, float(dl))
+                                 if dl is not None else None))
+        req.priority = min(max(0, int(meta.get(
+            "priority", self.qos.default_priority))),
+            self.qos.priorities - 1)
+        req.adapter = adapter
+        req.adapter_idx = aidx
+        req.ns = ns if aidx else 0
+        req.request_id = meta.get("requestId")
+        spill = dict(spill)
+        # adapter SLOT ids are replica-local: re-stamp with OUR slot
+        if self.adapters is not None:
+            spill["aid"] = aidx
+        else:
+            spill.pop("aid", None)
+        pk = _ParkedLane(req, spill, out, left, int(spill["pos"]), 0)
+        self.stats["adopted_lanes"] += 1
+        self._adopt_q.put(pk)
+        self._wake.set()
+        return req
+
+    def _maybe_peer_fetch(self, prompt) -> None:
+        """Submit-thread half of peer prefix fetch: when the prompt's
+        full-block chain is not fully covered locally, ask the fleet
+        (one bounded HTTP round-trip on the CALLER's thread — never
+        the ring's) for demoted payloads and queue them for radix
+        import at the next loop pass, so this request's admission
+        host-hits them."""
+        from paddle_operator_tpu.utils import fleetkv as FK
+        from paddle_operator_tpu.utils.radixkey import chain_key
+
+        pool = self.pool
+        bs = pool.bs
+        tokens = [int(t) for t in prompt]
+        n_full = len(tokens) // bs
+        if n_full == 0:
+            return
+        keys: List[Any] = []
+        key = None
+        for j in range(n_full):
+            key = chain_key(key, tuple(tokens[j * bs:(j + 1) * bs]))
+            keys.append(key)
+        tail = keys[-1]
+        if tail in self._peer_fetch_seen:
+            self._peer_fetch_seen.move_to_end(tail)
+            return
+        self._peer_fetch_seen[tail] = True
+        while len(self._peer_fetch_seen) > 1024:
+            self._peer_fetch_seen.popitem(last=False)
+        # local coverage probe — a racy read against the ring thread's
+        # radix mutations; any surprise is caught by submit's except
+        # and the fetch simply skipped
+        covered = 0
+        for k in keys:
+            if pool.entries.get(k) is None:
+                break
+            covered += 1
+        if covered >= n_full:
+            return
+        buf = self.peer_fetch(tokens, 0)
+        if not buf:
+            return
+        meta, chunks, idx, payloads = FK.decode_prefix(buf)
+        FK.check_fingerprint(meta, self._fingerprint())
+        if not idx:
+            return
+        self._host_imports.put((chunks, idx, payloads, 0))
+        self.stats["peer_prefix_fetches"] += 1
+        self._wake.set()
+
+    def _kick_migration(self, pk: _ParkedLane) -> None:
+        """Offer one parked lane to the fleet on a side thread (the
+        POST must never stall the ring)."""
+        pk.migrating = True
+        pk.req.migrate_state = "inflight"
+        threading.Thread(target=self._migrate_worker, args=(pk,),
+                         daemon=True, name="kv-migrate").start()
+
+    def _migrate_worker(self, pk: _ParkedLane) -> None:
+        ok = False
+        try:
+            ok = bool(self.migrate_out(self._migration_meta(pk),
+                                       pk.spill))
+        except Exception:
+            ok = False
+        self._migr_done.put((pk, ok))
+        self._wake.set()
+
+    def _pump_fleetkv(self, pending: List[tuple]) -> None:
+        """One loop pass of fleet-KV work: land adopted lanes in the
+        parked list, apply migration-attempt outcomes, import fetched
+        peer prefix payloads, and — draining with migration on, or a
+        parked lane past its patience — offer lanes to the fleet."""
+        # the ring loop is the ONLY consumer of these queues, so the
+        # empty() pre-checks (cheap, no exception) are race-free
+        while not self._adopt_q.empty():
+            pk = self._adopt_q.get_nowait()
+            if self._stop.is_set() or self._draining:
+                # raced shutdown: the adopter promised nothing yet —
+                # fail retriably so the client's next retry re-routes
+                self._finish(pk.req, ShuttingDown(
+                    "replica shut down before the adopted lane ran"))
+                continue
+            self._admit_seq += 1
+            pk.seq = self._admit_seq
+            self._parked.append(pk)
+        while not self._migr_done.empty():
+            pk, ok = self._migr_done.get_nowait()
+            if pk not in self._parked:
+                continue    # healed/cancelled away mid-flight
+            if ok:
+                self._parked.remove(pk)
+                self.stats["lane_migrations"] += 1
+                pk.req.migrate_state = "done"
+                self._finish(pk.req, LaneMigrated(
+                    "lane migrated to a peer replica; retry with the "
+                    "same request_id to collect the result"))
+            else:
+                # peer refused / unreachable: resume locally, never
+                # re-offer (completion-wait is the drain fallback)
+                pk.req.migrate_state = "failed"
+                pk.migrating = False
+        while not self._host_imports.empty():
+            chunks, idx, payloads, ns = self._host_imports.get_nowait()
+            if self.pool is not None:
+                try:
+                    self.pool.import_host_blocks(chunks, idx, payloads,
+                                                 ns=ns)
+                except Exception:
+                    pass    # an import is an optimization, never a fault
+        if self.migrate_out is None:
+            return
+        drain_migrate = (self._draining and self._migrate_on_drain
+                         and self.pool is not None)
+        if drain_migrate:
+            # park every resident decode lane at THE boundary (all
+            # in-flight chunks consumed, device state and host mirrors
+            # agree) so its spill captures exactly the consumed stream
+            prefill_pending = self._pending_prefill_slots()
+            todo = [i for i, r in enumerate(self.lane)
+                    if r is not None and i not in prefill_pending
+                    and not r.done.is_set() and not r._cancel
+                    and r.migrate_state is None and r._stream is None
+                    and r.request_id is not None]
+            if todo:
+                try:
+                    while pending:
+                        self._consume_oldest(pending)
+                except Exception as e:
+                    self._fault = e
+                    return
+                for i in todo:
+                    r = self.lane[i]
+                    if (r is not None and not r.done.is_set()
+                            and r.migrate_state is None):
+                        self._preempt(i)
+        now = time.monotonic()
+        for pk in list(self._parked):
+            r = pk.req
+            if (pk.migrating or r.migrate_state is not None
+                    or r.request_id is None or r._stream is not None
+                    or r._cancel or r.done.is_set()):
+                continue
+            if drain_migrate or (
+                    self.migrate_parked_s is not None
+                    and self.migrate_parked_s > 0
+                    and now - pk.t_parked >= self.migrate_parked_s):
+                self._kick_migration(pk)
 
     def _loop(self) -> None:
         try:
@@ -1721,6 +2068,12 @@ class ContinuousBatcher:
                 # with ShuttingDown (clients retry another replica)
                 self._shed_queue(ShuttingDown(
                     "server draining; retry another replica"))
+            # fleet-level KV (ISSUE 12): adopted lanes land, migration
+            # outcomes apply, peer prefix payloads import, and — when
+            # draining with migration on — residents park + offer out
+            self._pump_fleetkv(pending)
+            if self._fault is not None:
+                continue
             self._expire_deadlines()
             # cancelled lanes leave at the chunk boundary: the request
             # resolves with whatever tokens it has, the lane frees for
@@ -1730,8 +2083,12 @@ class ContinuousBatcher:
                 if r is not None and r._cancel:
                     self._evict(i)
             # parked lanes honor cancel too — a disconnect-abandoned
-            # preempted request must not wait for a free lane to die
+            # preempted request must not wait for a free lane to die.
+            # Mid-migration lanes wait for the wire outcome first
+            # (the _expire_deadlines rationale)
             for pk in list(self._parked):
+                if pk.migrating:
+                    continue
                 if pk.req._cancel or pk.req.done.is_set():
                     self._parked.remove(pk)
                     if not pk.req.done.is_set():

@@ -109,6 +109,44 @@ class ContinuousGenerator:
 
         self.batcher = ContinuousBatcher(params, cfg, **ring_kw)
         self.cfg = cfg
+        # fleet-level KV (ISSUE 12): lanes adopted from peers, keyed by
+        # the migrated request's idempotent row id — the client's retry
+        # (routed here by the router's migration table) collects the
+        # result instead of re-generating.  Bounded: an unclaimed
+        # handle is dropped oldest-first (its client gave up).
+        self.adopted: "OrderedDict[str, Any]" = OrderedDict()
+        self._adopted_lock = threading.Lock()
+
+    ADOPTED_CAP = 512
+
+    def adopt_envelope(self, buf: bytes) -> str:
+        """Decode + adopt one migrated-lane envelope; returns the
+        adopted request id.  Raises fleetkv.EnvelopeError on any
+        validation failure (the handler maps it to 409)."""
+        from paddle_operator_tpu.utils import fleetkv as FK
+
+        meta, spill = FK.decode_lane(buf)
+        rid = meta.get("requestId")
+        if not rid:
+            raise FK.EnvelopeError(
+                "lane envelope carries no requestId — the result "
+                "would be unretrievable")
+        handle = self.batcher.adopt(meta, spill)
+        with self._adopted_lock:
+            old = self.adopted.pop(rid, None)
+            if old is not None:
+                old.cancel()    # replayed migration: one runner only
+            self.adopted[rid] = handle
+            while len(self.adopted) > self.ADOPTED_CAP:
+                _, stale = self.adopted.popitem(last=False)
+                stale.cancel()
+        return rid
+
+    def take_adopted(self, rid: Optional[str]):
+        if rid is None:
+            return None
+        with self._adopted_lock:
+            return self.adopted.pop(rid, None)
 
     def __call__(self, tokens: np.ndarray, *, max_new_tokens: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
@@ -145,13 +183,21 @@ class ContinuousGenerator:
         reqs = []
         try:
             for i, row in enumerate(tokens):
-                reqs.append(self.batcher.submit(
-                    row, max_new_tokens=max_new_tokens,
-                    temperature=temperature, seed=seed + i,
-                    eos_token=eos_token, deadline_s=deadline_s,
-                    priority=priority, adapter=adapter,
-                    request_id=(f"{request_id}/row{i}"
-                                if request_id is not None else None)))
+                rid_row = (f"{request_id}/row{i}"
+                           if request_id is not None else None)
+                # fleet-level KV (ISSUE 12): a row whose lane migrated
+                # HERE is already decoding (or done) — collect it
+                # instead of re-generating; rows without an adopted
+                # lane submit as always
+                handle = self.take_adopted(rid_row)
+                if handle is None:
+                    handle = self.batcher.submit(
+                        row, max_new_tokens=max_new_tokens,
+                        temperature=temperature, seed=seed + i,
+                        eos_token=eos_token, deadline_s=deadline_s,
+                        priority=priority, adapter=adapter,
+                        request_id=rid_row)
+                reqs.append(handle)
             # ragged rows: sequences stop at eos, no rectangular array
             rows = [r.result(timeout=600) for r in reqs]
         except Exception:
@@ -395,6 +441,74 @@ class _Handler(BaseHTTPRequestHandler):
         except OSError as e:
             self._send(400, {"error": f"adapter file: {e}"})
 
+    def _kv_restore(self, body: bytes) -> None:
+        """POST /v1/kv/restore — adopt a migrated lane (ISSUE 12).
+        The body is a fleetkv LANE envelope; a valid one parks the
+        lane for restore at the next loop boundary and the client's
+        request_id-keyed retry collects the result.  Any validation
+        failure refuses the WHOLE envelope: 409 tells the origin to
+        keep the lane (completion-wait fallback)."""
+        from paddle_operator_tpu.infer.resilience import ShuttingDown
+        from paddle_operator_tpu.utils.fleetkv import EnvelopeError
+
+        gen = self.generator
+        if not isinstance(gen, ContinuousGenerator):
+            self._send(400, {"error": "lane adoption requires the "
+                                      "continuous server"})
+            return
+        if self.state is not None and self.state.draining:
+            self._send(503, {"error": "draining"},
+                       headers={"Retry-After":
+                                self.state.retry_after_s})
+            return
+        try:
+            rid = gen.adopt_envelope(body)
+            self._send(200, {"adopted": rid})
+        except ShuttingDown as e:
+            self._send(503, {"error": str(e)})
+        except EnvelopeError as e:
+            self._send(409, {"error": str(e)})
+        except Exception as e:      # noqa: BLE001 — refuse, never crash
+            self._send(400, {"error": str(e)})
+
+    def _kv_prefix(self, body: bytes) -> None:
+        """POST /v1/kv/prefix — export demoted blocks of a prompt's
+        radix chain (ISSUE 12 peer prefix fetch).  200 + a PREFIX
+        envelope when the host tier holds any of the chain; 204
+        otherwise.  The radix is ring-thread state and this runs on a
+        handler thread: any racy surprise degrades to 204 (the
+        requester re-prefills cold, exactly as without the fetch)."""
+        b = self._batcher()
+        try:
+            req = json.loads(body)
+            tokens = [int(t) for t in req["tokens"]]
+            ns = int(req.get("ns", 0))
+            if (b is None or b.pool is None or ns != 0
+                    or b.pool.host is None):
+                raise LookupError
+            chunks, idx, payloads = b.pool.export_host_chain(tokens,
+                                                             ns=0)
+            if not idx:
+                raise LookupError
+            from paddle_operator_tpu.utils import fleetkv as FK
+
+            # materialize lazily-demoted device slices to numpy HERE
+            # (jax arrays are immutable — a concurrent read is safe)
+            payloads = [{k: np.asarray(v) for k, v in p.items()}
+                        for p in payloads]
+            buf = FK.encode_prefix({"fingerprint": b._fingerprint()},
+                                   chunks, idx, payloads)
+        except Exception:       # noqa: BLE001 — nothing to export
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(buf)))
+        self.end_headers()
+        self.wfile.write(buf)
+
     def do_POST(self):
         from paddle_operator_tpu.infer.resilience import (
             RetriableError,
@@ -405,6 +519,10 @@ class _Handler(BaseHTTPRequestHandler):
         # an unread body would be parsed as the next request's start line
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n) if n else b""
+        if self.path == "/v1/kv/restore":
+            return self._kv_restore(body)
+        if self.path == "/v1/kv/prefix":
+            return self._kv_prefix(body)
         if self.path == "/v1/adapters":
             return self._adapters_admin(body)
         if self.path != "/v1/generate":
@@ -523,6 +641,56 @@ def make_server(host: str, port: int, params: Any, cfg: LlamaConfig,
     # resilience.ServingDrain flips state.draining on SIGTERM
     srv.state = state
     return srv
+
+
+def wire_fleet_kv_from_env(batcher, port: int) -> None:
+    """Fleet-level KV client wiring (ISSUE 12, docs/serving.md
+    "Fleet-level KV"): ``SERVE_KV_MIGRATE=1`` drains by MIGRATION
+    (residents spill + POST to a peer instead of waiting out
+    completions; completion-wait stays the fallback for lanes no peer
+    takes), ``SERVE_KV_PEER_FETCH=1`` asks the fleet for demoted
+    prefix blocks on a local radix miss.  ``SERVE_KV_BROKER`` names
+    the router (it picks adopters + dedupes replayed migrations);
+    ``SERVE_KV_PEERS`` is the router-less static peer list.
+    ``SERVE_MIGRATE_PARKED_S`` additionally sheds preemption-parked
+    lanes to idle peers OUTSIDE a drain.  Everything here requires
+    the paged ring (spills are block-granular); peer fetch further
+    needs the host tier (imports land there and promote through the
+    host-hit path).  Shared by the real entrypoint and the simfleet
+    subprocess replicas."""
+    import os
+
+    kv_migrate = os.environ.get("SERVE_KV_MIGRATE", "0") == "1"
+    kv_fetch = os.environ.get("SERVE_KV_PEER_FETCH", "0") == "1"
+    if not (kv_migrate or kv_fetch):
+        return
+    if batcher.pool is None:
+        print("SERVE_KV_MIGRATE/SERVE_KV_PEER_FETCH ignored: "
+              "fleet-level KV requires the paged ring (SERVE_PAGED=1)",
+              flush=True)
+        return
+    from paddle_operator_tpu.utils import fleetkv as FK
+
+    origin = f"{os.environ.get('POD_IP', '127.0.0.1')}:{port}"
+    kv_client = FK.FleetKVClient(
+        broker=os.environ.get("SERVE_KV_BROKER", ""),
+        peers=os.environ.get("SERVE_KV_PEERS", "").split(","),
+        origin=origin)
+    if kv_migrate:
+        batcher.migrate_out = lambda meta, spill: \
+            kv_client.migrate_out(FK.encode_lane(meta, spill))
+        batcher._migrate_on_drain = True
+        parked_s = float(os.environ.get("SERVE_MIGRATE_PARKED_S",
+                                        "0") or 0)
+        if parked_s > 0:
+            batcher.migrate_parked_s = parked_s
+    if kv_fetch:
+        if batcher.pool.host is None:
+            print("SERVE_KV_PEER_FETCH ignored: peer payloads import "
+                  "through the host tier — set "
+                  "SERVE_HOST_CACHE_BLOCKS/_MB", flush=True)
+        else:
+            batcher.peer_fetch = kv_client.fetch_prefix
 
 
 def main() -> int:
@@ -779,6 +947,7 @@ def main() -> int:
         # TPUJOB_CHAOS: deterministic fault injection on the live ring
         # (smoke-testing a deployment's resilience end-to-end)
         maybe_install_from_env(batcher)
+        wire_fleet_kv_from_env(batcher, env.port)
     watcher = PreemptionWatcher.install()
     drain = ServingDrain(
         srv, srv.state, batcher=batcher,

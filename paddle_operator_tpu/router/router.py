@@ -37,6 +37,18 @@ sheds; the client retries (client/client.py).  The retry carries the
 same idempotent ``request_id``, and the router remembers completed
 results (bounded LRU) — a retry that raced the original's completion
 replays the recorded response instead of generating twice.
+
+Fleet-level KV brokering (ISSUE 12, docs/serving.md "Fleet-level
+KV"): ``POST /v1/kv/migrate`` places a draining/parked lane's wire
+envelope on the best ready peer (fewest parked lanes, then
+least-loaded; origin excluded) and records ``request_id -> adopter``
+so the client's retry routes there (``X-Router-Reason: migrated``);
+replayed migrations answer from the table without re-forwarding.
+``POST /v1/kv/prefix`` forwards a peer-prefix-fetch ask to the
+prompt's hashring affinity owner — the same placement rule that put
+the prefix's traffic (and therefore its cached blocks) there.  The
+router only peeks envelope headers (utils/fleetkv.peek_header) and
+relays raw bytes; it stays jax-free.
 """
 
 from __future__ import annotations
@@ -65,6 +77,11 @@ _GAUGE_KEYS = {
     "tpujob_serve_prefix_hit_rate": "prefixHitRate",
     "tpujob_serve_accept_rate": "acceptRate",
     "tpujob_serve_draining": "draining",
+    # fleet-level KV (ISSUE 12): parked lanes + host-tier residency
+    # make migration-target choice inspectable (/statusz) and feed the
+    # broker's least-loaded-holder ordering
+    "tpujob_serve_parked_lanes": "parkedLanes",
+    "tpujob_serve_host_cache_blocks": "hostCacheBlocks",
 }
 
 _GAUGE_RE = re.compile(
@@ -133,7 +150,11 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
                 "prefillQueueDepth",
                 # multi-tenant QoS counters (ISSUE 10) — without them
                 # the fleet gauges read 0 while replicas preempt
-                "preemptedLanes", "parkedLanes", "activeAdapters"):
+                "preemptedLanes", "parkedLanes", "activeAdapters",
+                # fleet-level KV (ISSUE 12): migration and peer-fetch
+                # accounting sums across the fleet
+                "laneMigrations", "adoptedLanes", "peerPrefixFetches",
+                "hostCacheEvictions"):
         vals = [b.get(key) for b in blocks if b.get(key) is not None]
         if vals:
             total = sum(float(v) for v in vals)
@@ -189,6 +210,10 @@ class ReplicaState:
     def tokens_per_sec(self) -> float:
         return self.gauges.get("tokensPerSec", 0.0)
 
+    @property
+    def parked_lanes(self) -> float:
+        return self.gauges.get("parkedLanes", 0.0)
+
     def load_rank(self) -> Tuple[float, float, float]:
         """Least-loaded ordering: shortest queue first, then the most
         free KV blocks, then the highest recent throughput (a replica
@@ -228,10 +253,22 @@ class FleetRouter:
             OrderedDict()
         self._dedupe_cap = dedupe_cap
         self._inflight: set = set()
+        # fleet-level KV (ISSUE 12): request_id -> adopting endpoint
+        # for brokered lane migrations (bounded LRU); retries with a
+        # recorded id route to the adopter, replayed migrations are
+        # answered from the table instead of re-forwarded, and ids
+        # mid-broker sit in _migr_inflight so a replay race cannot
+        # place one lane on two replicas
+        self._migrations: "OrderedDict[str, str]" = OrderedDict()
+        self._migr_cap = 4096
+        self._migr_inflight: set = set()
         self.counters: Dict[str, float] = {
             "routed_affinity": 0, "routed_spill": 0,
             "routed_least_loaded": 0, "routed_adapter": 0,
+            "routed_migrated": 0,
             "dedupe_replays": 0,
+            "migrations_brokered": 0, "migration_replays": 0,
+            "prefix_forwards": 0,
             "upstream_errors": 0, "no_ready_replica": 0,
         }
         self._stop = threading.Event()
@@ -283,6 +320,16 @@ class FleetRouter:
             return resp.status, resp.read()
         finally:
             conn.close()
+
+    def _http_post(self, endpoint: str, path: str, body: bytes,
+                   content_type: str = "application/octet-stream",
+                   timeout: float = 10.0) -> Tuple[int, bytes]:
+        # shared with FleetKVClient (utils/fleetkv.http_post) so the
+        # wire's endpoint-parse/timeout semantics cannot drift
+        from paddle_operator_tpu.utils.fleetkv import http_post
+
+        return http_post(endpoint, path, body,
+                         content_type=content_type, timeout=timeout)
 
     def scrape_once(self) -> None:
         """One poll of every replica's /readyz + /metrics.  A replica
@@ -434,6 +481,127 @@ class FleetRouter:
             self.counters["routed_affinity"] += 1
             return target, "affinity"
 
+    # -- fleet-level KV brokering (ISSUE 12) -------------------------------
+
+    @staticmethod
+    def _base_request_id(request_id: str) -> str:
+        """The client-level id behind a per-row id: replicas key
+        migrations on ``{client_id}/row{i}`` (serve.py's per-row
+        submit ids), but the client's retry carries the bare
+        ``{client_id}`` — record both so the retry routes to the
+        adopter."""
+        base, sep, tail = request_id.rpartition("/row")
+        return base if sep and tail.isdigit() else request_id
+
+    def migrate_target(self, request_id: Optional[str]
+                       ) -> Optional[str]:
+        if request_id is None:
+            return None
+        with self._lock:
+            return self._migrations.get(request_id)
+
+    def record_migration(self, request_id: str, endpoint: str) -> None:
+        with self._lock:
+            self._migrations[request_id] = endpoint
+            self._migrations.move_to_end(request_id)
+            base = self._base_request_id(request_id)
+            if base != request_id:
+                # FIRST adopter wins the client-level id: a multi-row
+                # request whose rows land on different adopters must
+                # not have each row's record overwrite the base route
+                # (the retry would then miss every earlier adopter's
+                # handle and re-generate those rows while the adopted
+                # lanes decode orphaned)
+                self._migrations.setdefault(base, endpoint)
+                self._migrations.move_to_end(base)
+            while len(self._migrations) > self._migr_cap:
+                self._migrations.popitem(last=False)
+
+    def migration_candidates(self, origin: str) -> List[str]:
+        """Ready replicas able to adopt a lane, best first: fewest
+        parked lanes (a backlog of parked work means no room to host
+        more), then the usual least-loaded ordering.  The origin — the
+        replica shedding the lane — is excluded."""
+        origin = self._norm(origin) if origin else ""
+        with self._lock:
+            ready = [ep for ep in self._ready_endpoints()
+                     if ep != origin]
+            return sorted(ready, key=lambda e: (
+                self.replicas[e].parked_lanes,
+                self.replicas[e].load_rank()))
+
+    def broker_migration(self, envelope: bytes, request_id: str,
+                         origin: str) -> Tuple[int, Dict[str, Any]]:
+        """Place one lane envelope on the best ready peer.  Returns
+        ``(http_status, response_body)``.  A replayed id answers from
+        the migration table without forwarding (the lane must never
+        run on two replicas); an id mid-broker gets a retriable 503."""
+        with self._lock:
+            existing = self._migrations.get(request_id)
+            if existing is not None:
+                self.counters["migration_replays"] += 1
+                return 200, {"target": existing, "deduped": True}
+            if request_id in self._migr_inflight:
+                return 503, {"error": "migration already in flight"}
+            self._migr_inflight.add(request_id)
+        try:
+            for ep in self.migration_candidates(origin):
+                try:
+                    # the forward must resolve INSIDE the origin's
+                    # broker budget (utils/fleetkv timeout ordering) —
+                    # a slow-but-successful restore that outlives the
+                    # origin's socket would resume the lane locally
+                    # AND decode it on the adopter
+                    from paddle_operator_tpu.utils.fleetkv import (
+                        RESTORE_FORWARD_TIMEOUT_S,
+                    )
+
+                    code, _ = self._http_post(
+                        ep, "/v1/kv/restore", envelope,
+                        timeout=RESTORE_FORWARD_TIMEOUT_S)
+                except ConnectionRefusedError:
+                    # never reached the peer: safe to try the next
+                    self.mark_unready(ep)
+                    continue
+                except (OSError, socket.timeout):
+                    # AMBIGUOUS: the peer may have received (and
+                    # adopted) the envelope before the socket died —
+                    # forwarding to another candidate could place one
+                    # lane on TWO replicas.  Stop here; the origin
+                    # keeps the lane (completion-wait fallback), and
+                    # a possibly-adopted orphan decays out of the
+                    # adopter's bounded handle map unclaimed.
+                    self.mark_unready(ep)
+                    return 503, {"error": f"adopter {ep} unreachable "
+                                          "mid-restore; lane kept at "
+                                          "origin"}
+                if code == 200:
+                    self.record_migration(request_id, ep)
+                    with self._lock:
+                        self.counters["migrations_brokered"] += 1
+                    return 200, {"target": ep}
+                # 409/4xx: this peer refused (fingerprint mismatch,
+                # adapter absent) — try the next one
+            return 503, {"error": "no replica adopted the lane"}
+        finally:
+            with self._lock:
+                self._migr_inflight.discard(request_id)
+
+    def prefix_owner(self, tokens, origin: str) -> Optional[str]:
+        """The replica whose radix cache most likely holds this
+        prompt's prefix: its hashring affinity owner — the SAME
+        placement rule that put the prefix there — excluding the
+        asking replica."""
+        origin = self._norm(origin) if origin else ""
+        with self._lock:
+            ready = [ep for ep in self._ready_endpoints()
+                     if ep != origin]
+            if not ready or self.affinity_blocks <= 0:
+                return None
+            key, _ = prefix_chain_key(tokens, self.block_size,
+                                      self.affinity_blocks)
+            return self.ring.pick(key, ready)
+
     # -- dedupe ------------------------------------------------------------
 
     def dedupe_begin(self, request_id: Optional[str]
@@ -563,10 +731,85 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     # -- the proxy ---------------------------------------------------------
 
+    def _kv_migrate(self, body: bytes) -> None:
+        """POST /v1/kv/migrate — broker one lane envelope (ISSUE 12):
+        peek the header for the request id, place the raw bytes on the
+        best ready peer, record id -> adopter so the client's retry
+        routes there."""
+        from paddle_operator_tpu.utils.fleetkv import (
+            EnvelopeError,
+            peek_header,
+        )
+
+        r = self.router
+        if r.draining:
+            self._send(503, {"error": "router draining"},
+                       headers={"Retry-After": r.retry_after_s})
+            return
+        try:
+            header = peek_header(body)
+            rid = (header.get("meta") or {}).get("requestId")
+        except EnvelopeError as e:
+            self._send(400, {"error": str(e)})
+            return
+        if not rid:
+            self._send(400, {"error": "lane envelope carries no "
+                                      "requestId"})
+            return
+        origin = self.headers.get("X-Migrate-Origin", "")
+        code, resp = r.broker_migration(body, str(rid), origin)
+        headers = ({"Retry-After": r.retry_after_s}
+                   if code == 503 else None)
+        self._send(code, resp, headers=headers)
+
+    def _kv_prefix(self, body: bytes) -> None:
+        """POST /v1/kv/prefix — forward a prefix-fetch ask to the
+        prompt's hashring affinity owner (the replica the placement
+        rule sent that prefix's traffic to) and relay its envelope."""
+        r = self.router
+        try:
+            req = json.loads(body)
+            tokens = [int(t) for t in req["tokens"]]
+        except (ValueError, TypeError, KeyError,
+                json.JSONDecodeError) as e:
+            self._send(400, {"error": f"bad tokens: {e}"})
+            return
+        owner = r.prefix_owner(tokens,
+                               self.headers.get("X-Migrate-Origin", ""))
+        if owner is None:
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        try:
+            code, raw = r._http_post(owner, "/v1/kv/prefix", body,
+                                     content_type="application/json")
+        except (OSError, socket.timeout):
+            r.mark_unready(owner)
+            code, raw = 204, b""
+        with r._lock:
+            r.counters["prefix_forwards"] += 1
+        if code == 200 and raw:
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "application/octet-stream")
+            self.send_header("Content-Length", str(len(raw)))
+            self.send_header("X-Router-Replica", owner)
+            self.end_headers()
+            self.wfile.write(raw)
+        else:
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
     def do_POST(self):
         r = self.router
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n) if n else b""
+        if self.path == "/v1/kv/migrate":
+            return self._kv_migrate(body)
+        if self.path == "/v1/kv/prefix":
+            return self._kv_prefix(body)
         if self.path != "/v1/generate":
             self._send(404, {})
             return
@@ -601,6 +844,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
             return
         status, result = 0, None
         try:
+            # fleet-level KV (ISSUE 12): a retry whose lane migrated
+            # routes to the ADOPTER — it holds (or is still decoding)
+            # the result under this id.  An adopter that has since
+            # gone unready falls through to the normal policy (the
+            # request re-generates fresh; the original never
+            # delivered, so exactly-once delivery holds).
+            mt = r.migrate_target(request_id)
+            if mt is not None:
+                st = r.replicas.get(mt)
+                if st is not None and st.ready:
+                    with r._lock:
+                        r.counters["routed_migrated"] += 1
+                    status, result = self._proxy(mt, "migrated", body,
+                                                 req)
+                    return
             try:
                 ep, reason = r.choose(first_row,
                                       adapter=req.get("adapter"))

@@ -91,7 +91,9 @@ class SimFleet:
                  hot_queue_depth: int = 4,
                  scrape_interval: float = 0.2,
                  subprocess_replicas: bool = False,
-                 host_env: Optional[Dict[str, str]] = None) -> None:
+                 host_env: Optional[Dict[str, str]] = None,
+                 ring_extra: Optional[Dict[str, Any]] = None,
+                 fleet_kv: bool = False) -> None:
         self.block_size = block_size
         self.ring_kw: Dict[str, Any] = dict(
             slots=slots, max_len=max_len, chunk_tokens=chunk_tokens,
@@ -99,6 +101,10 @@ class SimFleet:
             block_size=block_size, prefix_cache=True)
         if num_blocks is not None:
             self.ring_kw["num_blocks"] = num_blocks
+        # extra ring knobs (ISSUE 12 fleet-KV tests size a host tier
+        # with host_cache_blocks=, quant fleets pass kv_quant=, ...)
+        self.ring_kw.update(ring_extra or {})
+        self.fleet_kv = fleet_kv
         self.subprocess_replicas = subprocess_replicas
         self.host_env = host_env or {}
         self.replicas: List[_Replica] = []
@@ -123,7 +129,38 @@ class SimFleet:
         self._router_thread.start()
         self.router_url = ("http://127.0.0.1:"
                            f"{self.router_srv.server_address[1]}")
+        if self.fleet_kv:
+            self.enable_fleet_kv()
         self.wait_ready()
+
+    def enable_fleet_kv(self, *, migrate: bool = True,
+                        peer_fetch: bool = True,
+                        parked_s: Optional[float] = None) -> None:
+        """Wire every LIVE in-process replica with a FleetKVClient
+        pointed at this fleet's router (ISSUE 12): drain-by-migration
+        + router-brokered parked-lane shed + peer prefix fetch — the
+        same wiring serve.py's SERVE_KV_MIGRATE / SERVE_KV_PEER_FETCH
+        envs produce in a pod.  Idempotent; call again after
+        add_replica()."""
+        from paddle_operator_tpu.utils import fleetkv as FK
+
+        broker = f"127.0.0.1:{self.router_srv.server_address[1]}"
+        for rep in self.replicas:
+            b = rep.batcher
+            if b is None or rep.exit_code is not None \
+                    or b.pool is None:
+                continue
+            client = FK.FleetKVClient(broker=broker,
+                                      origin=rep.endpoint)
+            if migrate:
+                b.migrate_out = (
+                    lambda c: lambda meta, spill:
+                    c.migrate_out(FK.encode_lane(meta, spill)))(client)
+                b._migrate_on_drain = True
+                if parked_s:
+                    b.migrate_parked_s = parked_s
+            if peer_fetch and b.pool.host is not None:
+                b.peer_fetch = client.fetch_prefix
 
     # -- replica lifecycle -------------------------------------------------
 
@@ -314,7 +351,10 @@ def _replica_main() -> int:
 
     from paddle_operator_tpu.ft.preemption import PreemptionWatcher
     from paddle_operator_tpu.infer.resilience import ServingDrain
-    from paddle_operator_tpu.infer.serve import make_server
+    from paddle_operator_tpu.infer.serve import (
+        make_server,
+        wire_fleet_kv_from_env,
+    )
 
     port = int(os.environ["TPUJOB_REPLICA_PORT"])
     ring_kw = ast.literal_eval(os.environ.get("SIMFLEET_RING_KW",
@@ -324,6 +364,9 @@ def _replica_main() -> int:
                       continuous=True, job="sim/fleet",
                       replica=os.environ.get("TPUJOB_REPLICA_ID", ""),
                       **ring_kw)
+    # fleet-level KV (ISSUE 12): the same SERVE_KV_* env contract the
+    # real entrypoint honors, so bench subprocess fleets migrate too
+    wire_fleet_kv_from_env(srv.generator.batcher, port)
     watcher = PreemptionWatcher.install()
     drain = ServingDrain(
         srv, srv.state, batcher=srv.generator.batcher,

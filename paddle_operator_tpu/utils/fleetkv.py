@@ -1,0 +1,384 @@
+"""Fleet-level KV wire protocol — the envelope + HTTP client (ISSUE 12).
+
+Spill payloads (``RingExecutor.spill_lane``) and host-cache demote
+payloads (``infer/paged.py HostCacheTier``) are plain host byte
+blobs already; this module gives them ONE self-describing wire form so
+the fleet can move KV between replicas:
+
+- **lane migration**: a parked/preempted lane's spill envelope POSTs to
+  a peer's ``/v1/kv/restore`` (router-brokered via ``/v1/kv/migrate``),
+  which resumes the stream bit-identically through the existing
+  promote-scatter + attach path;
+- **drain-by-migration**: scale-down drains residents by migrating them
+  out instead of waiting out completions;
+- **peer prefix fetch**: a replica whose radix walk misses asks the
+  prefix's hashring owner for DEMOTED blocks and promotes them through
+  the host-hit path (int8 pool blocks halve the wire bytes).
+
+The envelope is deliberately paranoid — version, quant mode, a
+dtype/shape manifest, the adapter name + namespace, and a payload
+checksum — and :func:`decode_envelope` rejects any mismatch loudly
+(:class:`EnvelopeError`): a truncated or version-skewed envelope must
+refuse cleanly, never corrupt a lane.
+
+Layout (little-endian)::
+
+    b"TPKV" | u32 version | u32 header_len | header JSON | payload
+
+The header carries ``meta`` (scalars: request identity, ring
+fingerprint, chunks for prefix envelopes), an ``arrays`` manifest
+(name/dtype/shape/offset/nbytes into the payload), and ``crc``
+(zlib.crc32 of the payload).  Chain keys and token ids ride as JSON
+ints end to end — Python ints JSON-round-trip exactly at any width
+(no float coercion), the same process-stability argument as
+utils/radixkey.py.
+
+Lives in utils/ (not infer/) because the ROUTER brokers migrations and
+prefix fetches and must stay jax-free — it only ever peeks the header
+(:func:`peek_header`) and relays the raw bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import zlib
+from http.client import HTTPConnection
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+MAGIC = b"TPKV"
+VERSION = 1
+_HDR = struct.Struct("<II")           # version, header_len
+
+# Wire timeouts, ordered so an AMBIGUOUS hop can never masquerade as a
+# clean refusal upstream: the router's forward to the adopter
+# (RESTORE_FORWARD_TIMEOUT_S) must complete — or fail — well inside
+# the origin's broker-call budget (BROKER_TIMEOUT_S).  Were the inner
+# hop the longer one, the origin could time out, report "peer
+# refused", and resume the lane locally while the adopter ALSO
+# decodes the successfully-forwarded copy: delivery stays exactly-once
+# (dedupe), but the stream runs twice — on exactly the drained/
+# overloaded fleet migration exists to relieve.
+BROKER_TIMEOUT_S = 8.0
+RESTORE_FORWARD_TIMEOUT_S = 4.0
+
+
+class EnvelopeError(ValueError):
+    """A wire envelope failed validation (bad magic, version skew,
+    truncation, checksum mismatch, manifest/fingerprint disagreement).
+    Receivers refuse the whole envelope — a partially-applied restore
+    would corrupt a lane byte-exactly where it matters most."""
+
+
+def _dtype_token(dt: np.dtype) -> str:
+    """Manifest token for a dtype.  Plain numpy dtypes use the
+    byte-order-explicit ``.str``; ml_dtypes extension dtypes (bfloat16
+    — what a real serving pool actually holds — float8_*, ...) have an
+    OPAQUE void ``.str`` ('|V2') that would decode as raw void bytes
+    and poison the promote upload, so they travel by NAME and resolve
+    back through ml_dtypes."""
+    dt = np.dtype(dt)
+    if dt.kind == "V":
+        return dt.name
+    return dt.str
+
+
+def _resolve_dtype(token: str) -> np.dtype:
+    try:
+        dt = np.dtype(token)
+        if dt.kind != "V":
+            return dt
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, token))
+    except (ImportError, AttributeError, TypeError):
+        raise EnvelopeError(
+            f"unresolvable array dtype {token!r} in envelope "
+            "manifest") from None
+
+
+def encode_envelope(kind: str, meta: Dict[str, Any],
+                    arrays: Dict[str, np.ndarray]) -> bytes:
+    """Serialize ``arrays`` (name -> ndarray) plus JSON-safe ``meta``
+    into one self-describing envelope."""
+    manifest: List[Dict[str, Any]] = []
+    chunks: List[bytes] = []
+    off = 0
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        raw = a.tobytes()
+        manifest.append({"name": name, "dtype": _dtype_token(a.dtype),
+                         "shape": list(a.shape), "offset": off,
+                         "nbytes": len(raw)})
+        chunks.append(raw)
+        off += len(raw)
+    payload = b"".join(chunks)
+    header = json.dumps({
+        "version": VERSION, "kind": kind, "meta": meta,
+        "arrays": manifest, "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+    }).encode()
+    return MAGIC + _HDR.pack(VERSION, len(header)) + header + payload
+
+
+def peek_header(buf: bytes) -> Dict[str, Any]:
+    """Parse and validate ONLY the header (magic, version, JSON) —
+    what the router needs to broker an envelope without touching the
+    payload.  Stdlib-only on purpose."""
+    if len(buf) < len(MAGIC) + _HDR.size or buf[:len(MAGIC)] != MAGIC:
+        raise EnvelopeError("not a fleet-KV envelope (bad magic)")
+    version, hlen = _HDR.unpack_from(buf, len(MAGIC))
+    if version != VERSION:
+        raise EnvelopeError(
+            f"envelope version {version} != supported {VERSION}; "
+            "refusing (mixed-version fleet mid-rollout — retry after "
+            "the rollout converges)")
+    start = len(MAGIC) + _HDR.size
+    if len(buf) < start + hlen:
+        raise EnvelopeError("truncated envelope (header cut short)")
+    try:
+        header = json.loads(buf[start:start + hlen])
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise EnvelopeError(f"corrupt envelope header: {e}") from None
+    if header.get("version") != version:
+        raise EnvelopeError("envelope header/frame version disagree")
+    return header
+
+
+def decode_envelope(buf: bytes) -> Tuple[str, Dict[str, Any],
+                                         Dict[str, np.ndarray]]:
+    """Validate + deserialize: returns ``(kind, meta, arrays)``.
+    Raises :class:`EnvelopeError` on ANY inconsistency."""
+    header = peek_header(buf)
+    # payload start comes from the FRAME's header_len, never from
+    # re-serializing the parsed header (JSON re-dumps are not
+    # byte-stable)
+    _, hlen = _HDR.unpack_from(buf, len(MAGIC))
+    start = len(MAGIC) + _HDR.size + hlen
+    payload = buf[start:]
+    total = sum(int(m["nbytes"]) for m in header["arrays"])
+    if len(payload) != total:
+        raise EnvelopeError(
+            f"truncated envelope: payload {len(payload)} bytes, "
+            f"manifest expects {total}")
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != header.get("crc"):
+        raise EnvelopeError("payload checksum mismatch (corrupt or "
+                            "truncated envelope)")
+    arrays: Dict[str, np.ndarray] = {}
+    for m in header["arrays"]:
+        off, nb = int(m["offset"]), int(m["nbytes"])
+        if off < 0 or off + nb > len(payload):
+            raise EnvelopeError(f"array {m['name']!r} manifest out of "
+                                "payload bounds")
+        dt = _resolve_dtype(m["dtype"])
+        a = np.frombuffer(payload, dtype=dt, count=nb // dt.itemsize,
+                          offset=off)
+        arrays[m["name"]] = a.reshape(m["shape"]).copy()
+    return header["kind"], header["meta"], arrays
+
+
+# ---------------------------------------------------------------------------
+# Lane (migration) and prefix (peer fetch) envelope shapes
+# ---------------------------------------------------------------------------
+
+# spill-dict keys that are arrays (everything else rides in meta)
+_LANE_ARRAYS = ("k", "v", "ks", "vs", "kt", "vt", "dk", "dv")
+
+
+def encode_lane(meta: Dict[str, Any], spill: Dict[str, Any]) -> bytes:
+    """A live lane's spill (RingExecutor.spill_lane output) + request
+    meta -> wire envelope.  Scalars (pos/tok/temp/key/n_blocks/dpos)
+    fold into meta; the per-replica adapter SLOT index does not travel
+    (slot ids are replica-local — the adopter re-resolves the adapter
+    by NAME against its own registry)."""
+    m = dict(meta)
+    m["pos"] = int(spill["pos"])
+    m["tok"] = int(spill["tok"])
+    m["temp"] = float(spill["temp"])
+    m["key"] = [int(x) for x in np.asarray(spill["key"]).ravel()]
+    m["nBlocks"] = int(spill["n_blocks"])
+    if "dpos" in spill:
+        m["dpos"] = int(spill["dpos"])
+    arrays = {k: np.asarray(spill[k]) for k in _LANE_ARRAYS
+              if k in spill}
+    return encode_envelope("lane", m, arrays)
+
+
+def decode_lane(buf: bytes) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Wire envelope -> ``(meta, spill)`` ready for
+    ``ContinuousBatcher.adopt`` / ``RingExecutor.restore_lane``."""
+    kind, meta, arrays = decode_envelope(buf)
+    if kind != "lane":
+        raise EnvelopeError(f"expected a lane envelope, got {kind!r}")
+    for req_key in ("pos", "tok", "temp", "key", "nBlocks", "prompt",
+                    "left"):
+        if req_key not in meta:
+            raise EnvelopeError(f"lane envelope missing meta "
+                                f"{req_key!r}")
+    if "k" not in arrays or "v" not in arrays:
+        raise EnvelopeError("lane envelope missing k/v arrays")
+    spill: Dict[str, Any] = {
+        "pos": int(meta["pos"]), "tok": int(meta["tok"]),
+        "temp": float(meta["temp"]),
+        "key": np.asarray(meta["key"], np.uint32),
+        "n_blocks": int(meta["nBlocks"]),
+    }
+    if "dpos" in meta:
+        spill["dpos"] = int(meta["dpos"])
+    spill.update(arrays)
+    return meta, spill
+
+
+def encode_prefix(meta: Dict[str, Any],
+                  chunks: Sequence[Sequence[int]],
+                  block_idx: Sequence[int],
+                  payloads: Sequence[Dict[str, np.ndarray]]) -> bytes:
+    """Demoted prefix blocks -> wire envelope.  ``chunks`` is EVERY
+    full block's token chunk from the chain start (the importer needs
+    them to recompute parent chain keys), ``block_idx`` the subset of
+    indices whose payloads actually travel (host-resident on the
+    exporter)."""
+    m = dict(meta)
+    m["chunks"] = [[int(t) for t in c] for c in chunks]
+    m["blocks"] = [int(j) for j in block_idx]
+    arrays: Dict[str, np.ndarray] = {}
+    for j, payload in zip(block_idx, payloads):
+        for name, a in payload.items():
+            arrays[f"{name}{j}"] = np.asarray(a)
+    return encode_envelope("prefix", m, arrays)
+
+
+def decode_prefix(buf: bytes) -> Tuple[Dict[str, Any], List[List[int]],
+                                       List[int],
+                                       List[Dict[str, np.ndarray]]]:
+    kind, meta, arrays = decode_envelope(buf)
+    if kind != "prefix":
+        raise EnvelopeError(f"expected a prefix envelope, got {kind!r}")
+    chunks = [list(map(int, c)) for c in meta.get("chunks", ())]
+    block_idx = [int(j) for j in meta.get("blocks", ())]
+    payloads: List[Dict[str, np.ndarray]] = []
+    for j in block_idx:
+        p = {name: arrays[f"{name}{j}"]
+             for name in ("k", "v", "ks", "vs")
+             if f"{name}{j}" in arrays}
+        if "k" not in p or "v" not in p:
+            raise EnvelopeError(f"prefix envelope block {j} missing "
+                                "k/v payload")
+        payloads.append(p)
+    return meta, chunks, block_idx, payloads
+
+
+def check_fingerprint(meta: Dict[str, Any],
+                      mine: Dict[str, Any]) -> None:
+    """Reject an envelope whose ring fingerprint (layer/head geometry,
+    block size, quant mode, spec depth) disagrees with the receiver —
+    the byte layouts would silently misinterpret each other."""
+    theirs = meta.get("fingerprint")
+    if theirs != mine:
+        raise EnvelopeError(
+            f"ring fingerprint mismatch: envelope {theirs} vs "
+            f"receiver {mine} — refusing (mixed fleet config?)")
+
+
+# ---------------------------------------------------------------------------
+# HTTP client: migration + prefix fetch, broker- or peer-direct
+# ---------------------------------------------------------------------------
+
+
+def http_post(endpoint: str, path: str, body: bytes,
+              content_type: str = "application/octet-stream",
+              timeout: float = 10.0,
+              headers: Optional[Dict[str, str]] = None
+              ) -> Tuple[int, bytes]:
+    """The one jax-free POST helper the fleet-KV wire uses — shared by
+    :class:`FleetKVClient` and the router's broker so endpoint
+    parsing / timeout semantics cannot drift between them."""
+    host, _, port = endpoint.rpartition(":")
+    conn = HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        hdrs = {"Content-Type": content_type}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", path, body=body, headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+class FleetKVClient:
+    """The replica-side wire client.  ``broker`` (the fleet router's
+    ``host:port``) is preferred — it picks the migration target from
+    its scraped peer directory and dedupes replayed migrations; static
+    ``peers`` (SERVE_KV_PEERS) are the router-less fallback, tried in
+    order.  All failures degrade to ``None``/``False`` — the caller
+    falls back to completion-wait / cold prefill, never errors the
+    request."""
+
+    def __init__(self, broker: str = "", peers: Sequence[str] = (),
+                 origin: str = "",
+                 timeout: float = BROKER_TIMEOUT_S) -> None:
+        self.broker = broker.strip().rstrip("/")
+        self.peers = [p.strip() for p in peers if p.strip()]
+        self.origin = origin
+        self.timeout = timeout
+
+    def _post(self, endpoint: str, path: str, body: bytes,
+              content_type: str = "application/octet-stream"
+              ) -> Tuple[int, bytes]:
+        headers = ({"X-Migrate-Origin": self.origin}
+                   if self.origin else None)
+        return http_post(endpoint, path, body,
+                         content_type=content_type,
+                         timeout=self.timeout, headers=headers)
+
+    def migrate_out(self, envelope: bytes) -> Optional[str]:
+        """Offer a lane envelope to the fleet; returns the adopting
+        endpoint (or None — the lane stays local)."""
+        if self.broker:
+            try:
+                code, body = self._post(self.broker, "/v1/kv/migrate",
+                                        envelope)
+                if code == 200:
+                    return json.loads(body).get("target") or self.broker
+            except (OSError, socket.timeout, ValueError):
+                pass
+            return None
+        for peer in self.peers:
+            if peer == self.origin:
+                continue
+            try:
+                code, _ = self._post(peer, "/v1/kv/restore", envelope)
+                if code == 200:
+                    return peer
+            except ConnectionRefusedError:
+                continue            # never reached: next peer is safe
+            except (OSError, socket.timeout):
+                # ambiguous — the peer may have adopted before the
+                # socket died; offering the envelope again could run
+                # one lane on two replicas.  Keep the lane local.
+                return None
+        return None
+
+    def fetch_prefix(self, tokens: Sequence[int],
+                     ns: int = 0) -> Optional[bytes]:
+        """Ask the fleet for demoted blocks of this prompt's chain;
+        returns a prefix envelope or None."""
+        body = json.dumps({"tokens": [int(t) for t in tokens],
+                           "ns": int(ns)}).encode()
+        targets = ([self.broker] if self.broker else
+                   [p for p in self.peers if p != self.origin])
+        for ep in targets:
+            try:
+                code, raw = self._post(ep, "/v1/kv/prefix", body,
+                                       content_type="application/json")
+                if code == 200 and raw:
+                    return raw
+            except (OSError, socket.timeout):
+                continue
+        return None

@@ -192,6 +192,22 @@ def _serving_gauges_one(status_serving: dict, job: str,
             float(status_serving.get("hostHitRate", 0.0)),
         f"tpujob_serve_promoted_blocks_total{lbl}":
             float(status_serving.get("promotedBlocks", 0.0)),
+        # fleet-level KV (ISSUE 12): host-tier dropped-oldest overflow
+        # evictions (previously INVISIBLE — a silently thrashing tier
+        # read as a healthy one), lanes migrated out to / adopted from
+        # peers, prefix chains fetched from a peer's host tier, and
+        # the parked-lane count the router's migration broker reads to
+        # pick adopters
+        f"tpujob_serve_host_cache_evictions_total{lbl}":
+            float(status_serving.get("hostCacheEvictions", 0.0)),
+        f"tpujob_serve_lane_migrations_total{lbl}":
+            float(status_serving.get("laneMigrations", 0.0)),
+        f"tpujob_serve_adopted_lanes_total{lbl}":
+            float(status_serving.get("adoptedLanes", 0.0)),
+        f"tpujob_serve_peer_prefix_fetches_total{lbl}":
+            float(status_serving.get("peerPrefixFetches", 0.0)),
+        f"tpujob_serve_parked_lanes{lbl}":
+            float(status_serving.get("parkedLanes", 0.0)),
         # device-resident megastep (ISSUE 11, SERVE_MEGASTEP): fused
         # ring iterations per compiled dispatch and the measured
         # resident dispatches per emitted token — dispatches_per_token

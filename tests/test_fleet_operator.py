@@ -138,6 +138,44 @@ class TestFleetMaterialization:
         assert "SERVE_PRIORITIES" not in names
         assert "SERVE_ADAPTERS" not in names
 
+    def test_fleet_kv_spec_maps_to_serve_env(self):
+        """ISSUE 12: spec.serving.kvMigration / peerPrefixFetch /
+        hostCacheMb / migrateParkedS reach every replica as SERVE_*
+        env, with the broker injected as the fleet's stable Service;
+        unset knobs emit NO env."""
+        from paddle_operator_tpu.api.types import ServingSpec
+
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        job = TPUJob(name="kj", namespace=NS, spec=TPUJobSpec(
+            serving=ServingSpec(
+                replicas=2, template=TMPL, kv_migration=True,
+                peer_prefix_fetch=True, host_cache_mb=512,
+                migrate_parked_s=2.5)))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "kj")
+        pod = api.get("Pod", NS, "kj-serve-0")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["SERVE_KV_MIGRATE"] == "1"
+        assert env["SERVE_KV_PEER_FETCH"] == "1"
+        assert env["SERVE_HOST_CACHE_MB"] == "512"
+        assert env["SERVE_MIGRATE_PARKED_S"] == "2.5"
+        # broker = the client-facing Service fronting the router
+        assert env["SERVE_KV_BROKER"] == "kj-serve:8700"
+        # round-trip through the apiserver dict form
+        sv = TPUJob.from_dict(api.get(KIND_JOB, NS, "kj")).spec.serving
+        assert sv.kv_migration is True
+        assert sv.peer_prefix_fetch is True
+        assert sv.host_cache_mb == 512
+        assert sv.migrate_parked_s == 2.5
+        # unset: no env injected, server defaults stay in charge
+        api2, rec2, _ = _setup(replicas=1)
+        pod2 = api2.get("Pod", NS, "fj-serve-0")
+        names = {e["name"] for e in pod2["spec"]["containers"][0]["env"]}
+        assert "SERVE_KV_MIGRATE" not in names
+        assert "SERVE_KV_BROKER" not in names
+
     def test_user_env_wins_over_injected_defaults(self):
         api = FakeAPI()
         rec = TPUJobReconciler(api)

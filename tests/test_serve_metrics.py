@@ -30,7 +30,9 @@ SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "promotedBlocks": 42,
            "priorityQueueDepth": [1, 2], "preemptedLanes": 3,
            "activeAdapters": 2, "adapterNames": ["acme", "zen"],
-           "megastepN": 4, "dispatchesPerToken": 0.0313}
+           "megastepN": 4, "dispatchesPerToken": 0.0313,
+           "parkedLanes": 1, "laneMigrations": 4, "adoptedLanes": 2,
+           "peerPrefixFetches": 6, "hostCacheEvictions": 7}
 
 
 class TestGaugeNaming:
@@ -81,6 +83,19 @@ class TestGaugeNaming:
         assert g['tpujob_serve_megastep_n{job="default/j"}'] == 4.0
         assert g['tpujob_serve_dispatches_per_token'
                  '{job="default/j"}'] == 0.0313
+        # fleet-level KV gauges (ISSUE 12): the previously invisible
+        # host-tier overflow evictions plus the migration/fetch
+        # counter pair, and the parked-lane count the router's
+        # migration broker scrapes for target choice
+        assert g['tpujob_serve_host_cache_evictions_total'
+                 '{job="default/j"}'] == 7.0
+        assert g['tpujob_serve_lane_migrations_total'
+                 '{job="default/j"}'] == 4.0
+        assert g['tpujob_serve_adopted_lanes_total'
+                 '{job="default/j"}'] == 2.0
+        assert g['tpujob_serve_peer_prefix_fetches_total'
+                 '{job="default/j"}'] == 6.0
+        assert g['tpujob_serve_parked_lanes{job="default/j"}'] == 1.0
 
     def test_prefill_mode_label_defaults_inline(self):
         g = serving_gauges({}, "ns/x")
@@ -113,6 +128,16 @@ class TestGaugeNaming:
             'tpujob_serve_host_cache_blocks{job="default/j"}',
             'tpujob_serve_host_hit_rate{job="default/j"}',
             'tpujob_serve_promoted_blocks_total{job="default/j"}',
+            # fleet-level KV shape (ISSUE 12): tier overflow
+            # evictions, the migration/fetch counter pair, and the
+            # parked-lane gauge the migration broker scrapes
+            'tpujob_serve_host_cache_evictions_total'
+            '{job="default/j"}',
+            'tpujob_serve_lane_migrations_total{job="default/j"}',
+            'tpujob_serve_adopted_lanes_total{job="default/j"}',
+            'tpujob_serve_peer_prefix_fetches_total'
+            '{job="default/j"}',
+            'tpujob_serve_parked_lanes{job="default/j"}',
             # multi-tenant QoS shape (ISSUE 10): one queue-depth gauge
             # per class in the block, preemptions, adapter count + one
             # marker per loaded adapter name
@@ -297,6 +322,9 @@ class TestBatcherServingStatus:
                            "adapterNames",
                            # megastep block (ISSUE 11)
                            "megastepN", "dispatchesPerToken",
+                           # fleet-level KV block (ISSUE 12)
+                           "laneMigrations", "adoptedLanes",
+                           "peerPrefixFetches", "hostCacheEvictions",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
@@ -308,6 +336,10 @@ class TestBatcherServingStatus:
         assert st["promotedBlocks"] == 0
         assert st["priorityQueueDepth"] == [0, 0]   # 2 classes default
         assert st["preemptedLanes"] == 0
+        assert st["laneMigrations"] == 0       # fleet KV off by default
+        assert st["adoptedLanes"] == 0
+        assert st["peerPrefixFetches"] == 0
+        assert st["hostCacheEvictions"] == 0
         assert st["activeAdapters"] == 0       # no registry by default
         assert st["megastepN"] == 1            # single-step default
         assert st["dispatchesPerToken"] > 0
