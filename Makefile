@@ -20,13 +20,17 @@ test: native
 # visible in CI logs) — the bar every PR must keep no worse than the
 # seed.
 #
-# Preflight: orphaned `infer.serve` / `router` processes leaked by a
-# previous session each burn ~5% CPU and ~700MB RSS FOREVER and
-# corrupt tier-1 timing on this contended box (ROADMAP budget note) —
-# detect them BEFORE the timed run and fail loudly with their PIDs so
-# the operator kills them instead of chasing a phantom slowdown.
+# Preflight: orphaned `infer.serve` / `infer.prefill_serve` / `router`
+# / `router.simfleet` processes leaked by a previous session each burn
+# ~5% CPU and ~700MB RSS FOREVER and corrupt tier-1 timing on this
+# contended box (ROADMAP budget note) — detect them BEFORE the timed
+# run and fail loudly with their PIDs so the operator kills them
+# instead of chasing a phantom slowdown.  (`router` alternation also
+# matches `router.simfleet` subprocess replicas; `prefill_serve` needs
+# its own alternation — "infer.serve" is not a substring of
+# "infer.prefill_serve".)
 tier1:
-	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.router' || true); \
+	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet' || true); \
 	if [ -n "$$pids" ]; then \
 		echo "tier1 preflight FAILED: orphaned serve/router process(es) from a previous session:"; \
 		ps -o pid,etime,rss,args -p $$pids || true; \
@@ -62,7 +66,7 @@ bench:
 # (all training parallelism axes, plus the serving parity lines:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
 # serve-disagg, serve-kvquant, serve-hostcache, serve-fleet,
-# serve-qos, serve-megastep, serve-fleetkv, ft-drain)
+# serve-qos, serve-megastep, serve-fleetkv, serve-xdisagg, ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

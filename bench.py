@@ -1685,6 +1685,204 @@ def _fold_fleet_kv_summary(rows, summary, emit) -> None:
             fetch[False]["fleetkv_spill_hit_rate"]
 
 
+def measure_autoscaler(*, sim_s: float = 600.0, dt: float = 0.25,
+                       prefill_ms: float = 150.0,
+                       ttft_target_ms: float = 2000.0,
+                       decode_s: float = 4.0,
+                       tok_s_per_req: float = 30.0,
+                       slots_per_decode: int = 4,
+                       tok_s_per_replica: float = 100.0,
+                       boot_s: float = 8.0,
+                       base_rate: float = 1.0, burst_rate: float = 8.0,
+                       bursts=((120.0, 200.0), (380.0, 460.0)),
+                       prefill_max: int = 8, decode_max: int = 6,
+                       cooldown_s: float = 15.0,
+                       up_cooldown_s: float = 2.0) -> list:
+    """SLO-autoscaler trace replay (ISSUE 13): drive the REAL control
+    law (controller/autoscaler.py FleetAutoscaler — the exact code the
+    reconciler runs) through a deterministic bursty OPEN-LOOP arrival
+    trace against a discrete-event fleet model, and compare three
+    provisioning policies:
+
+    - ``auto``        the law scales both pools off the same gauges
+      the router scrapes (prefill queue depth + service-time EMA,
+      decode tok/s, free slots), with pod boot delay and drain-gated
+      one-at-a-time downscale — exactly the reconciler's semantics;
+    - ``static_max``  pinned at the max bounds (the TTFT floor, and
+      the pod-seconds ceiling the ratio is measured against);
+    - ``static_min``  pinned at the min bounds (what the bursts do to
+      TTFT without scaling).
+
+    Open-loop on purpose: arrivals never back off, so a queue the
+    pool cannot drain GROWS — the regime autoscaling exists for.
+    The model is host-only arithmetic (no jax): service times are
+    parameters, not measurements — what this bench validates is the
+    CONTROL LAW (tracking, hysteresis, cool-down, boot-lag behavior),
+    not kernel speed, so it runs identically on any box."""
+    from paddle_operator_tpu.api.types import AutoscaleSpec
+    from paddle_operator_tpu.controller.autoscaler import FleetAutoscaler
+
+    spec = AutoscaleSpec(
+        ttft_target_ms=ttft_target_ms,
+        tok_s_per_replica=tok_s_per_replica,
+        min_replicas=1, max_replicas=decode_max,
+        prefill_min=1, prefill_max=prefill_max,
+        cooldown_s=cooldown_s, up_cooldown_s=up_cooldown_s)
+
+    def rate_at(t: float) -> float:
+        for lo, hi in bursts:
+            if lo <= t < hi:
+                return burst_rate
+        return base_rate
+
+    def run(mode: str) -> dict:
+        autoscaler = FleetAutoscaler(spec)
+        state = None
+        # pods: list of dicts {ready_at, busy_until} (prefill) /
+        # {ready_at, active: []} (decode); index order = identity
+        n_pf = prefill_max if mode == "static_max" else 1
+        n_dec = decode_max if mode == "static_max" else 1
+        pf_pods = [{"ready_at": 0.0, "busy_until": 0.0}
+                   for _ in range(n_pf)]
+        dec_pods = [{"ready_at": 0.0, "active": []}
+                    for _ in range(n_dec)]
+        pf_draining = dec_draining = None   # (pod, gone_at)
+        pf_queue = []                       # arrival times awaiting prefill
+        dec_queue = []                      # prefill-done awaiting a slot
+        ttfts = []
+        pod_seconds = 0.0
+        acc = 0.0
+        t = 0.0
+        next_ctl = 0.0
+        ms_ema = 0.0
+        while t < sim_s:
+            # arrivals (deterministic fractional accumulator)
+            acc += rate_at(t) * dt
+            while acc >= 1.0:
+                acc -= 1.0
+                pf_queue.append(t)
+            # finish drains
+            if pf_draining and t >= pf_draining[1]:
+                pf_pods.remove(pf_draining[0])
+                pf_draining = None
+            if dec_draining and t >= dec_draining[1]:
+                dec_pods.remove(dec_draining[0])
+                dec_draining = None
+            # prefill service: least-busy ready pod takes the head
+            ready_pf = [p for p in pf_pods if t >= p["ready_at"]
+                        and (not pf_draining or p is not pf_draining[0])]
+            while pf_queue and ready_pf:
+                pod = min(ready_pf, key=lambda p: p["busy_until"])
+                if pod["busy_until"] > t + dt:
+                    break               # every ready pod busy this tick
+                start = max(t, pod["busy_until"])
+                done = start + prefill_ms / 1e3
+                pod["busy_until"] = done
+                arrival = pf_queue.pop(0)
+                ttft = (done - arrival) * 1e3
+                ttfts.append(ttft)
+                ms_ema = (prefill_ms if not ms_ema
+                          else 0.8 * ms_ema + 0.2 * prefill_ms)
+                dec_queue.append(done)
+            # decode admission: free slots take finished prefills
+            for pod in dec_pods:
+                pod["active"] = [d for d in pod["active"] if d > t]
+            ready_dec = [p for p in dec_pods if t >= p["ready_at"]
+                         and (not dec_draining
+                              or p is not dec_draining[0])]
+            while dec_queue and ready_dec:
+                pod = min(ready_dec, key=lambda p: len(p["active"]))
+                if len(pod["active"]) >= slots_per_decode:
+                    break
+                done_at = dec_queue[0]
+                if done_at > t:
+                    break               # prefill not finished yet
+                dec_queue.pop(0)
+                pod["active"].append(t + decode_s)
+            pod_seconds += dt * (len(pf_pods) + len(dec_pods))
+            # control tick: the real law, 1 Hz like the reconciler
+            if mode == "auto" and t >= next_ctl:
+                next_ctl += 1.0
+                active = sum(len(p["active"]) for p in dec_pods)
+                slots_total = sum(
+                    slots_per_decode for p in dec_pods
+                    if t >= p["ready_at"])
+                gauges = {
+                    "prefillQueueDepth": len(pf_queue) + sum(
+                        1 for p in pf_pods if p["busy_until"] > t),
+                    "prefillMsAvg": round(ms_ema, 3),
+                    "tokensPerSec": active * tok_s_per_req,
+                    "queueDepth": len(dec_queue),
+                    "kvBlocksFree": max(0, slots_total - active),
+                }
+                state = autoscaler.observe(
+                    state, gauges,
+                    decode_spec=1, prefill_spec=1,
+                    decode_ready=sum(1 for p in dec_pods
+                                     if t >= p["ready_at"]),
+                    prefill_ready=sum(1 for p in pf_pods
+                                      if t >= p["ready_at"]),
+                    decode_draining=dec_draining is not None,
+                    prefill_draining=pf_draining is not None,
+                    now=t)
+                while len(pf_pods) < state["prefillDesired"]:
+                    pf_pods.append({"ready_at": t + boot_s,
+                                    "busy_until": 0.0})
+                if len(pf_pods) > state["prefillDesired"] \
+                        and not pf_draining:
+                    victim = pf_pods[-1]
+                    pf_draining = (victim,
+                                   max(t, victim["busy_until"]) + dt)
+                while len(dec_pods) < state["decodeDesired"]:
+                    dec_pods.append({"ready_at": t + boot_s,
+                                     "active": []})
+                if len(dec_pods) > state["decodeDesired"] \
+                        and not dec_draining:
+                    victim = dec_pods[-1]
+                    gone = max([t] + victim["active"]) + dt
+                    dec_draining = (victim, gone)
+            t += dt
+        ttfts.sort()
+        p95 = (ttfts[int(0.95 * (len(ttfts) - 1))]
+               if ttfts else float("inf"))
+        return {
+            "autoscaler_mode": mode,
+            "autoscaler_ttft_p95_ms": round(p95, 1),
+            "autoscaler_ttft_p50_ms": round(
+                ttfts[len(ttfts) // 2], 1) if ttfts else None,
+            "autoscaler_requests": len(ttfts),
+            "autoscaler_unserved": len(pf_queue) + len(dec_queue),
+            "autoscaler_pod_seconds": round(pod_seconds, 1),
+            "autoscaler_prefill_pods_final": len(pf_pods),
+            "autoscaler_decode_pods_final": len(dec_pods),
+            "autoscaler_ttft_target_ms": ttft_target_ms,
+        }
+
+    return [run(m) for m in ("auto", "static_max", "static_min")]
+
+
+def _fold_autoscaler_summary(rows, summary, emit) -> None:
+    for entry in rows if isinstance(rows, list) else [rows]:
+        emit("autoscaler_sweep", entry)
+    if not isinstance(rows, list):
+        return
+    by = {r["autoscaler_mode"]: r for r in rows}
+    auto, smax = by.get("auto"), by.get("static_max")
+    if auto:
+        # the SLO headline: p95 TTFT the autoscaled fleet delivered
+        # over the bursty trace, against the declared target
+        summary["xdisagg_ttft_slo_p95_ms"] = \
+            auto["autoscaler_ttft_p95_ms"]
+        summary["xdisagg_ttft_target_ms"] = \
+            auto["autoscaler_ttft_target_ms"]
+    if auto and smax and smax.get("autoscaler_pod_seconds"):
+        # the economics headline: pod-seconds spent vs always-max
+        # provisioning (< 1.0 = the autoscaler paid for itself)
+        summary["autoscaler_pod_seconds_ratio"] = round(
+            auto["autoscaler_pod_seconds"]
+            / smax["autoscaler_pod_seconds"], 3)
+
+
 def _fold_disagg_summary(disagg, summary, emit) -> None:
     """Emit the prefill-mode sweep rows and fold the acceptance keys:
     chunked/disagg cold-TTFT p95 and the disagg decode-throughput
@@ -2475,6 +2673,15 @@ def main() -> int:
     _fold_fleet_kv_summary(guarded("fleetkv",
                                    lambda: measure_fleet_kv()),
                            summary, emit)
+
+    # SLO-autoscaler trace replay (ISSUE 13): the REAL control law
+    # over a deterministic bursty open-loop trace — TTFT p95 vs the
+    # declared target (xdisagg_ttft_slo_p95_ms) and pod-seconds vs
+    # always-max provisioning (autoscaler_pod_seconds_ratio).  Pure
+    # host arithmetic; identical on any box.
+    _fold_autoscaler_summary(
+        guarded("autoscaler", lambda: measure_autoscaler()),
+        summary, emit)
 
     latency = guarded("latency", measure_submit_latency)
     # submit->ConfigMap anomaly guard, same rationale as first_step_s:

@@ -259,6 +259,50 @@ def _spec_schema() -> Dict[str, Any]:
                     "peerPrefixFetch": {"type": "boolean"},
                     "hostCacheMb": _int(0),
                     "migrateParkedS": {"type": "number", "minimum": 0},
+                    # cross-host disaggregation (ISSUE 13): prefill
+                    # executors in their OWN pods (standalone prefill
+                    # servers decode replicas hand cold prompts to
+                    # over the network, router-forwarded)
+                    "prefillPool": {
+                        "type": "object",
+                        "required": ["replicas"],
+                        "properties": {
+                            "replicas": _int(0),
+                            "port": _int(1),
+                            "template": _pod_template_schema(),
+                        },
+                    },
+                    # SLO autoscaler (ISSUE 13): declared TTFT /
+                    # throughput targets + min/max replicas per pool;
+                    # the reconciler scales each pool off the scraped
+                    # gauges (controller/autoscaler.py control law)
+                    "autoscale": {
+                        "type": "object",
+                        "properties": {
+                            "ttftTargetMs": {"type": "number",
+                                             "minimum": 0},
+                            "tokSPerReplica": {"type": "number",
+                                               "minimum": 0},
+                            "minReplicas": _int(0),
+                            "maxReplicas": _int(0),
+                            "prefillMin": _int(0),
+                            "prefillMax": _int(0),
+                            "cooldownS": {"type": "number",
+                                          "minimum": 0},
+                            "upCooldownS": {"type": "number",
+                                            "minimum": 0},
+                            # apiextensions/v1 JSONSchemaProps defines
+                            # exclusiveMinimum/Maximum as BOOLEANS —
+                            # the draft-6 numeric form fails CRD
+                            # decoding and bricks the whole manifest.
+                            # Coarse closed bounds here; the operator's
+                            # validate() enforces the open interval.
+                            "scaleDownRatio": {
+                                "type": "number",
+                                "minimum": 0,
+                                "maximum": 1},
+                        },
+                    },
                 },
             },
             "tpu": {
@@ -315,6 +359,8 @@ def _status_schema() -> Dict[str, Any]:
             # serving-fleet pod counters (replica + router pods);
             # excluded from gang phase derivation — see types.py
             "serve": _resource_status_schema(),
+            # prefill-pool pod counters (ISSUE 13) — same exclusion
+            "prefill": _resource_status_schema(),
             "elastic": {"type": "string"},
             "startTime": {"type": "string", "format": "date-time"},
             "completionTime": {"type": "string", "format": "date-time"},

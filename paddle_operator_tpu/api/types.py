@@ -37,10 +37,17 @@ RESOURCE_WORKER = "worker"
 RESOURCE_HETER = "heter"
 RESOURCE_SERVE = "serve"
 RESOURCE_ROUTER = "router"
+# Cross-host disaggregation (ISSUE 13): prefill-pool pods — standalone
+# prefill servers (infer/prefill_serve.py) the decode replicas hand
+# cold prompts to over the network.
+RESOURCE_PREFILL = "prefill"
 
 # Default port serving replicas bind (/v1/generate + /readyz +
 # /metrics) and the router fronts; per-job override in ServingSpec.
 SERVE_PORT = 8700
+# Default port prefill-pool pods bind (/v1/prefill + /readyz +
+# /metrics); per-job override in PrefillPoolSpec.
+PREFILL_PORT = 8701
 
 # Label / annotation keys stamped on child resources
 # (reference: api/v1/paddlejob_types.go:27-31 -> "paddle-res-name" etc.)
@@ -270,6 +277,131 @@ class ResourceSpec:
 
 
 @dataclass
+class PrefillPoolSpec:
+    """The PREFILL pool (ISSUE 13, cross-host disaggregation): a
+    second reconciler-managed pod set running standalone prefill
+    servers (``python -m paddle_operator_tpu.infer.prefill_serve``).
+    Decode replicas hand every cold prompt to the pool over HTTP
+    (router-forwarded to the least-loaded ready pod) and land the
+    returned block snapshot through the promote scatter — so prefill
+    capacity scales INDEPENDENTLY of decode, the DistServe argument at
+    the pod level.
+
+    - ``replicas``  desired prefill pods (the SLO autoscaler overrides
+      this live when ``serving.autoscale`` bounds the pool);
+    - ``port``      the port each prefill pod serves /v1/prefill on;
+    - ``template``  prefill pod template — when empty it derives from
+      the serving replica template's image running the prefill module
+      (the common case: same image, different entrypoint).
+    """
+
+    replicas: int = 1
+    port: int = PREFILL_PORT
+    template: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"replicas": self.replicas}
+        if self.port != PREFILL_PORT:
+            d["port"] = self.port
+        if self.template:
+            d["template"] = self.template
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["PrefillPoolSpec"]:
+        if d is None:
+            return None
+        return cls(
+            replicas=int(d.get("replicas", 1)),
+            port=int(d.get("port", PREFILL_PORT)),
+            template=d.get("template", {}) or {},
+        )
+
+
+@dataclass
+class AutoscaleSpec:
+    """Declared serving SLOs + per-pool replica bounds (ISSUE 13) —
+    what the operator's SLO autoscaler (controller/autoscaler.py)
+    scales each pool against, using the gauges the router already
+    scrapes.  A pool autoscales only when its ``max`` bound is > 0;
+    otherwise its spec replica count stands.
+
+    - ``ttft_target_ms``    cold-TTFT SLO: the autoscaler converts it
+      into a per-prefill-pod queue-depth bound via the pool's scraped
+      per-job service time (``prefillMsAvg``) — queued jobs serialize,
+      so depth x service time IS the queue's TTFT contribution;
+    - ``tok_s_per_replica`` decode throughput target per replica: the
+      fleet's decode tok/s above this per ready replica reads as
+      overload (scale up), far below as waste (scale down);
+    - ``min_replicas``/``max_replicas``        decode-pool bounds;
+    - ``prefill_min``/``prefill_max``          prefill-pool bounds;
+    - ``cooldown_s``        minimum seconds between DOWNSCALE actions
+      per pool — the relax-slowly half of the damping;
+    - ``up_cooldown_s``     minimum seconds between UPSCALE actions —
+      deliberately much shorter (react-fast): a burst's backlog grows
+      at the arrival rate while capacity boots, so waiting out the
+      full down-cool-down before the next up-step converts directly
+      into queue-wait TTFT.  Flapping is prevented by the control
+      law's anticipatory denominator (load ratios divide by pods
+      already REQUESTED, not just pods ready), not by symmetric
+      damping;
+    - ``scale_down_ratio``  hysteresis low-water mark: scale down only
+      when load sinks below this fraction of the scale-up threshold
+      (0.5 default), so load hovering AT the threshold never flaps.
+    """
+
+    ttft_target_ms: float = 0.0
+    tok_s_per_replica: float = 0.0
+    min_replicas: int = 1
+    max_replicas: int = 0
+    prefill_min: int = 1
+    prefill_max: int = 0
+    cooldown_s: float = 30.0
+    up_cooldown_s: float = 5.0
+    scale_down_ratio: float = 0.5
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {}
+        if self.ttft_target_ms:
+            d["ttftTargetMs"] = self.ttft_target_ms
+        if self.tok_s_per_replica:
+            d["tokSPerReplica"] = self.tok_s_per_replica
+        if self.min_replicas != 1:
+            d["minReplicas"] = self.min_replicas
+        if self.max_replicas:
+            d["maxReplicas"] = self.max_replicas
+        if self.prefill_min != 1:
+            d["prefillMin"] = self.prefill_min
+        if self.prefill_max:
+            d["prefillMax"] = self.prefill_max
+        if self.cooldown_s != 30.0:
+            d["cooldownS"] = self.cooldown_s
+        if self.up_cooldown_s != 5.0:
+            d["upCooldownS"] = self.up_cooldown_s
+        if self.scale_down_ratio != 0.5:
+            d["scaleDownRatio"] = self.scale_down_ratio
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["AutoscaleSpec"]:
+        if d is None:
+            return None
+        return cls(
+            ttft_target_ms=float(d.get("ttftTargetMs", 0.0)),
+            tok_s_per_replica=float(d.get("tokSPerReplica", 0.0)),
+            min_replicas=int(d.get("minReplicas", 1)),
+            max_replicas=int(d.get("maxReplicas", 0)),
+            prefill_min=int(d.get("prefillMin", 1)),
+            prefill_max=int(d.get("prefillMax", 0)),
+            cooldown_s=float(d.get("cooldownS", 30.0)),
+            up_cooldown_s=float(d.get("upCooldownS", 5.0)),
+            scale_down_ratio=float(d.get("scaleDownRatio", 0.5)),
+        )
+
+
+@dataclass
 class ServingSpec:
     """The serving fleet (ISSUE 9): N inference ring replicas
     (infer/serve.py pods) behind one prefix-affinity router
@@ -332,6 +464,19 @@ class ServingSpec:
     - ``migrate_parked_s`` preemption-parked lanes older than this
       also migrate to an idle peer OUTSIDE a drain (0 disables) ->
       SERVE_MIGRATE_PARKED_S.
+
+    Cross-host disaggregation + SLO autoscaling (ISSUE 13):
+
+    - ``prefill_pool``     a :class:`PrefillPoolSpec` — prefill
+      executors in their OWN pods; decode replicas get
+      SERVE_PREFILL=disagg + SERVE_PREFILL_REMOTE=1 +
+      SERVE_PREFILL_BROKER (the fleet service, so the router forwards
+      each job to the least-loaded ready prefill pod);
+    - ``autoscale``        an :class:`AutoscaleSpec` — declared
+      TTFT/throughput targets + min/max replicas per pool; the
+      reconciler scales each pool off the scraped gauges with
+      hysteresis and a cool-down, every downscale through the PR 9
+      drain-aware victim path.
     """
 
     replicas: int = 1
@@ -350,6 +495,8 @@ class ServingSpec:
     peer_prefix_fetch: Optional[bool] = None
     host_cache_mb: int = 0
     migrate_parked_s: float = 0.0
+    prefill_pool: Optional[PrefillPoolSpec] = None
+    autoscale: Optional[AutoscaleSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"replicas": self.replicas}
@@ -383,6 +530,10 @@ class ServingSpec:
             d["hostCacheMb"] = self.host_cache_mb
         if self.migrate_parked_s:
             d["migrateParkedS"] = self.migrate_parked_s
+        if self.prefill_pool is not None:
+            d["prefillPool"] = self.prefill_pool.to_dict()
+        if self.autoscale is not None:
+            d["autoscale"] = self.autoscale.to_dict()
         return d
 
     @classmethod
@@ -411,6 +562,9 @@ class ServingSpec:
                                else None),
             host_cache_mb=int(d.get("hostCacheMb", 0)),
             migrate_parked_s=float(d.get("migrateParkedS", 0.0)),
+            prefill_pool=PrefillPoolSpec.from_dict(
+                d.get("prefillPool")),
+            autoscale=AutoscaleSpec.from_dict(d.get("autoscale")),
         )
 
 
@@ -553,6 +707,9 @@ class TPUJobStatus:
     # replica exiting 83 is a completed drain handled by the fleet
     # path, never a reason to tear the training gang down.
     serve: ResourceStatus = field(default_factory=ResourceStatus)
+    # Prefill-pool pod counters (ISSUE 13) — visibility-only, same
+    # exclusion from the gang derivation as ``serve``.
+    prefill: ResourceStatus = field(default_factory=ResourceStatus)
     elastic: str = ""
     start_time: Optional[str] = None          # RFC3339
     completion_time: Optional[str] = None
@@ -619,6 +776,9 @@ class TPUJobStatus:
         serve = self.serve.to_dict()
         if serve:
             d["serve"] = serve
+        prefill = self.prefill.to_dict()
+        if prefill:
+            d["prefill"] = prefill
         if self.elastic:
             d["elastic"] = self.elastic
         if self.start_time:
@@ -651,6 +811,7 @@ class TPUJobStatus:
             worker=ResourceStatus.from_dict(d.get("worker")),
             heter=ResourceStatus.from_dict(d.get("heter")),
             serve=ResourceStatus.from_dict(d.get("serve")),
+            prefill=ResourceStatus.from_dict(d.get("prefill")),
             elastic=d.get("elastic", ""),
             start_time=d.get("startTime"),
             completion_time=d.get("completionTime"),
@@ -712,6 +873,34 @@ class TPUJob:
                 errs.append("serving.blockSize must be >= 1")
             if sv.affinity_blocks < 0:
                 errs.append("serving.affinityBlocks must be >= 0")
+            if sv.prefill_pool is not None \
+                    and sv.prefill_pool.replicas < 0:
+                errs.append("serving.prefillPool.replicas must be "
+                            ">= 0")
+            if sv.autoscale is not None:
+                a = sv.autoscale
+                if a.max_replicas and a.max_replicas < a.min_replicas:
+                    errs.append("serving.autoscale: maxReplicas < "
+                                "minReplicas")
+                if a.prefill_max and a.prefill_max < a.prefill_min:
+                    errs.append("serving.autoscale: prefillMax < "
+                                "prefillMin")
+                if a.prefill_max and sv.prefill_pool is None:
+                    errs.append("serving.autoscale.prefillMax set "
+                                "without serving.prefillPool")
+                # a pool whose autoscale is enabled (max > 0) but
+                # whose SLO target is unset would read load ratio 0.0
+                # forever: drained to min and never scaled back up —
+                # refuse loudly instead of quietly decimating a fleet
+                if a.max_replicas and a.tok_s_per_replica <= 0:
+                    errs.append("serving.autoscale.maxReplicas set "
+                                "without tokSPerReplica (> 0)")
+                if a.prefill_max and a.ttft_target_ms <= 0:
+                    errs.append("serving.autoscale.prefillMax set "
+                                "without ttftTargetMs (> 0)")
+                if not 0 < a.scale_down_ratio < 1:
+                    errs.append("serving.autoscale.scaleDownRatio "
+                                "must be in (0, 1)")
         if self.spec.tpu is not None:
             try:
                 self.spec.tpu.chips_per_slice()

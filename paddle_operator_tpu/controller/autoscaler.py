@@ -1,0 +1,207 @@
+"""SLO autoscaler control law (ISSUE 13) — pure functions, no I/O.
+
+The paper's thesis is the orchestration contract: declarative spec in,
+reconciler materializes pods.  This module closes the serving-economics
+loop on top of it — the CRD declares SLOs (``spec.serving.autoscale``:
+a cold-TTFT target and a per-replica throughput target, with min/max
+replicas per pool) and the reconciler scales the DECODE pool and the
+PREFILL pool independently off the gauges the router already scrapes
+into ``status.serving`` (prefill queue depth + per-job service time,
+fleet tok/s, free KV blocks).
+
+Everything here is a pure function of (spec, observed gauges, stored
+state, now) so the control law is table-driven-testable with the
+FakeAPI — the same discipline as controller/builders.py.  The
+reconciler owns persistence: decisions and last-action stamps live in
+``status.serving.fleet.autoscaler`` and ride the normal status write.
+
+The law, per pool:
+
+1. **load ratio** — observed load over the pool's declared per-replica
+   capacity (:func:`prefill_load_ratio` / :func:`decode_load_ratio`);
+   1.0 means "exactly at target".
+2. **hysteresis** — scale UP only above 1.0, DOWN only below
+   ``scale_down_ratio`` (default 0.5); load hovering at the threshold
+   never flaps.
+3. **asymmetric cool-down** — upscale waits only ``up_cooldown_s``
+   (react fast: a burst's backlog grows at the arrival rate while
+   capacity boots, so up-step latency converts directly into
+   queue-wait TTFT) and steps proportionally to the overload;
+   downscale waits the full ``cooldown_s`` and always sheds ONE
+   replica (each goes through the PR 9 drain — gradual capacity loss,
+   and the next window re-reads the gauges the drain changed).
+   Fast-up cannot flap because the load ratios use an ANTICIPATORY
+   denominator: pods already REQUESTED count as capacity even while
+   they boot, so a pending up-step suppresses the next one instead of
+   compounding it.
+4. **drain gate** — while a victim is mid-drain the pool never shrinks
+   further (the observed gauges still include the draining pod's
+   capacity; deciding off them would overshoot).
+5. **min/max clamp** — always.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+from paddle_operator_tpu.api.types import AutoscaleSpec
+
+# status.serving.fleet key the reconciler persists decisions under
+STATE_KEY = "autoscaler"
+
+# The law targets this fraction of the declared TTFT SLO as its
+# steady-state setpoint.  Controlling AT the limit means every boot
+# transient and burst onset breaches it — p95 lives in the transients;
+# holding the queue at half the budget leaves the headroom that
+# absorbs them (the standard SLO-setpoint discipline; 0.5 holds the
+# bench's bursty reference trace at p95 0.9x the target where 1.0
+# breached it by 40%).
+SLO_HEADROOM = 0.5
+
+
+def prefill_load_ratio(queue_depth: float, ready: int,
+                       prefill_ms_avg: float,
+                       ttft_target_ms: float) -> float:
+    """Observed prefill load over SLO capacity.  Queued jobs serialize
+    per pod, so a pod's queue contributes ``depth x service_time`` to
+    the cold TTFT of the job at its tail; the pool meets the target
+    while per-pod depth stays under the SLO budget over the service
+    time — with :data:`SLO_HEADROOM` of the budget as the setpoint so
+    boot transients and burst onsets land INSIDE the target rather
+    than on top of it.  With no service-time reading yet (a fresh
+    pool), one queued job per pod is taken as the capacity —
+    conservative: the pool grows until real readings arrive."""
+    if ttft_target_ms <= 0:
+        return 0.0
+    ready = max(1, int(ready))
+    if prefill_ms_avg > 0:
+        allowed_per_pod = max(
+            1.0, ttft_target_ms * SLO_HEADROOM / prefill_ms_avg - 1.0)
+    else:
+        allowed_per_pod = 1.0
+    return float(queue_depth) / (ready * allowed_per_pod)
+
+
+def decode_load_ratio(tokens_per_sec: float, queue_depth: float,
+                      kv_blocks_free: float, ready: int,
+                      tok_s_per_replica: float) -> float:
+    """Observed decode load over SLO capacity: fleet tok/s against the
+    declared per-replica target, pushed ABOVE 1.0 when the fleet is
+    visibly starved regardless of throughput — requests queueing while
+    the KV pool runs dry means admission-bound saturation the tok/s
+    reading alone can hide (an admission-starved fleet's tok/s
+    plateaus BELOW target exactly because it needs more replicas)."""
+    if tok_s_per_replica <= 0:
+        return 0.0
+    ready = max(1, int(ready))
+    ratio = float(tokens_per_sec) / (ready * tok_s_per_replica)
+    if queue_depth > 0 and kv_blocks_free <= 0:
+        # starvation floor: at least "one replica over capacity", plus
+        # pressure proportional to the backlog
+        ratio = max(ratio, 1.0 + float(queue_depth) / (ready * 4.0))
+    return ratio
+
+
+def step(spec_min: int, spec_max: int, current: int, ratio: float, *,
+         now: float, last_scale_t: float, cooldown_s: float,
+         up_cooldown_s: float, scale_down_ratio: float,
+         draining: bool) -> Tuple[int, str]:
+    """One control-law step for one pool: returns ``(desired,
+    reason)`` where reason is "" when nothing changes.  ``current`` is
+    the pool's current DESIRED count (the stored decision, not the
+    live pod count — pods catching up is the reconciler's business,
+    not a reason to re-scale)."""
+    if spec_max <= 0:
+        return current, ""                  # autoscale off: spec stands
+    lo, hi = max(0, int(spec_min)), int(spec_max)
+    clamped = min(max(current, lo), hi)
+    if clamped != current:
+        return clamped, "clamp"             # spec bounds moved
+    if ratio > 1.0 and current < hi:
+        if now - last_scale_t < up_cooldown_s:
+            return current, ""              # (short) up cool-down
+        # proportional step: a 3x overload asks for ~3x the pods in
+        # one window, still clamped; the anticipatory denominator
+        # (observe()) keeps consecutive windows from compounding the
+        # same backlog into runaway growth
+        want = min(hi, max(current + 1,
+                           int(math.ceil(current * min(ratio, 4.0)))))
+        return want, "up"
+    if ratio < scale_down_ratio and current > lo:
+        if draining:
+            return current, ""              # drain in flight: hold
+        if now - last_scale_t < cooldown_s:
+            return current, ""              # (long) down cool-down
+        return current - 1, "down"          # one at a time, drained
+    return current, ""
+
+
+class FleetAutoscaler:
+    """The two-pool law over one observation.  Stateless — callers
+    pass the stored state dict (``status.serving.fleet.autoscaler``)
+    in and persist the returned one."""
+
+    def __init__(self, spec: AutoscaleSpec) -> None:
+        self.spec = spec
+
+    def observe(self, state: Optional[Dict[str, Any]],
+                serving: Dict[str, Any], *, decode_spec: int,
+                prefill_spec: int, decode_ready: int,
+                prefill_ready: int, decode_draining: bool,
+                prefill_draining: bool, now: float
+                ) -> Dict[str, Any]:
+        """One pass: read the aggregated ``status.serving`` gauges,
+        return the new state dict ``{"decodeDesired", "prefillDesired",
+        "decodeLastScaleT", "prefillLastScaleT", "decodeReason",
+        "prefillReason"}``.  ``decode_spec``/``prefill_spec`` seed the
+        desired counts on the first pass (and stand entirely for a
+        pool whose max bound is 0)."""
+        a = self.spec
+        st = dict(state or {})
+        d_cur = int(st.get("decodeDesired", decode_spec))
+        p_cur = int(st.get("prefillDesired", prefill_spec))
+        # first observation: treat job creation as the last action, so
+        # a fresh fleet with no gauges yet gets one full cool-down of
+        # grace instead of an instant idle-downscale off zero readings
+        d_last = float(st.get("decodeLastScaleT", now))
+        p_last = float(st.get("prefillLastScaleT", now))
+
+        # ANTICIPATORY denominators: capacity already requested (the
+        # stored desired counts) suppresses the next up-step while it
+        # boots — the flap guard that makes the short up cool-down
+        # safe.  max() with ready covers spec edits that shrank
+        # desired below what is actually serving.
+        d_ratio = decode_load_ratio(
+            float(serving.get("tokensPerSec", 0.0) or 0.0),
+            float(serving.get("queueDepth", 0.0) or 0.0),
+            float(serving.get("kvBlocksFree", 0.0) or 0.0),
+            max(decode_ready, d_cur), a.tok_s_per_replica)
+        p_ratio = prefill_load_ratio(
+            float(serving.get("prefillQueueDepth", 0.0) or 0.0),
+            max(prefill_ready, p_cur),
+            float(serving.get("prefillMsAvg", 0.0) or 0.0),
+            a.ttft_target_ms)
+
+        d_new, d_why = step(
+            a.min_replicas, a.max_replicas, d_cur, d_ratio, now=now,
+            last_scale_t=d_last, cooldown_s=a.cooldown_s,
+            up_cooldown_s=a.up_cooldown_s,
+            scale_down_ratio=a.scale_down_ratio,
+            draining=decode_draining)
+        p_new, p_why = step(
+            a.prefill_min, a.prefill_max, p_cur, p_ratio, now=now,
+            last_scale_t=p_last, cooldown_s=a.cooldown_s,
+            up_cooldown_s=a.up_cooldown_s,
+            scale_down_ratio=a.scale_down_ratio,
+            draining=prefill_draining)
+        return {
+            "decodeDesired": d_new,
+            "prefillDesired": p_new,
+            "decodeLastScaleT": round(now, 3) if d_why else d_last,
+            "prefillLastScaleT": round(now, 3) if p_why else p_last,
+            "decodeReason": d_why,
+            "prefillReason": p_why,
+            "decodeLoadRatio": round(d_ratio, 4),
+            "prefillLoadRatio": round(p_ratio, 4),
+        }

@@ -19,6 +19,7 @@ Kubernetes objects are represented as plain dicts (their JSON form).
 
 from __future__ import annotations
 
+import copy
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -30,6 +31,7 @@ from paddle_operator_tpu.api.types import (
     RESOURCE_ANNOTATION,
     RESOURCE_HETER,
     RESOURCE_NAME_LABEL,
+    RESOURCE_PREFILL,
     RESOURCE_PS,
     RESOURCE_ROUTER,
     RESOURCE_SERVE,
@@ -281,9 +283,11 @@ def construct_configmap(job: TPUJob, child_pods: List[Dict[str, Any]]) -> Option
     )
 
     serve_hosts: Dict[int, str] = {}
+    prefill_hosts: Dict[int, str] = {}
     for pod in child_pods:
         res_type, idx = extract_name_index(pod["metadata"]["name"])
-        if res_type in (RESOURCE_SERVE, RESOURCE_ROUTER):
+        if res_type in (RESOURCE_SERVE, RESOURCE_ROUTER,
+                        RESOURCE_PREFILL):
             # fleet pods never gate the TRAINING rendezvous barrier;
             # their endpoint list below is partial-tolerant (it
             # regenerates as addresses appear, and the router re-reads
@@ -291,6 +295,8 @@ def construct_configmap(job: TPUJob, child_pods: List[Dict[str, Any]]) -> Option
             host = _pod_host(job, pod)
             if res_type == RESOURCE_SERVE and host is not None:
                 serve_hosts[idx] = host
+            elif res_type == RESOURCE_PREFILL and host is not None:
+                prefill_hosts[idx] = host
             continue
         host = _pod_host(job, pod)
         if host is None:
@@ -386,6 +392,16 @@ def construct_configmap(job: TPUJob, child_pods: List[Dict[str, Any]]) -> Option
         data["TPUJOB_SERVE_REPLICAS"] = ",".join(
             f"{serve_hosts[i]}:{port}" for i in sorted(serve_hosts))
         data["TPUJOB_SERVE_FLEET_SIZE"] = str(job.spec.serving.replicas)
+        if job.spec.serving.prefill_pool is not None:
+            # prefill pool (ISSUE 13): the second endpoint list the
+            # router forwards /v1/prefill jobs over.  ALWAYS written
+            # (even empty) so the router's live file re-read can drop
+            # autoscaled-away pods — an absent key would freeze its
+            # last view.
+            pport = job.spec.serving.prefill_pool.port
+            data["TPUJOB_PREFILL_REPLICAS"] = ",".join(
+                f"{prefill_hosts[i]}:{pport}"
+                for i in sorted(prefill_hosts))
 
     return {
         "apiVersion": "v1",
@@ -652,6 +668,16 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
     if sv.migrate_parked_s:
         _env_setdefault(env, "SERVE_MIGRATE_PARKED_S",
                         str(sv.migrate_parked_s))
+    # cross-host disaggregation (ISSUE 13): with a prefill pool, every
+    # decode replica hands cold prompts to it — disagg prefill mode,
+    # remote flavor, jobs brokered through the fleet service (the
+    # router forwards /v1/prefill to the least-loaded ready prefill
+    # pod).  All user-overridable, like every operator default here.
+    if sv.prefill_pool is not None:
+        _env_setdefault(env, "SERVE_PREFILL", "disagg")
+        _env_setdefault(env, "SERVE_PREFILL_REMOTE", "1")
+        _env_setdefault(env, "SERVE_PREFILL_BROKER",
+                        f"{job.name}-{RESOURCE_SERVE}:{sv.port}")
     if job.spec.checkpoint_path:
         _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
                         job.spec.checkpoint_path)
@@ -673,6 +699,80 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
     # the drain budget must fit inside kubelet's SIGTERM->SIGKILL
     # window, or a busy replica gets killed mid-flush (exit 137, a
     # budget-burning failure instead of a preemption)
+    spec.setdefault("terminationGracePeriodSeconds", 60)
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
+            "spec": spec}
+
+
+def construct_prefill_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
+    """One prefill-pool pod (ISSUE 13) from
+    ``spec.serving.prefillPool.template`` — or, when that is empty,
+    derived from the serving replica template's image running the
+    standalone prefill server (same image, different entrypoint: the
+    common case).  Injected contract mirrors the serve pod: identity
+    env, the prefill port, SERVE_BLOCK_SIZE matching the fleet (a
+    block-size skew would be refused at every handoff by the
+    fingerprint — inject the right one instead), TPU placement, and
+    restartPolicy Never so a drain's exit 83 stays observable."""
+    sv = job.spec.serving
+    pp = sv.prefill_pool
+    name = gen_res_name(job.name, RESOURCE_PREFILL, idx)
+    template = pp.template
+    if not (template.get("spec") or {}).get("containers"):
+        image, inherit_env = "", []
+        if sv.template:
+            tcs = (sv.template.get("spec") or {}).get("containers") or []
+            if tcs:
+                image = tcs[0].get("image", "")
+                # inherit the serving container's env wholesale: fleet
+                # config rides it (SERVE_KV_QUANT, MODEL_PRESET,
+                # SERVE_MAX_LEN, ...) and a prefill pod that boots
+                # without it has a skewed handoff fingerprint — every
+                # POST 409s and remote prefill is an outage while all
+                # pods look healthy
+                inherit_env = copy.deepcopy(tcs[0].get("env") or [])
+        c = {
+            "name": "prefill",
+            "image": image,
+            "command": ["python", "-m",
+                        "paddle_operator_tpu.infer.prefill_serve"],
+        }
+        if inherit_env:
+            c["env"] = inherit_env
+        template = {"spec": {"containers": [c]}}
+    meta, spec, c0 = _stamp_fleet_child(job, template,
+                                        RESOURCE_PREFILL, name,
+                                        pp.port)
+    env = c0.setdefault("env", [])
+    if job.spec.intranet == Intranet.SERVICE:
+        env.append({"name": "POD_IP", "value": name})
+    else:
+        env.append({
+            "name": "POD_IP",
+            "valueFrom": {"fieldRef": {"fieldPath": "status.podIP"}},
+        })
+    env.append({"name": "TPUJOB_REPLICA_ID", "value": str(idx)})
+    env.append({"name": "TPUJOB_RES_TYPE", "value": RESOURCE_PREFILL})
+    env.append({"name": "TPUJOB_NAME", "value": job.name})
+    env.append({"name": "TPUJOB_PORT", "value": str(pp.port)})
+    _env_setdefault(env, "SERVE_BLOCK_SIZE", str(sv.block_size))
+    if job.spec.checkpoint_path:
+        _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
+                        job.spec.checkpoint_path)
+    tpu = job.spec.tpu
+    if tpu is not None:
+        chips = tpu.effective_chips_per_worker()
+        resources = c0.setdefault("resources", {})
+        resources.setdefault("limits", {})["google.com/tpu"] = chips
+        resources.setdefault("requests", {})["google.com/tpu"] = chips
+        sel = spec.setdefault("nodeSelector", {})
+        sel.setdefault("cloud.google.com/gke-tpu-accelerator",
+                       tpu.accelerator)
+        sel.setdefault("cloud.google.com/gke-tpu-topology",
+                       tpu.topology)
+    if job.spec.scheduler_name and not spec.get("schedulerName"):
+        spec["schedulerName"] = job.spec.scheduler_name
+    spec["restartPolicy"] = "Never"
     spec.setdefault("terminationGracePeriodSeconds", 60)
     return {"apiVersion": "v1", "kind": "Pod", "metadata": meta,
             "spec": spec}
@@ -717,6 +817,13 @@ def construct_router_pod(job: TPUJob) -> Dict[str, Any]:
     _env_setdefault(
         env, "ROUTER_ENDPOINTS_FILE",
         f"{ROUTER_ENDPOINTS_MOUNT}/TPUJOB_SERVE_REPLICAS")
+    if sv.prefill_pool is not None:
+        # prefill pool (ISSUE 13): the second endpoint list, same
+        # live-reload volume trick — the autoscaler's pool changes
+        # reach the running router through the ConfigMap file
+        _env_setdefault(
+            env, "ROUTER_PREFILL_ENDPOINTS_FILE",
+            f"{ROUTER_ENDPOINTS_MOUNT}/TPUJOB_PREFILL_REPLICAS")
     mounts = c0.setdefault("volumeMounts", [])
     if not any(m.get("name") == "fleet-endpoints" for m in mounts):
         mounts.append({"name": "fleet-endpoints",

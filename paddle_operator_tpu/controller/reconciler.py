@@ -34,6 +34,7 @@ from paddle_operator_tpu.api.types import (
     DRAIN_ANNOTATION,
     HOSTPORT_ANNOTATION,
     RESOURCE_HETER,
+    RESOURCE_PREFILL,
     RESOURCE_PS,
     RESOURCE_ROUTER,
     RESOURCE_SERVE,
@@ -82,8 +83,14 @@ def _now() -> str:
 
 class TPUJobReconciler:
     def __init__(self, api: APIClient, allocator=None) -> None:
+        import time
+
         self.api = api
         self.allocator = allocator or make_allocator()
+        # SLO autoscaler clock (ISSUE 13): wall time so cool-down
+        # stamps persisted in status survive controller restarts;
+        # tests override with a fake to drive the cool-down window
+        self.clock = time.time
         # job key -> adopted host-port block base (collision detection)
         self._adopted: Dict[str, int] = {}
         # job key -> generation whose InvalidSpec event was already emitted
@@ -435,14 +442,25 @@ class TPUJobReconciler:
                 # router in front of zero ready replicas is an outage,
                 # not RUNNING (fleet.routerReady carries the router).
                 sync(status.serve, pod)
+            elif res_type == RESOURCE_PREFILL:
+                # prefill-pool pods (ISSUE 13): visibility-only, same
+                # exclusions as serve — a pool outage degrades cold
+                # TTFT (decode falls back to retriable 503s the client
+                # re-routes), it does not fail the job
+                sync(status.prefill, pod)
 
         status.ps.refs.sort(key=lambda r: r["name"])
         status.worker.refs.sort(key=lambda r: r["name"])
         status.heter.refs.sort(key=lambda r: r["name"])
         status.serve.refs.sort(key=lambda r: r["name"])
+        status.prefill.refs.sort(key=lambda r: r["name"])
         if job.spec.serving:
             status.serve.ready = (
                 f"{status.serve.running}/{job.spec.serving.replicas}")
+            if job.spec.serving.prefill_pool is not None:
+                status.prefill.ready = (
+                    f"{status.prefill.running}/"
+                    f"{job.spec.serving.prefill_pool.replicas}")
         if job.spec.ps:
             status.ps.ready = f"{status.ps.running}/{job.spec.ps.replicas}"
         if job.spec.worker:
@@ -524,7 +542,8 @@ class TPUJobReconciler:
         if name == f"{job.name}-{RESOURCE_SERVE}":
             return True
         res_type, _ = builders.extract_name_index(name)
-        return res_type in (RESOURCE_SERVE, RESOURCE_ROUTER)
+        return res_type in (RESOURCE_SERVE, RESOURCE_ROUTER,
+                            RESOURCE_PREFILL)
 
     def _teardown_gang(self, job: TPUJob,
                        child_pods: List[Dict[str, Any]]) -> bool:
@@ -706,14 +725,27 @@ class TPUJobReconciler:
         # time, then delete the router and the fleet Service
         sv = job.spec.serving or ServingSpec(replicas=0, template={})
         serve_pods: Dict[int, Dict[str, Any]] = {}
+        prefill_pods: Dict[int, Dict[str, Any]] = {}
         router_pods: List[Dict[str, Any]] = []
         for pod in child_pods:
             res_type, idx = builders.extract_name_index(
                 pod["metadata"]["name"])
             if res_type == RESOURCE_SERVE:
                 serve_pods[idx] = pod
+            elif res_type == RESOURCE_PREFILL:
+                prefill_pods[idx] = pod
             elif res_type == RESOURCE_ROUTER:
                 router_pods.append(pod)
+
+        # -- SLO autoscaler (ISSUE 13): the declared TTFT/throughput
+        #    targets turn the spec replica counts into LIVE desired
+        #    counts, off the scraped gauges in status.serving —
+        #    hysteresis, cool-down and min/max clamp in
+        #    controller/autoscaler.py; every downscale below goes
+        #    through the same drain-aware victim path a spec edit
+        #    would.  With no autoscale block the spec counts stand.
+        eff_serve, eff_prefill = self._autoscale_serving(
+            job, raw, sv, serve_pods, prefill_pods)
 
         # -- fleet service + router pod (want exactly one of each
         #    while any replica is desired, none otherwise) ------------
@@ -763,68 +795,95 @@ class TPUJobReconciler:
 
         # -- scale-down: drain ONE victim at a time, highest index
         #    first, so the fleet loses capacity gradually and the
-        #    router re-homes each victim's prefixes once -------------
-        victims = sorted((i for i in serve_pods if i >= sv.replicas),
+        #    router re-homes each victim's prefixes once.  Decode
+        #    victims drain by completion/migration (PR 9/12); prefill
+        #    victims drain by finishing their in-flight jobs and
+        #    REFUSING new handoffs (503 — the decode side retries the
+        #    next pod), both through the same annotate→SIGTERM→exit-83
+        #    operator protocol. --------------------------------------
+        victims = sorted((i for i in serve_pods if i >= eff_serve),
                          reverse=True)
         if victims:
             pod = serve_pods[victims[0]]
             return self._drain_serve_victim(job, raw, pod)
+        pvictims = sorted((i for i in prefill_pods if i >= eff_prefill),
+                          reverse=True)
+        if pvictims:
+            pod = prefill_pods[pvictims[0]]
+            return self._drain_serve_victim(job, raw, pod,
+                                            counter="prefillDrained")
 
         # -- replace failed in-range replicas (one per pass): a
         #    preempted exit (83 — node preemption, or a drain we did
         #    not ask for) is absorbed without burning anything;
         #    anything else bumps the fleet's replicaRestarts counter
         #    (visible, but never the gang's maxRestarts budget) -------
-        for idx in sorted(serve_pods):
-            pod = serve_pods[idx]
-            phase = pod.get("status", {}).get("phase", "")
-            if phase not in ("Failed", "Succeeded"):
-                continue
-            if pod["metadata"].get("deletionTimestamp"):
-                continue   # already accounted; kubelet is terminating
-            if builders.is_pod_preempted(pod):
-                def bump(j):
-                    j.status.preempted_count += 1
-                self.api.record_event(
-                    raw, "Normal", "ReplicaPreempted",
-                    f"serving replica {pod['metadata']['name']} "
-                    f"drained (exit 83); replacing without burning "
-                    f"the restart budget")
-            else:
-                def bump(j):
-                    self._bump_fleet_counter(j, "replicaRestarts")
-                self.api.record_event(
-                    raw, "Warning", "ReplicaFailed",
-                    f"serving replica {pod['metadata']['name']} "
-                    f"{phase.lower()}; replacing")
-            # account BEFORE deleting (once the pod object is gone the
-            # exit code is unobservable), exactly once per pod uid
-            if not self._account_replica_exit(job, pod, bump):
+        for pool, pods, restart_key in (
+                ("serving", serve_pods, "replicaRestarts"),
+                ("prefill", prefill_pods, "prefillRestarts")):
+            for idx in sorted(pods):
+                pod = pods[idx]
+                phase = pod.get("status", {}).get("phase", "")
+                if phase not in ("Failed", "Succeeded"):
+                    continue
+                if pod["metadata"].get("deletionTimestamp"):
+                    continue   # already accounted; kubelet terminating
+                if builders.is_pod_preempted(pod):
+                    def bump(j):
+                        j.status.preempted_count += 1
+                    self.api.record_event(
+                        raw, "Normal", "ReplicaPreempted",
+                        f"{pool} replica {pod['metadata']['name']} "
+                        f"drained (exit 83); replacing without burning "
+                        f"the restart budget")
+                else:
+                    def bump(j, _k=restart_key):
+                        self._bump_fleet_counter(j, _k)
+                    self.api.record_event(
+                        raw, "Warning", "ReplicaFailed",
+                        f"{pool} replica {pod['metadata']['name']} "
+                        f"{phase.lower()}; replacing")
+                # account BEFORE deleting (once the pod object is gone
+                # the exit code is unobservable), once per pod uid
+                if not self._account_replica_exit(job, pod, bump):
+                    return Result(requeue_after=1.0)
+                self._delete_serve_pod(job, pod)
                 return Result(requeue_after=1.0)
-            self._delete_serve_pod(job, pod)
-            return Result(requeue_after=1.0)
 
         # -- scale-up / create missing replicas.  All missing pods are
         #    created in one pass (replicas are independent — there is
         #    no gang atomicity to preserve); the router admits each
-        #    only once its /readyz goes true. -------------------------
+        #    only once its /readyz goes true.  The prefill pool scales
+        #    up the same way: traffic admission is the router's
+        #    /v1/prefill candidate gate. ------------------------------
         created = 0
-        for idx in range(sv.replicas):
+        for idx in range(eff_serve):
             if idx in serve_pods:
                 continue
             pod = builders.construct_serve_pod(job, idx)
             self.api.set_controller_reference(raw, pod)
             self._create_child(job, KIND_POD, pod)
             created += 1
+        if sv.prefill_pool is not None:
+            for idx in range(eff_prefill):
+                if idx in prefill_pods:
+                    continue
+                pod = builders.construct_prefill_pod(job, idx)
+                self.api.set_controller_reference(raw, pod)
+                self._create_child(job, KIND_POD, pod)
+                created += 1
         if created:
             return Result(requeue_after=1.0)
 
-        if self._update_serving_status(job, serve_pods, router_pods):
+        if self._update_serving_status(job, serve_pods, router_pods,
+                                       prefill_pods, eff_serve,
+                                       eff_prefill):
             return Result(requeue_after=1.0)
         return None
 
     def _drain_serve_victim(self, job: TPUJob, raw: Dict[str, Any],
-                            pod: Dict[str, Any]) -> Result:
+                            pod: Dict[str, Any],
+                            counter: str = "drainedReplicas") -> Result:
         """One step of the scale-down drain for a single victim pod.
 
         The pod-side protocol is MIGRATION-FIRST when
@@ -851,7 +910,7 @@ class TPUJobReconciler:
             if builders.is_pod_preempted(pod):
                 def bump(j):
                     j.status.preempted_count += 1
-                    self._bump_fleet_counter(j, "drainedReplicas")
+                    self._bump_fleet_counter(j, counter)
                 self.api.record_event(
                     raw, "Normal", "ReplicaDrained",
                     f"scale-down: {meta['name']} drained cleanly "
@@ -887,7 +946,7 @@ class TPUJobReconciler:
         # will be gone before we could observe the exit code.
         def bump(j):
             j.status.preempted_count += 1
-            self._bump_fleet_counter(j, "drainedReplicas")
+            self._bump_fleet_counter(j, counter)
         self.api.record_event(
             raw, "Normal", "ReplicaDrained",
             f"scale-down: deleting {meta['name']} (SIGTERM drain; "
@@ -932,9 +991,82 @@ class TPUJobReconciler:
         del acct[:-8]        # bounded; uids never recur
         return self._persist_status(job)
 
+    def _autoscale_serving(self, job: TPUJob, raw: Dict[str, Any],
+                           sv, serve_pods: Dict[int, Dict[str, Any]],
+                           prefill_pods: Dict[int, Dict[str, Any]]
+                           ) -> tuple:
+        """Turn the spec replica counts into live DESIRED counts via
+        the SLO control law (controller/autoscaler.py), persisting
+        decisions + cool-down stamps in
+        ``status.serving.fleet.autoscaler`` so they survive controller
+        restarts and re-entered passes.  No ``spec.serving.autoscale``
+        block -> the spec counts stand untouched."""
+        pp = sv.prefill_pool
+        p_spec = pp.replicas if pp is not None else 0
+        if sv.autoscale is None:
+            return sv.replicas, p_spec
+        from paddle_operator_tpu.controller.autoscaler import (
+            STATE_KEY,
+            FleetAutoscaler,
+        )
+
+        fleet = job.status.serving.setdefault("fleet", {})
+        state = fleet.get(STATE_KEY) or None
+
+        def ready(pods):
+            return sum(1 for p in pods.values()
+                       if builders.is_pod_real_running(p))
+
+        def draining(pods):
+            # a victim mid-drain: annotated, or already deleted and
+            # terminating — the gauges still include its capacity, so
+            # the law must not shrink further off them (drain gate)
+            return any(
+                p["metadata"].get("deletionTimestamp")
+                or DRAIN_ANNOTATION in (p["metadata"].get("annotations")
+                                        or {})
+                for p in pods.values())
+
+        new = FleetAutoscaler(sv.autoscale).observe(
+            state, job.status.serving,
+            decode_spec=sv.replicas, prefill_spec=p_spec,
+            decode_ready=ready(serve_pods),
+            prefill_ready=ready(prefill_pods),
+            decode_draining=draining(serve_pods),
+            prefill_draining=draining(prefill_pods),
+            now=self.clock())
+        decisive = ("decodeDesired", "prefillDesired",
+                    "decodeLastScaleT", "prefillLastScaleT")
+        changed = state is None or any(
+            new[k] != state.get(k) for k in decisive)
+        # store the fresh pass only on a decisive change: the load
+        # ratios fluctuate in the 4th decimal every observation, and
+        # landing them in status each pass would defeat this filter
+        # with an API write per reconcile
+        fleet[STATE_KEY] = new if changed else state
+        if changed:
+            for pool in ("decode", "prefill"):
+                why = new.get(f"{pool}Reason")
+                if why in ("up", "down"):
+                    self.api.record_event(
+                        raw, "Normal", "Autoscaled",
+                        f"{pool} pool scaled {why} to "
+                        f"{new[pool + 'Desired']} (load ratio "
+                        f"{new[pool + 'LoadRatio']}, SLO control law)")
+            # persist the decision BEFORE acting on it: a crash between
+            # the scale action and the write must re-enter with the
+            # cool-down stamp in place, not re-fire the action.  A lost
+            # race just recomputes next pass.
+            self._persist_status(job)
+        return int(new["decodeDesired"]), int(new["prefillDesired"])
+
     def _update_serving_status(self, job: TPUJob,
                                serve_pods: Dict[int, Dict[str, Any]],
-                               router_pods: List[Dict[str, Any]]
+                               router_pods: List[Dict[str, Any]],
+                               prefill_pods: Optional[
+                                   Dict[int, Dict[str, Any]]] = None,
+                               eff_serve: Optional[int] = None,
+                               eff_prefill: Optional[int] = None
                                ) -> bool:
         """Refresh the operator-owned ``status.serving.fleet`` block
         and (when the replicas publish per-replica telemetry under
@@ -967,16 +1099,30 @@ class TPUJobReconciler:
             # aggregate rides ON TOP of whatever single-pod keys were
             # there: the fleet numbers are what dashboards should read
             serving.update(aggregate_fleet_serving(per_replica))
+        want_serve = sv.replicas if eff_serve is None else eff_serve
         ready = sum(
             1 for i, p in serve_pods.items()
-            if i < sv.replicas and builders.is_pod_real_running(p))
+            if i < want_serve and builders.is_pod_real_running(p))
         fleet = serving.setdefault("fleet", {})
-        fleet["replicasDesired"] = sv.replicas
+        # desired counts are the LIVE targets (autoscaler-adjusted
+        # when spec.serving.autoscale is set) — what pod counts are
+        # actually converging to, which is what dashboards should read
+        fleet["replicasDesired"] = want_serve
         fleet["replicasReady"] = ready
         fleet["routerReady"] = any(
             builders.is_pod_real_running(p) for p in router_pods)
         fleet.setdefault("drainedReplicas", 0)
         fleet.setdefault("replicaRestarts", 0)
+        if sv.prefill_pool is not None:
+            want_prefill = (sv.prefill_pool.replicas
+                            if eff_prefill is None else eff_prefill)
+            fleet["prefillReplicasDesired"] = want_prefill
+            fleet["prefillReplicasReady"] = sum(
+                1 for i, p in (prefill_pods or {}).items()
+                if i < want_prefill
+                and builders.is_pod_real_running(p))
+            fleet.setdefault("prefillDrained", 0)
+            fleet.setdefault("prefillRestarts", 0)
         if serving != before:
             self._persist_status(job)
             return True

@@ -1053,7 +1053,8 @@ class RingExecutor:
                  kv_quant: str = "none",
                  host_cache_blocks: int = 0,
                  adapters=None,
-                 megastep: int = 1) -> None:
+                 megastep: int = 1,
+                 prefill_client=None) -> None:
         # many-adapter serving (ISSUE 10, infer/qos.py AdapterRegistry):
         # stacked LoRA deltas served off the one base param set.  The
         # registry's arrays ride every dispatch as trailing operands
@@ -1213,20 +1214,32 @@ class RingExecutor:
 
         # the disaggregated prefill engine (prefill_mode="disagg"):
         # built here so its compile set and pool live with the rest of
-        # the device state; the scheduler drives its queues
-        self.prefill_exec: Optional[PrefillExecutor] = None
+        # the device state; the scheduler drives its queues.  With a
+        # ``prefill_client`` (ISSUE 13 cross-host disaggregation —
+        # infer/prefill_serve.RemotePrefillClient) the engine lives in
+        # its OWN pods: the client satisfies the same submit/results
+        # contract, its results are HOST payloads the scheduler lands
+        # through the promote scatter, and only the tiny attach
+        # dispatch runs here — no local prefill pool, no local
+        # whole-prompt compiles.
+        self.prefill_exec: Optional[Any] = None
+        self.prefill_remote = False
         if prefill_mode == "disagg":
             if not self.paged:
                 raise ValueError("prefill_mode='disagg' requires the "
                                  "paged ring (block-granular handoff)")
-            self.prefill_exec = PrefillExecutor(
-                self.params, cfg, max_len=max_len,
-                block_size=self.block_size, buckets=self.buckets,
-                top_k=top_k, top_p=top_p, mesh=mesh,
-                kv_quant=self.kv_quant, adapters=adapters)
-            self._transfer = self._pg.make_pool_transfer(
-                self.pool.max_blocks, quant=self.quant)
             self._attach = make_attach_lane()
+            if prefill_client is not None:
+                self.prefill_exec = prefill_client
+                self.prefill_remote = True
+            else:
+                self.prefill_exec = PrefillExecutor(
+                    self.params, cfg, max_len=max_len,
+                    block_size=self.block_size, buckets=self.buckets,
+                    top_k=top_k, top_p=top_p, mesh=mesh,
+                    kv_quant=self.kv_quant, adapters=adapters)
+                self._transfer = self._pg.make_pool_transfer(
+                    self.pool.max_blocks, quant=self.quant)
 
         self.reset_state()
 
@@ -1806,16 +1819,18 @@ class RingExecutor:
             else:
                 k = jnp.zeros_like(cache["k"])
                 self._copy_block(k, jnp.zeros_like(cache["v"]), 0, 0)
-            if self.host_cache_blocks:
+            if self.host_cache_blocks or self.prefill_remote:
                 # host-tier programs: the demote fetch and the promote
                 # upload at the small pad ladder rungs a typical
                 # admission batches into — otherwise the FIRST host hit
-                # pays the promote compile inside its TTFT
+                # pays the promote compile inside its TTFT.  A REMOTE
+                # disagg ring lands every cold handoff through the
+                # same promote scatter, so it warms the ladder too.
                 lc, _, h, bsz, dd = cache["k"].shape
-                if self.quant:
+                if self.host_cache_blocks and self.quant:
                     self._fetch_prog(cache["k"], cache["v"],
                                      cache["ks"], cache["vs"], 0)
-                else:
+                elif self.host_cache_blocks:
                     self._fetch_prog(cache["k"], cache["v"], 0)
                 pad = 1
                 # inclusive of _promote_pad(max_blocks): a 9-block
@@ -1839,13 +1854,15 @@ class RingExecutor:
                             jnp.zeros_like(cache["v"]), slab, slab, ids)
                     del out
                     pad *= 2
-        if self.prefill_exec is not None:
+        if self.prefill_exec is not None and not self.prefill_remote:
             # the disagg engine's whole-prompt programs compile on the
             # PREFILL thread (they never stall decode), but the first
             # cold prompt would still pay them in its TTFT — run each
             # bucket against the executor's own pool (no donation, and
             # pool content only matters mid-job, so racing a live job
-            # is safe); the handoff transfer + attach ride along
+            # is safe); the handoff transfer + attach ride along.
+            # (Remote rings skip this: their whole-prompt programs
+            # live — and prewarm — in the prefill pods.)
             pe = self.prefill_exec
             for b, prog in pe._progs.items():
                 prog(self.params, pe.cache, pe.table_row,

@@ -267,7 +267,8 @@ class ContinuousBatcher:
                  resilience: Optional[RingResilience] = None,
                  qos: Optional[QOS.QoSConfig] = None,
                  adapters: Optional[QOS.AdapterRegistry] = None,
-                 megastep: int = 1) -> None:
+                 megastep: int = 1,
+                 prefill_client=None) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
                              f"{PREFILL_MODES}")
@@ -347,13 +348,21 @@ class ContinuousBatcher:
             prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
             check_finite=self._check_finite, kv_quant=kv_quant,
             host_cache_blocks=host_cache_blocks, adapters=adapters,
-            megastep=self.megastep)
+            megastep=self.megastep, prefill_client=prefill_client)
         self.mesh = mesh
         self.paged = self.executor.paged
         self.kv_quant = self.executor.kv_quant
         self.spec_k = self.executor.spec_k
         self.draft_cfg = self.executor.draft_cfg
         self._top_k, self._top_p = top_k, top_p
+        # cross-host disaggregation (ISSUE 13): stamp the remote
+        # prefill client with THIS ring's handoff fingerprint — every
+        # POST carries it, the prefill pod refuses a mismatch with
+        # 409, and the client re-validates the returned envelope
+        # before the scheduler ever touches its bytes
+        if self.executor.prefill_remote:
+            self.executor.prefill_exec.fingerprint = \
+                self.handoff_fingerprint()
 
         self.lane: List[Optional[_Request]] = [None] * slots
         self._lane_out: List[List[int]] = [[] for _ in range(slots)]
@@ -436,6 +445,10 @@ class ContinuousBatcher:
                       # the prompts prefilled off the ring thread.
                       "prefill_calls": 0, "prefill_tokens": 0,
                       "chunked_prefill_tokens": 0, "disagg_prefills": 0,
+                      # cross-host disaggregation (ISSUE 13): cold
+                      # prompts whose prefill ran in a PREFILL POOL
+                      # pod and handed off over the wire
+                      "remote_prefills": 0,
                       "cow_copies": 0,
                       # hierarchical-cache accounting (ISSUE 8): blocks
                       # uploaded back from the host tier — cumulative
@@ -817,6 +830,10 @@ class ContinuousBatcher:
             "laneMigrations": self.stats["lane_migrations"],
             "adoptedLanes": self.stats["adopted_lanes"],
             "peerPrefixFetches": self.stats["peer_prefix_fetches"],
+            # cross-host disaggregation (ISSUE 13): handoffs landed
+            # from the prefill pool — the
+            # tpujob_serve_remote_prefills_total gauge
+            "remotePrefills": self.stats["remote_prefills"],
             "hostCacheEvictions": (self.pool.host_evictions()
                                    if self.pool is not None else 0),
             "activeAdapters": (len(self.adapters)
@@ -1340,8 +1357,29 @@ class ContinuousBatcher:
         # the promoted entry re-anchored in the radix cache and a later
         # hit on it must read real bytes
         self._dispatch_cow(slot, cow, hit_len)
+        ex = self.executor
+        if ex.prefill_remote and req.adapter_idx:
+            # remote prefill pods serve the BASE param set: an adapter
+            # prompt prefilled there would hand off base-model KV under
+            # a tenant's namespace.  Admit it inline on the ring thread
+            # instead (exactly the SERVE_PREFILL=inline cold path) —
+            # correctness first; adapter traffic simply skips the
+            # remote TTFT win.
+            n = len(req.prompt)
+            ex.cache, ex.tok, ex.temp, ex.keys, first = \
+                ex.inserts[req.bucket](
+                    ex.params, ex.cache,
+                    jnp.asarray(self.pool.table[slot]), ex.tok,
+                    ex.temp, ex.keys, req.dev_prompt, n, slot,
+                    float(req.temperature), req.seed,
+                    *ex.lora_insert_tail(req.adapter_idx))
+            self.stats["prefill_calls"] += 1
+            self.stats["prefill_tokens"] += n
+            self.pool.publish(slot, req.prompt, ns=req.ns)
+            self._activate(slot, req, first)
+            return
         self._disagg_waiting[slot] = req
-        self.executor.prefill_exec.submit(req, slot)
+        ex.prefill_exec.submit(req, slot)
 
     def _drain_handoffs(self) -> None:
         """Attach completed disaggregated prefills: device-to-device
@@ -1368,6 +1406,35 @@ class ContinuousBatcher:
                 continue
             _, _, snap, n_blocks, first = item
             n = len(req.prompt)
+            if ex.prefill_remote:
+                # cross-host handoff (ISSUE 13): ``snap`` is the wire
+                # envelope's HOST payload — per-block pool bytes the
+                # prefill pod captured.  Land them in the lane's
+                # already-reserved blocks through the SAME batched
+                # promote scatter a host-tier hit uses (PR 8 — byte-
+                # exact upload, codes+scales verbatim under int8),
+                # then the identical attach path as in-process.
+                promotes = []
+                for j in range(n_blocks):
+                    payload = {"k": snap["k"][:, j:j + 1],
+                               "v": snap["v"][:, j:j + 1]}
+                    if ex.quant:
+                        payload["ks"] = snap["ks"][:, j:j + 1]
+                        payload["vs"] = snap["vs"][:, j:j + 1]
+                    promotes.append(
+                        (int(self.pool.table[slot][j]), payload, None))
+                if promotes:
+                    ex.dispatch_promotions(promotes)
+                if ex.quant:
+                    # the prompt's partial-block staging tail crosses
+                    # the wire exact — it lands in decode tail ``slot``
+                    ex.cache["kt"] = ex.cache["kt"].at[:, slot].set(
+                        jnp.asarray(snap["kt"][:, 0]))
+                    ex.cache["vt"] = ex.cache["vt"].at[:, slot].set(
+                        jnp.asarray(snap["vt"][:, 0]))
+                self.stats["remote_prefills"] += 1
+                self._attach_handoff(slot, req, n, first)
+                continue
             # src blocks are the executor's fixed identity row 1..M;
             # dst blocks were mapped at admission.  Both id vectors pad
             # to the table width with the TRASH block — garbage written
@@ -1394,22 +1461,32 @@ class ContinuousBatcher:
                 ex.cache["k"], ex.cache["v"] = ex._transfer(
                     ex.cache["k"], ex.cache["v"], snap["k"], snap["v"],
                     jnp.asarray(src_ids), jnp.asarray(dst_ids))
-            if self.spec_k:
-                (ex.dcache, ex.cache["pos"], ex.tok, ex.temp,
-                 ex.keys) = ex.spec_attach(req.bucket)(
-                    ex.draft_params, ex.dcache, ex.cache["pos"], ex.tok,
-                    ex.temp, ex.keys, req.dev_prompt, n, slot, first,
-                    float(req.temperature), req.seed)
-            else:
-                (ex.cache["pos"], ex.tok, ex.temp,
-                 ex.keys) = ex._attach(
-                    ex.cache["pos"], ex.tok, ex.temp, ex.keys, slot,
-                    first, n, float(req.temperature), req.seed)
-            self.stats["prefill_calls"] += 1
-            self.stats["prefill_tokens"] += n
-            self.stats["disagg_prefills"] += 1
-            self.pool.publish(slot, req.prompt, ns=req.ns)
-            self._activate(slot, req, first)
+            self._attach_handoff(slot, req, n, first)
+
+    def _attach_handoff(self, slot: int, req: _Request, n: int,
+                        first) -> None:
+        """The handoff's decode-side tail, shared by the in-process
+        (device block copy) and remote (promote-scatter upload) paths:
+        one tiny attach dispatch — spec rings additionally prefill the
+        DRAFT lane here, which is why the handoff snapshot never
+        carries draft state — then publish + activate."""
+        ex = self.executor
+        if self.spec_k:
+            (ex.dcache, ex.cache["pos"], ex.tok, ex.temp,
+             ex.keys) = ex.spec_attach(req.bucket)(
+                ex.draft_params, ex.dcache, ex.cache["pos"], ex.tok,
+                ex.temp, ex.keys, req.dev_prompt, n, slot, first,
+                float(req.temperature), req.seed)
+        else:
+            (ex.cache["pos"], ex.tok, ex.temp,
+             ex.keys) = ex._attach(
+                ex.cache["pos"], ex.tok, ex.temp, ex.keys, slot,
+                first, n, float(req.temperature), req.seed)
+        self.stats["prefill_calls"] += 1
+        self.stats["prefill_tokens"] += n
+        self.stats["disagg_prefills"] += 1
+        self.pool.publish(slot, req.prompt, ns=req.ns)
+        self._activate(slot, req, first)
 
     # -- consume / evict ---------------------------------------------------
 
@@ -1598,6 +1675,22 @@ class ContinuousBatcher:
                 "blockSize": int(ex.block_size),
                 "quant": ex.kv_quant,
                 "specK": int(ex.spec_k)}
+
+    def handoff_fingerprint(self) -> Dict[str, Any]:
+        """The geometry + sampling rule a remote-prefill HANDOFF
+        envelope must match (ISSUE 13) — narrower than the migration
+        fingerprint: spec depth is absent (the draft lane prefills
+        decode-side at attach) and top-k/top-p are PRESENT (the
+        prefill pod samples the first token through the shared
+        rule)."""
+        from paddle_operator_tpu.infer.prefill_serve import (
+            handoff_fingerprint,
+        )
+
+        return handoff_fingerprint(
+            self.cfg, block_size=self.executor.block_size,
+            kv_quant=self.kv_quant, top_k=self._top_k,
+            top_p=self._top_p)
 
     def _migration_meta(self, pk: _ParkedLane) -> Dict[str, Any]:
         """The JSON half of a lane envelope: request identity + stream

@@ -818,6 +818,21 @@ def main() -> int:
             if prefill_mode == "disagg" and not ring_kw.get("paged"):
                 print("SERVE_PREFILL=disagg implies SERVE_PAGED=1 "
                       "(block-granular handoff)", flush=True)
+        if prefill_mode == "disagg":
+            # cross-host disaggregation (ISSUE 13, docs/serving.md
+            # "Cross-host disaggregation"): SERVE_PREFILL_REMOTE=1
+            # moves cold prefills to the PREFILL POOL's pods —
+            # SERVE_PREFILL_BROKER (the fleet router, operator-
+            # injected) forwards each job to the least-loaded ready
+            # prefill pod; SERVE_PREFILL_PEERS is the router-less
+            # static list.  Unset keeps the in-process executor.
+            from paddle_operator_tpu.infer.prefill_serve import (
+                remote_prefill_client_from_env,
+            )
+
+            rp = remote_prefill_client_from_env()
+            if rp is not None:
+                ring_kw["prefill_client"] = rp
         if os.environ.get("SERVE_PREFILL_CHUNK"):
             ring_kw["prefill_chunk"] = int(
                 os.environ["SERVE_PREFILL_CHUNK"])

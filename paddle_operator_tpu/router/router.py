@@ -82,6 +82,16 @@ _GAUGE_KEYS = {
     # broker's least-loaded-holder ordering
     "tpujob_serve_parked_lanes": "parkedLanes",
     "tpujob_serve_host_cache_blocks": "hostCacheBlocks",
+    # prefill pool (ISSUE 13): queue depth + per-job service time —
+    # what /v1/prefill forwarding orders candidates by, and what the
+    # operator's SLO autoscaler converts a TTFT target into a depth
+    # bound with (controller/autoscaler.py)
+    "tpujob_serve_prefill_queue_depth": "prefillQueueDepth",
+    "tpujob_serve_prefill_ms_avg": "prefillMsAvg",
+    # the served-jobs weight for the fleet prefillMsAvg fold — without
+    # it a freshly-joined pod's one slow reading counts as much as a
+    # seasoned pod's thousands
+    "tpujob_serve_prefill_jobs_total": "prefillJobs",
 }
 
 _GAUGE_RE = re.compile(
@@ -138,11 +148,24 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
     number below what the traffic actually experienced); liveness
     folds conservatively (draining if ANY, healthy only if ALL).
     Shared by the router's ``/statusz`` and the reconciler's fleet
-    status aggregation — one definition, no drift."""
-    blocks = [b for b in replicas.values() if isinstance(b, dict)]
-    agg: Dict[str, Any] = {"replicasReporting": len(blocks)}
-    if not blocks:
+    status aggregation — one definition, no drift.
+
+    ROLE-AWARE (ISSUE 13): a prefill-pool replica (``role:
+    "prefill"``) never decodes — its ``tokensPerSec`` counts PREFILL
+    tokens and its hit/accept rates do not exist.  Folding it into the
+    decode sums would inflate fleet tok/s with prompt tokens and (via
+    its ``tokensTotal`` weight) drag every token-weighted rate toward
+    0.  Prefill blocks therefore aggregate into their OWN keys
+    (``prefillTokensPerSec`` / ``prefillMsAvg`` /
+    ``prefillReplicasReporting``, and their queue depths fold into the
+    fleet ``prefillQueueDepth``); only liveness folds across both
+    pools."""
+    blocks_all = [b for b in replicas.values() if isinstance(b, dict)]
+    agg: Dict[str, Any] = {"replicasReporting": len(blocks_all)}
+    if not blocks_all:
         return agg
+    blocks = [b for b in blocks_all if b.get("role") != "prefill"]
+    prefill = [b for b in blocks_all if b.get("role") == "prefill"]
     for key in ("tokensPerSec", "queueDepth", "kvBlocksFree",
                 "tokensTotal", "activeLanes", "kvPoolBytes",
                 "hostCacheBlocks", "promotedBlocks", "deadlineExceeded",
@@ -179,11 +202,32 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
         if vals:
             agg[key] = round(sum(v * w for v, w in vals)
                              / (sum(w for _, w in vals) or 1.0), 4)
-    if any("draining" in b for b in blocks):
-        agg["draining"] = any(bool(b.get("draining")) for b in blocks)
-    if any("healthy" in b for b in blocks):
+    # prefill-pool fold (ISSUE 13): own keys, decode sums untouched
+    if prefill:
+        agg["prefillReplicasReporting"] = len(prefill)
+        agg["prefillTokensPerSec"] = round(
+            sum(float(b.get("tokensPerSec", 0.0) or 0.0)
+                for b in prefill), 2)
+        # the POOL's own depth REPLACES the decode-side sum: a remote
+        # handoff in flight is counted by its decode ring
+        # (_disagg_waiting) AND by the pod serving it — folding both
+        # would read ~2x and the SLO autoscaler would converge the
+        # pool at twice the pods the TTFT target needs
+        agg["prefillQueueDepth"] = int(sum(
+            float(b.get("prefillQueueDepth", 0) or 0)
+            for b in prefill))
+        ms = [(float(b.get("prefillMsAvg", 0.0) or 0.0),
+               max(1.0, float(b.get("prefillJobs", 0) or 0)))
+              for b in prefill if b.get("prefillMsAvg")]
+        if ms:
+            agg["prefillMsAvg"] = round(
+                sum(v * w for v, w in ms) / sum(w for _, w in ms), 3)
+    if any("draining" in b for b in blocks_all):
+        agg["draining"] = any(bool(b.get("draining"))
+                              for b in blocks_all)
+    if any("healthy" in b for b in blocks_all):
         agg["healthy"] = all(bool(b.get("healthy", True))
-                             for b in blocks)
+                             for b in blocks_all)
     return agg
 
 
@@ -233,7 +277,9 @@ class FleetRouter:
                  scrape_interval: float = 1.0, dedupe_cap: int = 1024,
                  endpoints_file: Optional[str] = None,
                  vnodes: int = 64, retry_after_s: int = 1,
-                 upstream_timeout: float = 600.0) -> None:
+                 upstream_timeout: float = 600.0,
+                 prefill_endpoints: Optional[List[str]] = None,
+                 prefill_endpoints_file: Optional[str] = None) -> None:
         self.block_size = block_size
         self.affinity_blocks = affinity_blocks
         self.hot_queue_depth = hot_queue_depth
@@ -245,6 +291,12 @@ class FleetRouter:
         self._lock = threading.RLock()
         self.ring = HashRing(vnodes=vnodes)
         self.replicas: Dict[str, ReplicaState] = {}
+        # prefill pool (ISSUE 13 cross-host disaggregation): a SECOND
+        # scraped directory — prefill pods take /v1/prefill forwards
+        # only, never generate traffic, and never join the hashring
+        # (they hold no radix cache to be affine to)
+        self.prefill_endpoints_file = prefill_endpoints_file
+        self.prefill: Dict[str, ReplicaState] = {}
         self.draining = False
         self.inflight_proxies = 0
         # exactly-once dedupe: request_id -> recorded (status, body) for
@@ -269,6 +321,9 @@ class FleetRouter:
             "dedupe_replays": 0,
             "migrations_brokered": 0, "migration_replays": 0,
             "prefix_forwards": 0,
+            # prefill pool (ISSUE 13): /v1/prefill forwards placed on
+            # a ready prefill pod, and asks that found none ready
+            "prefill_jobs_forwarded": 0, "no_ready_prefill": 0,
             "upstream_errors": 0, "no_ready_replica": 0,
         }
         self._stop = threading.Event()
@@ -276,6 +331,8 @@ class FleetRouter:
         self._scrape_pool = None        # lazy ThreadPoolExecutor
         if endpoints:
             self.set_endpoints(endpoints)
+        if prefill_endpoints:
+            self.set_prefill_endpoints(prefill_endpoints)
 
     # -- membership --------------------------------------------------------
 
@@ -296,17 +353,41 @@ class FleetRouter:
         with self._lock:
             return self.ring.endpoints
 
+    def set_prefill_endpoints(self, endpoints: List[str]) -> None:
+        eps = [self._norm(e) for e in endpoints if e.strip()]
+        with self._lock:
+            for ep in eps:
+                self.prefill.setdefault(ep, ReplicaState(ep))
+            for ep in [e for e in self.prefill if e not in set(eps)]:
+                del self.prefill[ep]
+
+    def prefill_pool(self) -> List[str]:
+        with self._lock:
+            return sorted(self.prefill)
+
     def _reload_endpoints_file(self) -> None:
-        if not self.endpoints_file:
-            return
-        try:
-            with open(self.endpoints_file) as f:
-                raw = f.read()
-        except OSError:
-            return
-        eps = [e for e in re.split(r"[,\s]+", raw) if e]
-        if eps and set(map(self._norm, eps)) != set(self.endpoints()):
-            self.set_endpoints(eps)
+        if self.endpoints_file:
+            try:
+                with open(self.endpoints_file) as f:
+                    raw = f.read()
+            except OSError:
+                raw = ""
+            eps = [e for e in re.split(r"[,\s]+", raw) if e]
+            if eps and set(map(self._norm, eps)) \
+                    != set(self.endpoints()):
+                self.set_endpoints(eps)
+        if self.prefill_endpoints_file:
+            try:
+                with open(self.prefill_endpoints_file) as f:
+                    raw = f.read()
+            except OSError:
+                return
+            # unlike the decode list, EMPTY is meaningful here: the
+            # autoscaler may scale the prefill pool to its minimum and
+            # back — stale entries must drop, not linger unroutable
+            eps = [e for e in re.split(r"[,\s]+", raw) if e]
+            if set(map(self._norm, eps)) != set(self.prefill):
+                self.set_prefill_endpoints(eps)
 
     # -- scraping ----------------------------------------------------------
 
@@ -362,6 +443,8 @@ class FleetRouter:
 
         states = [st for ep in self.endpoints()
                   if (st := self.replicas.get(ep)) is not None]
+        with self._lock:
+            states += list(self.prefill.values())
         if len(states) <= 1:
             for st in states:
                 probe(st)
@@ -587,6 +670,48 @@ class FleetRouter:
             with self._lock:
                 self._migr_inflight.discard(request_id)
 
+    # -- prefill pool forwarding (ISSUE 13) --------------------------------
+
+    def prefill_candidates(self) -> List[str]:
+        """Ready prefill pods, best first: shortest queue, then
+        fastest recent service time — a pod already turning jobs
+        around clears its queue soonest.  Prefill is side-effect-free,
+        so the caller may walk the WHOLE list on failure (unlike lane
+        migration, where an ambiguous hop must stop the walk)."""
+        with self._lock:
+            ready = [ep for ep, st in self.prefill.items() if st.ready]
+            return sorted(ready, key=lambda e: (
+                self.prefill[e].gauges.get("prefillQueueDepth", 0.0),
+                self.prefill[e].gauges.get("prefillMsAvg", 0.0)))
+
+    def forward_prefill(self, body: bytes
+                        ) -> Tuple[int, bytes, Optional[str]]:
+        """Place one prefill job on the best ready prefill pod.
+        Returns ``(status, response_bytes, pod)``.  Connection
+        failures and 503s (draining pod) walk to the next candidate —
+        re-running a prefill is always safe; only a deterministic
+        4xx/5xx (fingerprint mismatch, bad prompt) relays as-is."""
+        for ep in self.prefill_candidates():
+            try:
+                code, raw = self._http_post(
+                    ep, "/v1/prefill", body,
+                    content_type="application/json",
+                    timeout=self.upstream_timeout)
+            except (OSError, socket.timeout):
+                st = self.prefill.get(ep)
+                if st is not None:
+                    st.ready = False
+                continue
+            if code == 503:
+                continue            # draining: next candidate
+            with self._lock:
+                self.counters["prefill_jobs_forwarded"] += 1
+            return code, raw, ep
+        with self._lock:
+            self.counters["no_ready_prefill"] += 1
+        return 503, json.dumps(
+            {"error": "no ready prefill pod"}).encode(), None
+
     def prefix_owner(self, tokens, origin: str) -> Optional[str]:
         """The replica whose radix cache most likely holds this
         prompt's prefix: its hashring affinity owner — the SAME
@@ -651,16 +776,29 @@ class FleetRouter:
         with self._lock:
             per = {ep: dict(st.gauges, ready=st.ready)
                    for ep, st in self.replicas.items()}
-            return {
+            # prefill blocks join the aggregate under their scraped
+            # role marker so the fold stays role-aware
+            fleet_in = {ep: st.gauges
+                        for ep, st in self.replicas.items()
+                        if st.gauges}
+            fleet_in.update({ep: dict(st.gauges, role="prefill")
+                             for ep, st in self.prefill.items()
+                             if st.gauges})
+            out = {
                 "replicas": per,
-                "fleet": aggregate_fleet_serving(
-                    {ep: st.gauges for ep, st in self.replicas.items()
-                     if st.gauges}),
+                "fleet": aggregate_fleet_serving(fleet_in),
                 "router": dict(self.counters,
                                readyReplicas=len(self._ready_endpoints()),
                                endpoints=len(self.replicas),
                                draining=self.draining),
             }
+            if self.prefill:
+                out["prefill"] = {
+                    ep: dict(st.gauges, ready=st.ready)
+                    for ep, st in self.prefill.items()}
+                out["router"]["readyPrefill"] = sum(
+                    1 for st in self.prefill.values() if st.ready)
+            return out
 
     def metrics_text(self) -> str:
         """The fleet's own /metrics: router counters + per-replica
@@ -681,6 +819,13 @@ class FleetRouter:
                              f"{1.0 if st.ready else 0.0}")
                 lines.append(f"tpujob_router_replica_queue_depth{lbl} "
                              f"{st.queue_depth}")
+            for ep, st in sorted(self.prefill.items()):
+                lbl = f'{{replica="{ep}"}}'
+                lines.append(f"tpujob_router_prefill_ready{lbl} "
+                             f"{1.0 if st.ready else 0.0}")
+                lines.append(
+                    f"tpujob_router_prefill_queue_depth{lbl} "
+                    f"{st.gauges.get('prefillQueueDepth', 0.0)}")
             return "\n".join(lines) + "\n"
 
 
@@ -802,10 +947,46 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "0")
             self.end_headers()
 
+    def _prefill_forward(self, body: bytes) -> None:
+        """POST /v1/prefill — the prefill-pool half of cross-host
+        disaggregation (ISSUE 13): relay one prefill job to the
+        least-loaded ready prefill pod and stream its handoff envelope
+        back.  The router never parses the envelope; it stays
+        jax-free."""
+        r = self.router
+        if r.draining:
+            self._send(503, {"error": "router draining"},
+                       headers={"Retry-After": r.retry_after_s})
+            return
+        # the SIGTERM drain gates on this counter: a forward can hold
+        # its upstream for up to upstream_timeout, and shutting the
+        # server down mid-relay severs a live handoff (same contract
+        # as the generate proxy)
+        with r._lock:
+            r.inflight_proxies += 1
+        try:
+            code, raw, ep = r.forward_prefill(body)
+        finally:
+            with r._lock:
+                r.inflight_proxies -= 1
+        self.send_response(code)
+        self.send_header("Content-Type",
+                         "application/octet-stream" if code == 200
+                         else "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        if ep:
+            self.send_header("X-Router-Prefill", ep)
+        if code == 503:
+            self.send_header("Retry-After", str(r.retry_after_s))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def do_POST(self):
         r = self.router
         n = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(n) if n else b""
+        if self.path == "/v1/prefill":
+            return self._prefill_forward(body)
         if self.path == "/v1/kv/migrate":
             return self._kv_migrate(body)
         if self.path == "/v1/kv/prefix":
@@ -1024,6 +1205,13 @@ def main() -> int:
     port = int(os.environ.get("ROUTER_PORT", "8800"))
     eps = [e for e in os.environ.get("TPUJOB_SERVE_REPLICAS",
                                      "").split(",") if e.strip()]
+    # prefill pool (ISSUE 13): the second scraped directory —
+    # TPUJOB_PREFILL_REPLICAS at boot, ROUTER_PREFILL_ENDPOINTS_FILE
+    # re-read live (the same ConfigMap volume trick as the decode
+    # list) so the SLO autoscaler's pool changes reach a RUNNING
+    # router
+    peps = [e for e in os.environ.get("TPUJOB_PREFILL_REPLICAS",
+                                      "").split(",") if e.strip()]
     router = FleetRouter(
         eps,
         block_size=int(os.environ.get("ROUTER_BLOCK_SIZE", "256")),
@@ -1032,7 +1220,10 @@ def main() -> int:
         hot_queue_depth=int(os.environ.get("ROUTER_HOT_QUEUE", "4")),
         low_blocks=int(os.environ.get("ROUTER_LOW_BLOCKS", "0")),
         scrape_interval=float(os.environ.get("ROUTER_SCRAPE_S", "1")),
-        endpoints_file=os.environ.get("ROUTER_ENDPOINTS_FILE"))
+        endpoints_file=os.environ.get("ROUTER_ENDPOINTS_FILE"),
+        prefill_endpoints=peps,
+        prefill_endpoints_file=os.environ.get(
+            "ROUTER_PREFILL_ENDPOINTS_FILE"))
     srv = make_router_server("0.0.0.0", port, router)
     print(f"fleet router on :{port} fronting "
           f"{len(router.endpoints())} replica(s) "

@@ -93,7 +93,8 @@ class SimFleet:
                  subprocess_replicas: bool = False,
                  host_env: Optional[Dict[str, str]] = None,
                  ring_extra: Optional[Dict[str, Any]] = None,
-                 fleet_kv: bool = False) -> None:
+                 fleet_kv: bool = False,
+                 prefill_pool: int = 0) -> None:
         self.block_size = block_size
         self.ring_kw: Dict[str, Any] = dict(
             slots=slots, max_len=max_len, chunk_tokens=chunk_tokens,
@@ -111,14 +112,31 @@ class SimFleet:
         self._params = self._cfg = None
         if not subprocess_replicas:
             self._params, self._cfg = _tiny_params()
-        for _ in range(n):
-            self.add_replica(wait_ready=False)
+        # cross-host disaggregation (ISSUE 13): N REAL prefill servers
+        # (infer/prefill_serve.py) spawned BEFORE the decode replicas
+        # — each decode ring boots with a RemotePrefillClient pointed
+        # at this fleet's router, exactly the pod wiring
+        # (SERVE_PREFILL=disagg + SERVE_PREFILL_REMOTE=1 +
+        # SERVE_PREFILL_BROKER=<fleet service>) produces
+        self.prefill_servers: List[Any] = []
+        self._prefill_exits: List[Optional[int]] = []
+        if prefill_pool:
+            if subprocess_replicas:
+                raise ValueError("prefill_pool needs in-process "
+                                 "replicas (the client wires at ring "
+                                 "construction)")
+            self.ring_kw["prefill_mode"] = "disagg"
+            for _ in range(prefill_pool):
+                self._spawn_prefill()
+        # router FIRST (empty decode membership): replicas constructed
+        # below need its address for their remote-prefill broker
         self.router = FleetRouter(
-            [r.endpoint for r in self.replicas],
+            [],
             block_size=block_size,
             affinity_blocks=2 if affinity else 0,
             hot_queue_depth=hot_queue_depth,
-            scrape_interval=scrape_interval)
+            scrape_interval=scrape_interval,
+            prefill_endpoints=self.prefill_endpoints())
         self.router_srv = make_router_server("127.0.0.1", 0,
                                              self.router)
         # short poll: shutdown() blocks a full poll interval per
@@ -129,9 +147,70 @@ class SimFleet:
         self._router_thread.start()
         self.router_url = ("http://127.0.0.1:"
                            f"{self.router_srv.server_address[1]}")
+        for _ in range(n):
+            self.add_replica(wait_ready=False)
         if self.fleet_kv:
             self.enable_fleet_kv()
         self.wait_ready()
+
+    # -- prefill pool (ISSUE 13) -------------------------------------------
+
+    def prefill_endpoints(self) -> List[str]:
+        return [f"127.0.0.1:{s.server_address[1]}"
+                for i, s in enumerate(self.prefill_servers)
+                if self._prefill_exits[i] is None]
+
+    def _spawn_prefill(self):
+        from paddle_operator_tpu.infer.prefill_serve import (
+            make_prefill_server,
+        )
+
+        srv = make_prefill_server(
+            "127.0.0.1", 0, self._params, self._cfg,
+            block_size=self.block_size,
+            max_len=self.ring_kw["max_len"],
+            buckets=self.ring_kw["prefill_buckets"],
+            kv_quant=self.ring_kw.get("kv_quant", "none"),
+            # sampling rule is part of the handoff fingerprint: a
+            # ring_extra top-k/top-p the pool didn't carry would 409
+            # every handoff
+            top_k=self.ring_kw.get("top_k"),
+            top_p=self.ring_kw.get("top_p"),
+            job="sim/fleet",
+            replica=f"pf{len(self.prefill_servers)}")
+        threading.Thread(
+            target=lambda: srv.serve_forever(poll_interval=0.05),
+            daemon=True).start()
+        self.prefill_servers.append(srv)
+        self._prefill_exits.append(None)
+        return srv
+
+    def add_prefill(self) -> str:
+        """Scale the prefill pool up (the autoscaler's join): the
+        router routes jobs to it once its scrape sees /readyz true."""
+        srv = self._spawn_prefill()
+        self.router.set_prefill_endpoints(self.prefill_endpoints())
+        return f"127.0.0.1:{srv.server_address[1]}"
+
+    def drain_prefill(self, idx: int, budget_s: float = 30.0) -> None:
+        """The prefill pod's drain protocol (docs/fault-tolerance.md):
+        /readyz false and new handoffs 503 (the decode side retries
+        another pod), in-flight jobs finish and flush, exit 83."""
+        import time as _time
+
+        from paddle_operator_tpu.api.types import EXIT_PREEMPTED
+
+        srv = self.prefill_servers[idx]
+        srv.frontend.draining = True
+        deadline = _time.monotonic() + budget_s
+        while srv.frontend.depth() > 0 \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        srv.shutdown()
+        srv.server_close()      # refuse, don't backlog (drain_replica)
+        srv.frontend.close()
+        self._prefill_exits[idx] = EXIT_PREEMPTED
+        self.router.set_prefill_endpoints(self.prefill_endpoints())
 
     def enable_fleet_kv(self, *, migrate: bool = True,
                         peer_fetch: bool = True,
@@ -182,9 +261,18 @@ class SimFleet:
     def _spawn_inprocess(self, idx: int) -> _Replica:
         from paddle_operator_tpu.infer.serve import make_server
 
+        ring_kw = dict(self.ring_kw)
+        if self.prefill_servers:
+            from paddle_operator_tpu.infer.prefill_serve import (
+                RemotePrefillClient,
+            )
+
+            ring_kw["prefill_client"] = RemotePrefillClient(
+                broker="127.0.0.1:"
+                       f"{self.router_srv.server_address[1]}")
         srv = make_server("127.0.0.1", 0, self._params, self._cfg,
                           continuous=True, job="sim/fleet",
-                          replica=str(idx), **self.ring_kw)
+                          replica=str(idx), **ring_kw)
         rep = _Replica(f"127.0.0.1:{srv.server_address[1]}")
         rep.srv = srv
         rep.thread = threading.Thread(
@@ -319,6 +407,14 @@ class SimFleet:
                         rep.batcher.close()
                     except Exception:
                         pass
+        for i, srv in enumerate(self.prefill_servers):
+            if self._prefill_exits[i] is None:
+                srv.shutdown()
+                srv.server_close()
+                try:
+                    srv.frontend.close()
+                except Exception:
+                    pass
 
 
 def prefix_workload(n_groups: int, per_group: int, *,

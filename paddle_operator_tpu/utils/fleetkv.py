@@ -234,6 +234,46 @@ def decode_lane(buf: bytes) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     return meta, spill
 
 
+def encode_handoff(meta: Dict[str, Any],
+                   arrays: Dict[str, np.ndarray]) -> bytes:
+    """A completed remote PREFILL's block snapshot (ISSUE 13 cross-host
+    disaggregation) -> wire envelope.  Unlike a lane envelope this is
+    not a live stream capture: the prefill pod ran the whole-prompt
+    forward and sampled the first token; the decode replica lands the
+    blocks through its promote scatter and attaches the lane exactly
+    as the in-process disagg handoff does.  ``meta`` must carry
+    ``first`` (the sampled first token), ``promptLen``, ``nBlocks``
+    and the HANDOFF fingerprint (layer/head geometry, block size,
+    quant mode, the sampling rule's top-k/top-p — spec depth and tp
+    deliberately absent: the draft lane prefills decode-side at
+    attach, and host bytes re-shard through the promote scatter)."""
+    return encode_envelope("handoff", meta, arrays)
+
+
+def decode_handoff(buf: bytes) -> Tuple[Dict[str, Any],
+                                        Dict[str, np.ndarray]]:
+    """Wire envelope -> ``(meta, arrays)`` for the decode-side handoff
+    receiver.  Raises :class:`EnvelopeError` on any inconsistency —
+    kind mismatch, missing meta, missing k/v payload — on top of
+    :func:`decode_envelope`'s magic/version/CRC/manifest checks."""
+    kind, meta, arrays = decode_envelope(buf)
+    if kind != "handoff":
+        raise EnvelopeError(f"expected a handoff envelope, got {kind!r}")
+    for req_key in ("first", "promptLen", "nBlocks"):
+        if req_key not in meta:
+            raise EnvelopeError(
+                f"handoff envelope missing meta {req_key!r}")
+    if "k" not in arrays or "v" not in arrays:
+        raise EnvelopeError("handoff envelope missing k/v arrays")
+    n = int(meta["nBlocks"])
+    for name in ("k", "v"):
+        if arrays[name].shape[1] != n:
+            raise EnvelopeError(
+                f"handoff payload {name} carries "
+                f"{arrays[name].shape[1]} blocks, meta says {n}")
+    return meta, arrays
+
+
 def encode_prefix(meta: Dict[str, Any],
                   chunks: Sequence[Sequence[int]],
                   block_idx: Sequence[int],

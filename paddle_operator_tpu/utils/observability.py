@@ -117,6 +117,15 @@ def serving_gauges(status_serving: dict, job: str,
             float(fleet.get("drainedReplicas", 0))
         out[f"tpujob_serve_fleet_replica_restarts{lbl}"] = \
             float(fleet.get("replicaRestarts", 0))
+        # prefill pool (ISSUE 13) — rendered only when the fleet runs
+        # one, so the decode-only gauge set is untouched
+        if "prefillReplicasDesired" in fleet:
+            out[f"tpujob_serve_fleet_prefill_replicas_desired{lbl}"] = \
+                float(fleet.get("prefillReplicasDesired", 0))
+            out[f"tpujob_serve_fleet_prefill_replicas_ready{lbl}"] = \
+                float(fleet.get("prefillReplicasReady", 0))
+            out[f"tpujob_serve_fleet_prefill_drained{lbl}"] = \
+                float(fleet.get("prefillDrained", 0))
     return out
 
 
@@ -208,6 +217,11 @@ def _serving_gauges_one(status_serving: dict, job: str,
             float(status_serving.get("peerPrefixFetches", 0.0)),
         f"tpujob_serve_parked_lanes{lbl}":
             float(status_serving.get("parkedLanes", 0.0)),
+        # cross-host disaggregation (ISSUE 13): cold prompts prefilled
+        # in the PREFILL POOL's pods and handed off over the wire —
+        # zero on in-process/inline rings
+        f"tpujob_serve_remote_prefills_total{lbl}":
+            float(status_serving.get("remotePrefills", 0.0)),
         # device-resident megastep (ISSUE 11, SERVE_MEGASTEP): fused
         # ring iterations per compiled dispatch and the measured
         # resident dispatches per emitted token — dispatches_per_token

@@ -464,3 +464,447 @@ class TestValidationAndSchema:
 
     def test_exit_preempted_pinned(self):
         assert EXIT_PREEMPTED == 83
+
+
+# ---------------------------------------------------------------------------
+# Cross-host disaggregation + SLO autoscaler (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def _xd_job(name="xj", replicas=2, prefill=2, autoscale=None):
+    from paddle_operator_tpu.api.types import PrefillPoolSpec
+
+    return TPUJob(name=name, namespace=NS, spec=TPUJobSpec(
+        serving=ServingSpec(
+            replicas=replicas, template=TMPL, block_size=8,
+            prefill_pool=PrefillPoolSpec(replicas=prefill),
+            autoscale=autoscale)))
+
+
+def _xd_setup(name="xj", replicas=2, prefill=2, autoscale=None,
+              clock=None):
+    api = FakeAPI()
+    rec = TPUJobReconciler(api)
+    if clock is not None:
+        rec.clock = clock
+    fleet = FakeFleet(api, NS)
+    api.create(KIND_JOB, _xd_job(name, replicas, prefill,
+                                 autoscale).to_dict())
+    run_to_settled(rec, NS, name)
+    fleet.run_all()
+    run_to_settled(rec, NS, name)
+    return api, rec, fleet
+
+
+class TestPrefillPool:
+    def test_prefill_pods_materialize(self):
+        api, rec, fleet = _xd_setup(replicas=2, prefill=2)
+        pods = sorted(k[2] for k in api.store if k[0] == "Pod")
+        assert pods == ["xj-prefill-0", "xj-prefill-1", "xj-router-0",
+                        "xj-serve-0", "xj-serve-1"]
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert got.status.prefill.running == 2
+        assert got.status.prefill.ready == "2/2"
+        flt = got.status.serving["fleet"]
+        assert flt["prefillReplicasDesired"] == 2
+        assert flt["prefillReplicasReady"] == 2
+
+    def test_prefill_pod_contract(self):
+        """Template derives from the serving image running the prefill
+        module; identity/port/block-size env injected; restartPolicy
+        Never so exit 83 stays observable."""
+        api, rec, fleet = _xd_setup(prefill=1)
+        pod = api.get("Pod", NS, "xj-prefill-0")
+        c0 = pod["spec"]["containers"][0]
+        assert c0["image"] == "jax:latest"
+        assert c0["command"][-1] == \
+            "paddle_operator_tpu.infer.prefill_serve"
+        env = {e["name"]: e.get("value") for e in c0["env"]}
+        assert env["TPUJOB_RES_TYPE"] == "prefill"
+        assert env["TPUJOB_PORT"] == "8701"
+        assert env["SERVE_BLOCK_SIZE"] == "8"
+        assert pod["spec"]["restartPolicy"] == "Never"
+
+    def test_prefill_pod_inherits_serving_env(self):
+        """A derived prefill template carries the serving container's
+        env wholesale: fleet config (SERVE_KV_QUANT, MODEL_PRESET, ...)
+        rides it, and a prefill pod booted without it would have a
+        skewed handoff fingerprint — every POST 409s.  An explicit
+        prefillPool.template still stands as authored."""
+        from paddle_operator_tpu.api.types import PrefillPoolSpec
+        from paddle_operator_tpu.controller import builders
+
+        tmpl = {"spec": {"containers": [{
+            "name": "m", "image": "jax:latest",
+            "env": [{"name": "SERVE_KV_QUANT", "value": "int8"},
+                    {"name": "MODEL_PRESET", "value": "tiny"}]}]}}
+        job = TPUJob(name="xj", namespace=NS, spec=TPUJobSpec(
+            serving=ServingSpec(
+                replicas=1, template=tmpl, block_size=8,
+                prefill_pool=PrefillPoolSpec(replicas=1))))
+        pod = builders.construct_prefill_pod(job, 0)
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["SERVE_KV_QUANT"] == "int8"
+        assert env["MODEL_PRESET"] == "tiny"
+        # the serving template itself is never aliased/mutated
+        assert len(tmpl["spec"]["containers"][0]["env"]) == 2
+        # an explicit pool template is authoritative — nothing leaks in
+        own = {"spec": {"containers": [{
+            "name": "p", "image": "other:latest",
+            "command": ["python", "-m",
+                        "paddle_operator_tpu.infer.prefill_serve"]}]}}
+        job2 = TPUJob(name="xj", namespace=NS, spec=TPUJobSpec(
+            serving=ServingSpec(
+                replicas=1, template=tmpl, block_size=8,
+                prefill_pool=PrefillPoolSpec(replicas=1,
+                                             template=own))))
+        pod2 = builders.construct_prefill_pod(job2, 0)
+        names = {e["name"]
+                 for e in pod2["spec"]["containers"][0]["env"]}
+        assert "SERVE_KV_QUANT" not in names
+
+    def test_decode_replicas_get_remote_prefill_env(self):
+        api, rec, fleet = _xd_setup()
+        pod = api.get("Pod", NS, "xj-serve-0")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["SERVE_PREFILL"] == "disagg"
+        assert env["SERVE_PREFILL_REMOTE"] == "1"
+        # brokered through the fleet Service fronting the router
+        assert env["SERVE_PREFILL_BROKER"] == "xj-serve:8700"
+        # a pool-less fleet injects none of it
+        api2, rec2, _ = _setup(replicas=1)
+        names = {e["name"] for e in api2.get("Pod", NS, "fj-serve-0")
+                 ["spec"]["containers"][0]["env"]}
+        assert "SERVE_PREFILL_REMOTE" not in names
+
+    def test_configmap_and_router_carry_prefill_endpoints(self):
+        api, rec, fleet = _xd_setup(prefill=2)
+        cm = api.get("ConfigMap", NS, "xj")
+        eps = cm["data"]["TPUJOB_PREFILL_REPLICAS"].split(",")
+        assert len(eps) == 2
+        assert all(ep.endswith(":8701") for ep in eps)
+        router = api.get("Pod", NS, "xj-router-0")
+        env = {e["name"]: e.get("value")
+               for e in router["spec"]["containers"][0]["env"]}
+        assert env["ROUTER_PREFILL_ENDPOINTS_FILE"].endswith(
+            "TPUJOB_PREFILL_REPLICAS")
+
+    def test_prefill_scale_down_drains(self):
+        """A prefill victim goes through the SAME annotate -> SIGTERM
+        -> exit-83 drain path as a decode victim, counted preempted
+        under the pool's own fleet counter."""
+        api, rec, fleet = _xd_setup(prefill=2)
+        raw = api.get(KIND_JOB, NS, "xj")
+        raw["spec"]["serving"]["prefillPool"]["replicas"] = 1
+        api.update(KIND_JOB, raw)
+        rec.reconcile(NS, "xj")
+        pod = api.get("Pod", NS, "xj-prefill-1")
+        assert pod["metadata"]["annotations"]["tpujob-drain"] \
+            == "scale-down"
+        fleet.preempt("xj-prefill-1")
+        run_to_settled(rec, NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert ("Pod", NS, "xj-prefill-1") not in api.store
+        assert got.status.preempted_count == 1
+        assert got.status.serving["fleet"]["prefillDrained"] == 1
+        assert got.status.phase == "Running"
+
+    def test_failed_prefill_pod_replaced(self):
+        api, rec, fleet = _xd_setup(prefill=2)
+        fleet.fail("xj-prefill-0")
+        run_to_settled(rec, NS, "xj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert ("Pod", NS, "xj-prefill-0") in api.store
+        assert got.status.serving["fleet"]["prefillRestarts"] == 1
+        assert got.status.restart_count == 0
+        assert got.status.phase == "Running"
+
+    def test_serde_and_crd_schema_roundtrip(self):
+        from paddle_operator_tpu.api.crd import (
+            generate_crd,
+            validate_tpujob_object,
+        )
+        from paddle_operator_tpu.api.types import AutoscaleSpec
+
+        job = _xd_job(autoscale=AutoscaleSpec(
+            ttft_target_ms=800.0, tok_s_per_replica=120.0,
+            max_replicas=6, prefill_max=8, cooldown_s=20.0,
+            up_cooldown_s=3.0))
+        back = TPUJob.from_dict(job.to_dict())
+        pp = back.spec.serving.prefill_pool
+        a = back.spec.serving.autoscale
+        assert pp.replicas == 2 and pp.port == 8701
+        assert a.ttft_target_ms == 800.0
+        assert a.tok_s_per_replica == 120.0
+        assert (a.max_replicas, a.prefill_max) == (6, 8)
+        assert (a.cooldown_s, a.up_cooldown_s) == (20.0, 3.0)
+        schema = generate_crd()["spec"]["versions"][0]["schema"][
+            "openAPIV3Schema"]["properties"]
+        serving = schema["spec"]["properties"]["serving"]["properties"]
+        assert "prefillPool" in serving
+        assert "autoscale" in serving
+        assert "prefill" in schema["status"]["properties"]
+        assert validate_tpujob_object(job.to_dict()) == []
+
+    def test_validation(self):
+        from paddle_operator_tpu.api.types import AutoscaleSpec
+
+        bad = _xd_job(autoscale=AutoscaleSpec(max_replicas=2,
+                                              min_replicas=5))
+        assert any("maxReplicas" in e for e in bad.validate())
+        bad = TPUJob(name="b", namespace=NS, spec=TPUJobSpec(
+            serving=ServingSpec(replicas=1, template=TMPL,
+                                autoscale=AutoscaleSpec(
+                                    prefill_max=3))))
+        assert any("prefillPool" in e for e in bad.validate())
+        # enabled autoscale without its SLO target would read load
+        # ratio 0.0 forever (drain to min, never scale up) — refused
+        bad = _xd_job(autoscale=AutoscaleSpec(max_replicas=4))
+        assert any("tokSPerReplica" in e for e in bad.validate())
+        bad = _xd_job(autoscale=AutoscaleSpec(prefill_max=4))
+        assert any("ttftTargetMs" in e for e in bad.validate())
+        good = _xd_job(autoscale=AutoscaleSpec(
+            max_replicas=4, tok_s_per_replica=100.0,
+            prefill_max=4, ttft_target_ms=800.0))
+        assert good.validate() == []
+        assert _xd_job().validate() == []
+
+
+class TestAutoscalerLaw:
+    """controller/autoscaler.py pure units: hysteresis, asymmetric
+    cool-down, min/max clamp, drain gate, anticipatory denominator."""
+
+    def _step(self, current, ratio, *, now=100.0, last=0.0,
+              lo=1, hi=8, cd=30.0, ucd=5.0, sdr=0.5, draining=False):
+        from paddle_operator_tpu.controller.autoscaler import step
+
+        return step(lo, hi, current, ratio, now=now, last_scale_t=last,
+                    cooldown_s=cd, up_cooldown_s=ucd,
+                    scale_down_ratio=sdr, draining=draining)
+
+    def test_hysteresis_band_holds(self):
+        # between the down-water mark and 1.0: no action either way
+        assert self._step(3, 0.8) == (3, "")
+        assert self._step(3, 1.0) == (3, "")
+
+    def test_up_proportional_and_clamped(self):
+        assert self._step(2, 1.5) == (3, "up")
+        assert self._step(2, 3.0) == (6, "up")
+        assert self._step(4, 4.0) == (8, "up")     # clamp at max
+        assert self._step(8, 9.9) == (8, "")       # already at max
+
+    def test_down_one_at_a_time(self):
+        assert self._step(4, 0.1) == (3, "down")
+        assert self._step(1, 0.0) == (1, "")       # floor
+
+    def test_asymmetric_cooldown(self):
+        # up waits only up_cooldown_s; down waits the full cooldown_s
+        assert self._step(2, 2.0, now=103.0, last=100.0) == (2, "")
+        assert self._step(2, 2.0, now=106.0, last=100.0) == (4, "up")
+        assert self._step(4, 0.1, now=106.0, last=100.0) == (4, "")
+        assert self._step(4, 0.1, now=131.0, last=100.0) == (3, "down")
+
+    def test_drain_gates_downscale_only(self):
+        assert self._step(4, 0.1, draining=True) == (4, "")
+        assert self._step(2, 2.0, draining=True) == (4, "up")
+
+    def test_autoscale_off_leaves_spec(self):
+        assert self._step(3, 9.0, hi=0) == (3, "")
+
+    def test_prefill_ratio_converts_ttft_to_depth(self):
+        from paddle_operator_tpu.controller.autoscaler import (
+            SLO_HEADROOM,
+            prefill_load_ratio,
+        )
+
+        # 1000ms target x headroom over 100ms/job = 10 - 1 = 4 jobs/pod
+        allowed = 1000.0 * SLO_HEADROOM / 100.0 - 1.0
+        r = prefill_load_ratio(8.0, 2, 100.0, 1000.0)
+        assert abs(r - 8.0 / (2 * allowed)) < 1e-9
+        # no service-time reading yet: one job per pod
+        assert prefill_load_ratio(3.0, 3, 0.0, 1000.0) == 1.0
+        # no declared target: autoscale contributes nothing
+        assert prefill_load_ratio(99.0, 1, 100.0, 0.0) == 0.0
+
+    def test_decode_ratio_starvation_floor(self):
+        from paddle_operator_tpu.controller.autoscaler import (
+            decode_load_ratio,
+        )
+
+        # plateaued tok/s BELOW target but queueing with zero free
+        # blocks: admission-bound saturation must read as overload
+        r = decode_load_ratio(50.0, 8.0, 0.0, 2, 100.0)
+        assert r > 1.0
+        # same plateau with free blocks: genuinely underloaded
+        assert decode_load_ratio(50.0, 0.0, 64.0, 2, 100.0) == 0.25
+
+    def test_anticipatory_denominator_suppresses_restep(self):
+        """While requested pods boot (ready < desired), the SAME
+        backlog must not compound into another up-step."""
+        from paddle_operator_tpu.api.types import AutoscaleSpec
+        from paddle_operator_tpu.controller.autoscaler import (
+            FleetAutoscaler,
+        )
+
+        a = FleetAutoscaler(AutoscaleSpec(
+            ttft_target_ms=1000.0, prefill_min=1, prefill_max=8,
+            up_cooldown_s=1.0, cooldown_s=30.0))
+        gauges = {"prefillQueueDepth": 24.0, "prefillMsAvg": 100.0}
+        # first observation seeds the state (creation grace window)
+        st = a.observe(None, gauges, decode_spec=1, prefill_spec=1,
+                       decode_ready=1, prefill_ready=1,
+                       decode_draining=False, prefill_draining=False,
+                       now=1000.0)
+        st = a.observe(st, gauges, decode_spec=1, prefill_spec=1,
+                       decode_ready=1, prefill_ready=1,
+                       decode_draining=False, prefill_draining=False,
+                       now=1001.5)
+        grown = st["prefillDesired"]
+        assert grown == 4       # ceil(1 x min(ratio, 4)), ratio = 6
+        # next windows: pods still booting (ready stays 1), backlog
+        # unchanged — the REQUESTED capacity divides the ratio, so the
+        # law converges on exactly the pods that clear the backlog
+        # inside the SLO (24 jobs / 4 allowed per pod = 6) and HOLDS,
+        # instead of compounding the same backlog to max
+        for now, want in ((1003.0, 6), (1004.5, 6), (1006.0, 6)):
+            st = a.observe(st, gauges, decode_spec=1, prefill_spec=1,
+                           decode_ready=1, prefill_ready=1,
+                           decode_draining=False,
+                           prefill_draining=False, now=now)
+            assert st["prefillDesired"] == want, (now, st)
+
+    def test_first_observation_gets_cooldown_grace(self):
+        """A fresh fleet with no gauges yet must not insta-downscale:
+        job creation counts as the last action."""
+        from paddle_operator_tpu.api.types import AutoscaleSpec
+        from paddle_operator_tpu.controller.autoscaler import (
+            FleetAutoscaler,
+        )
+
+        a = FleetAutoscaler(AutoscaleSpec(
+            ttft_target_ms=1000.0, tok_s_per_replica=100.0,
+            min_replicas=1, max_replicas=4, prefill_min=1,
+            prefill_max=4, cooldown_s=30.0))
+        st = a.observe(None, {}, decode_spec=3, prefill_spec=3,
+                       decode_ready=0, prefill_ready=0,
+                       decode_draining=False, prefill_draining=False,
+                       now=5000.0)
+        assert st["decodeDesired"] == 3
+        assert st["prefillDesired"] == 3
+
+
+class TestAutoscalerReconcile:
+    """The law driven THROUGH the reconciler with the FakeAPI: scaled
+    pod counts materialize, downscale drains, cool-down damps."""
+
+    def _autoscale(self, **kw):
+        from paddle_operator_tpu.api.types import AutoscaleSpec
+
+        kw.setdefault("ttft_target_ms", 1000.0)
+        kw.setdefault("prefill_min", 1)
+        kw.setdefault("prefill_max", 6)
+        kw.setdefault("cooldown_s", 30.0)
+        kw.setdefault("up_cooldown_s", 5.0)
+        return AutoscaleSpec(**kw)
+
+    def _gauges(self, api, name, **g):
+        raw = api.get(KIND_JOB, NS, name)
+        raw.setdefault("status", {}).setdefault("serving", {}).update(g)
+        api.update_status(KIND_JOB, raw)
+
+    def test_scale_up_on_queue_pressure(self):
+        clock = [10000.0]
+        api, rec, fleet = _xd_setup(
+            prefill=1, autoscale=self._autoscale(),
+            clock=lambda: clock[0])
+        # a burst: deep prefill queue at 100ms/job against a 1000ms SLO
+        self._gauges(api, "xj", prefillQueueDepth=24.0,
+                     prefillMsAvg=100.0)
+        clock[0] += 40.0            # past the boot grace window
+        run_to_settled(rec, NS, "xj")
+        fleet.run_all()
+        run_to_settled(rec, NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        desired = got.status.serving["fleet"]["prefillReplicasDesired"]
+        assert desired > 1
+        pods = [k[2] for k in api.store
+                if k[0] == "Pod" and "prefill" in k[2]]
+        assert len(pods) == desired
+        assert any(e["reason"] == "Autoscaled" for e in api.events)
+
+    def test_downscale_drains_and_cooldown_damps(self):
+        clock = [10000.0]
+        api, rec, fleet = _xd_setup(
+            prefill=3, autoscale=self._autoscale(),
+            clock=lambda: clock[0])
+        # idle pool: load ratio 0 -> shed one replica per cool-down
+        self._gauges(api, "xj", prefillQueueDepth=0.0,
+                     prefillMsAvg=100.0)
+        clock[0] += 40.0
+        rec.reconcile(NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        # the DECISION persisted (the fleet counter refreshes once the
+        # drain settles — the pass stops at the victim first)
+        assert got.status.serving["fleet"]["autoscaler"][
+            "prefillDesired"] == 2
+        # the victim drains through the PR 9 path: advance-notice
+        # annotation on this pass, SIGTERM-by-delete on the next
+        pod = api.get("Pod", NS, "xj-prefill-2")
+        assert pod["metadata"]["annotations"]["tpujob-drain"] \
+            == "scale-down"
+        fleet.preempt("xj-prefill-2")
+        run_to_settled(rec, NS, "xj")
+        assert ("Pod", NS, "xj-prefill-2") not in api.store
+        # cool-down: an immediate next pass must NOT shed another
+        rec.reconcile(NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert got.status.serving["fleet"][
+            "prefillReplicasDesired"] == 2
+        # ...until the window passes
+        clock[0] += 31.0
+        run_to_settled(rec, NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert got.status.serving["fleet"][
+            "prefillReplicasDesired"] == 1
+
+    def test_clamp_and_decode_pool(self):
+        clock = [10000.0]
+        api, rec, fleet = _xd_setup(
+            replicas=1, prefill=1,
+            autoscale=self._autoscale(tok_s_per_replica=100.0,
+                                      min_replicas=1, max_replicas=2),
+            clock=lambda: clock[0])
+        # decode overload way past what max allows: clamped at 2
+        self._gauges(api, "xj", tokensPerSec=900.0, queueDepth=10.0,
+                     kvBlocksFree=0.0)
+        clock[0] += 40.0
+        run_to_settled(rec, NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert got.status.serving["fleet"]["replicasDesired"] == 2
+        serve = [k[2] for k in api.store
+                 if k[0] == "Pod" and "-serve-" in k[2]]
+        assert sorted(serve) == ["xj-serve-0", "xj-serve-1"]
+
+    def test_cooldown_survives_controller_restart(self):
+        """The cool-down stamp rides status: a BRAND NEW reconciler
+        (controller restart) must still damp the next downscale."""
+        clock = [10000.0]
+        api, rec, fleet = _xd_setup(
+            prefill=2, autoscale=self._autoscale(),
+            clock=lambda: clock[0])
+        self._gauges(api, "xj", prefillQueueDepth=0.0)
+        clock[0] += 40.0
+        run_to_settled(rec, NS, "xj")   # sheds one (desired 1)
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert got.status.serving["fleet"][
+            "prefillReplicasDesired"] == 1
+        rec2 = TPUJobReconciler(api)    # fresh controller
+        rec2.clock = lambda: clock[0] + 5.0     # inside the window
+        rec2.reconcile(NS, "xj")
+        got = TPUJob.from_dict(api.get(KIND_JOB, NS, "xj"))
+        assert got.status.serving["fleet"][
+            "prefillReplicasDesired"] == 1      # damped, not 0-bound

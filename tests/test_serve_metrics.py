@@ -138,6 +138,10 @@ class TestGaugeNaming:
             'tpujob_serve_peer_prefix_fetches_total'
             '{job="default/j"}',
             'tpujob_serve_parked_lanes{job="default/j"}',
+            # cross-host disaggregation shape (ISSUE 13): cold prompts
+            # prefilled in the prefill pool and handed off over the
+            # wire (zero on in-process/inline rings)
+            'tpujob_serve_remote_prefills_total{job="default/j"}',
             # multi-tenant QoS shape (ISSUE 10): one queue-depth gauge
             # per class in the block, preemptions, adapter count + one
             # marker per loaded adapter name
@@ -325,6 +329,8 @@ class TestBatcherServingStatus:
                            # fleet-level KV block (ISSUE 12)
                            "laneMigrations", "adoptedLanes",
                            "peerPrefixFetches", "hostCacheEvictions",
+                           # cross-host disaggregation block (ISSUE 13)
+                           "remotePrefills",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
@@ -336,6 +342,7 @@ class TestBatcherServingStatus:
         assert st["promotedBlocks"] == 0
         assert st["priorityQueueDepth"] == [0, 0]   # 2 classes default
         assert st["preemptedLanes"] == 0
+        assert st["remotePrefills"] == 0       # no prefill pool by default
         assert st["laneMigrations"] == 0       # fleet KV off by default
         assert st["adoptedLanes"] == 0
         assert st["peerPrefixFetches"] == 0
