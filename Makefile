@@ -66,7 +66,8 @@ bench:
 # (all training parallelism axes, plus the serving parity lines:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
 # serve-disagg, serve-kvquant, serve-hostcache, serve-fleet,
-# serve-qos, serve-megastep, serve-fleetkv, serve-xdisagg, ft-drain)
+# serve-qos, serve-megastep, serve-fleetkv, serve-xdisagg,
+# serve-prefillpool, ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

@@ -270,6 +270,19 @@ def _spec_schema() -> Dict[str, Any]:
                             "replicas": _int(0),
                             "port": _int(1),
                             "template": _pod_template_schema(),
+                            # prefill-pool throughput (ISSUE 14):
+                            # lanes >= 2 runs the batched, chunk-
+                            # interleaved N-lane engine per pod
+                            # (SERVE_PREFILL_LANES; 1 keeps the
+                            # monolithic oracle); stream turns on
+                            # chunked block-group handoff frames
+                            # (SERVE_PREFILL_STREAM on the decode
+                            # replicas); prefixBlocks caps each pod's
+                            # own radix prefix cache
+                            # (SERVE_PREFILL_PREFIX_BLOCKS)
+                            "lanes": _int(1),
+                            "stream": {"type": "boolean"},
+                            "prefixBlocks": _int(0),
                         },
                     },
                     # SLO autoscaler (ISSUE 13): declared TTFT /

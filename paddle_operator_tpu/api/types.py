@@ -292,12 +292,29 @@ class PrefillPoolSpec:
     - ``port``      the port each prefill pod serves /v1/prefill on;
     - ``template``  prefill pod template — when empty it derives from
       the serving replica template's image running the prefill module
-      (the common case: same image, different entrypoint).
+      (the common case: same image, different entrypoint);
+    - ``lanes``     engine width per pod (ISSUE 14): >= 2 runs the
+      batched, chunk-interleaved N-lane engine (comparable queued
+      jobs coalesce into ONE compiled forward; long prompts advance
+      one chunk slice per iteration alongside short ones); 1 (the
+      default) keeps the monolithic single-job engine — the parity
+      oracle — so existing fleets are byte-identical;
+    - ``stream``    streamed block handoff: decode replicas consume
+      chunked handoff frames, uploading completed block groups while
+      the pod still prefills the rest (long-prompt TTFT ~ last chunk
+      + attach instead of full prefill + full transfer);
+    - ``prefix_blocks``  capacity (in pool blocks) of each pod's OWN
+      radix prefix cache — repeated system prompts prefill only the
+      suffix on the prefill side too; None keeps the server default
+      (256), 0 disables.  Engine-only (lanes >= 2).
     """
 
     replicas: int = 1
     port: int = PREFILL_PORT
     template: Dict[str, Any] = field(default_factory=dict)
+    lanes: int = 1
+    stream: bool = False
+    prefix_blocks: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {"replicas": self.replicas}
@@ -305,6 +322,12 @@ class PrefillPoolSpec:
             d["port"] = self.port
         if self.template:
             d["template"] = self.template
+        if self.lanes != 1:
+            d["lanes"] = self.lanes
+        if self.stream:
+            d["stream"] = self.stream
+        if self.prefix_blocks is not None:
+            d["prefixBlocks"] = self.prefix_blocks
         return d
 
     @classmethod
@@ -312,10 +335,14 @@ class PrefillPoolSpec:
                   ) -> Optional["PrefillPoolSpec"]:
         if d is None:
             return None
+        pb = d.get("prefixBlocks")
         return cls(
             replicas=int(d.get("replicas", 1)),
             port=int(d.get("port", PREFILL_PORT)),
             template=d.get("template", {}) or {},
+            lanes=int(d.get("lanes", 1)),
+            stream=bool(d.get("stream", False)),
+            prefix_blocks=int(pb) if pb is not None else None,
         )
 
 

@@ -62,25 +62,47 @@ SLO_HEADROOM = 0.5
 
 def prefill_load_ratio(queue_depth: float, ready: int,
                        prefill_ms_avg: float,
-                       ttft_target_ms: float) -> float:
-    """Observed prefill load over SLO capacity.  Queued jobs serialize
-    per pod, so a pod's queue contributes ``depth x service_time`` to
-    the cold TTFT of the job at its tail; the pool meets the target
-    while per-pod depth stays under the SLO budget over the service
-    time — with :data:`SLO_HEADROOM` of the budget as the setpoint so
-    boot transients and burst onsets land INSIDE the target rather
-    than on top of it.  With no service-time reading yet (a fresh
-    pool), one queued job per pod is taken as the capacity —
-    conservative: the pool grows until real readings arrive."""
+                       ttft_target_ms: float,
+                       lanes: int = 1,
+                       batch_occupancy: Optional[float] = None
+                       ) -> float:
+    """Observed prefill load over SLO capacity.  Queued jobs
+    serialize per pod in batches of ``lanes`` (the ISSUE 14 N-lane
+    engine drains N comparable jobs per service quantum), so the job
+    at a pod's queue tail waits ``~depth/lanes x service_time``; the
+    pool meets the target while per-pod depth stays under ``lanes x``
+    the SLO budget over the service time — with :data:`SLO_HEADROOM`
+    of the budget as the setpoint so boot transients and burst onsets
+    land INSIDE the target rather than on top of it.  With no
+    service-time reading yet (a fresh pool), ``lanes`` queued jobs
+    per pod are taken as the capacity — conservative: the pool grows
+    until real readings arrive.
+
+    ``batch_occupancy`` (the scraped
+    ``tpujob_serve_prefill_batch_occupancy`` EMA): the depth gauge
+    counts RUNNING jobs too, so a pool whose batches run below
+    saturation would read loaded while it still has free lanes — the
+    in-flight jobs ``occupancy x lanes x ready`` are subtracted from
+    the observed depth (they occupy lanes, not the queue) so a
+    half-empty batch never reads as a saturated pool.  A SATURATED
+    batch (occupancy 1.0) keeps the full reading: at saturation the
+    depth gauge cannot distinguish running from waiting, and the
+    conservative read is that arrivals queue."""
     if ttft_target_ms <= 0:
         return 0.0
     ready = max(1, int(ready))
+    lanes = max(1, int(lanes))
     if prefill_ms_avg > 0:
         allowed_per_pod = max(
-            1.0, ttft_target_ms * SLO_HEADROOM / prefill_ms_avg - 1.0)
+            1.0,
+            lanes * (ttft_target_ms * SLO_HEADROOM / prefill_ms_avg
+                     - 1.0))
     else:
-        allowed_per_pod = 1.0
-    return float(queue_depth) / (ready * allowed_per_pod)
+        allowed_per_pod = float(lanes)
+    depth = float(queue_depth)
+    if batch_occupancy is not None and 0.0 <= batch_occupancy < 1.0:
+        depth = max(0.0, depth - batch_occupancy * lanes * ready)
+    return depth / (ready * allowed_per_pod)
 
 
 def decode_load_ratio(tokens_per_sec: float, queue_depth: float,
@@ -177,11 +199,14 @@ class FleetAutoscaler:
             float(serving.get("queueDepth", 0.0) or 0.0),
             float(serving.get("kvBlocksFree", 0.0) or 0.0),
             max(decode_ready, d_cur), a.tok_s_per_replica)
+        occ = serving.get("prefillBatchOccupancy")
         p_ratio = prefill_load_ratio(
             float(serving.get("prefillQueueDepth", 0.0) or 0.0),
             max(prefill_ready, p_cur),
             float(serving.get("prefillMsAvg", 0.0) or 0.0),
-            a.ttft_target_ms)
+            a.ttft_target_ms,
+            lanes=int(serving.get("prefillLanes", 1) or 1),
+            batch_occupancy=(float(occ) if occ is not None else None))
 
         d_new, d_why = step(
             a.min_replicas, a.max_replicas, d_cur, d_ratio, now=now,

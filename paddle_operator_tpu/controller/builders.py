@@ -678,6 +678,11 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
         _env_setdefault(env, "SERVE_PREFILL_REMOTE", "1")
         _env_setdefault(env, "SERVE_PREFILL_BROKER",
                         f"{job.name}-{RESOURCE_SERVE}:{sv.port}")
+        # streamed handoff (ISSUE 14): the decode side consumes the
+        # pool's chunked frames, overlapping upload with the pod's
+        # remaining prefill compute
+        _env_setdefault(env, "SERVE_PREFILL_STREAM",
+                        "1" if sv.prefill_pool.stream else "0")
     if job.spec.checkpoint_path:
         _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
                         job.spec.checkpoint_path)
@@ -756,6 +761,12 @@ def construct_prefill_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
     env.append({"name": "TPUJOB_NAME", "value": job.name})
     env.append({"name": "TPUJOB_PORT", "value": str(pp.port)})
     _env_setdefault(env, "SERVE_BLOCK_SIZE", str(sv.block_size))
+    # prefill-pool throughput (ISSUE 14): the N-lane batched engine
+    # (1 keeps the monolithic oracle) and its own radix prefix cache
+    _env_setdefault(env, "SERVE_PREFILL_LANES", str(pp.lanes))
+    if pp.prefix_blocks is not None:
+        _env_setdefault(env, "SERVE_PREFILL_PREFIX_BLOCKS",
+                        str(pp.prefix_blocks))
     if job.spec.checkpoint_path:
         _env_setdefault(env, "TPUJOB_CHECKPOINT_PATH",
                         job.spec.checkpoint_path)

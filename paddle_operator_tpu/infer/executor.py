@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -887,29 +888,253 @@ def make_disagg_prefill(cfg: LlamaConfig, bucket: int, block_size: int,
     return jax.jit(prefill)
 
 
+def make_pool_prefill_slice(cfg: LlamaConfig, mesh=None,
+                            quant: bool = False):
+    """One MULTI-LANE intermediate prefill slice for the N-lane
+    prefill engine (ISSUE 14): advance EVERY participating lane's job
+    by up to ``slice`` tokens in ONE compiled forward — per-lane block
+    tables, per-lane absolute positions, no lm head.  Lanes sitting
+    the iteration out ride masked: their rows route to the trash block
+    (``limits`` 0) and — quant — their staging-tail writes redirect to
+    the trash tail (``mask``), so a paused lane's live tail state is
+    never touched.  The batch dimension IS the engine lane index, so
+    the pool's per-lane staging tails address directly.
+
+    ``slice(params, cache, tables [N, M], toks [N, sb], starts [N],
+    limits [N], mask [N]) -> cache'``
+
+    NOT donated: streamed-handoff frames hold version snapshots of the
+    pool arrays (the release protocol in :class:`PrefillExecutor`'s
+    docstring), and donating a referenced buffer would delete it under
+    the decode side's transfer.
+
+    bf16 writes go WHOLE-BLOCK (``aligned=True`` — the engine rounds
+    its chunk to a block multiple and every slice start is
+    block-aligned by construction), so the traced write-op count is
+    O(lanes x blocks), not O(lanes x rows): at production slice widths
+    the per-row unroll is pathological to COMPILE.  The quant tail
+    protocol is inherently per-row and keeps the row path."""
+    from paddle_operator_tpu.infer.speculative import _multi_forward_paged
+
+    def slice_(params, cache, tables, toks, starts, limits, mask,
+               *lora_args):
+        lane_cache = {"k": cache["k"], "v": cache["v"], "pos": starts}
+        if quant:
+            lane_cache["ks"], lane_cache["vs"] = cache["ks"], cache["vs"]
+            lane_cache["kt"], lane_cache["vt"] = cache["kt"], cache["vt"]
+        _, new = _multi_forward_paged(
+            cfg, params, toks, lane_cache, tables, limit=limits,
+            mesh=mesh, head=False, quant=quant,
+            lane_mask=(mask if quant else None),
+            lora=tuple(lora_args) if lora_args else None,
+            aligned=not quant)
+        out = {"k": new["k"], "v": new["v"], "pos": cache["pos"]}
+        if quant:
+            out["ks"], out["vs"] = new["ks"], new["vs"]
+            out["kt"], out["vt"] = new["kt"], new["vt"]
+        return out
+
+    return jax.jit(slice_)
+
+
+def make_pool_prefill_final(cfg: LlamaConfig,
+                            top_k: Optional[int] = None,
+                            top_p: Optional[float] = None, mesh=None,
+                            quant: bool = False):
+    """The FINAL prefill slice for the N-lane engine: run each
+    finishing lane's last ``n_rows`` prompt tokens (right-padded to
+    the slice width) WITH the lm head, and sample every finishing
+    lane's first token through the shared rule — the batched analogue
+    of the monolithic path's ``logits[prompt_len - 1]`` +
+    ``_sample_tokens`` tail, so first tokens stay bit-identical to the
+    1-lane oracle.  Non-finishing lanes ride masked exactly as in
+    :func:`make_pool_prefill_slice`; their sampled "firsts" are
+    garbage the host ignores.
+
+    ``final(params, cache, tables [N, M], toks [N, sb], n_rows [N],
+    starts [N], temps [N], seeds [N], limits [N], mask [N])
+    -> (cache', firsts [N])``
+
+    bf16 writes are whole-block like the intermediate slice (the
+    straddling block writes its pad rows into the lane's real block —
+    :func:`ops.decode_attention.scatter_prefill_blocks`'s
+    exactness-with-padding contract: masked in-slice, overwritten by
+    decode before any read, and the prefix cache stores only full
+    blocks strictly inside the prompt)."""
+    from paddle_operator_tpu.infer.speculative import _multi_forward_paged
+
+    def final(params, cache, tables, toks, n_rows, starts, temps,
+              seeds, limits, mask, *lora_args):
+        lane_cache = {"k": cache["k"], "v": cache["v"], "pos": starts}
+        if quant:
+            lane_cache["ks"], lane_cache["vs"] = cache["ks"], cache["vs"]
+            lane_cache["kt"], lane_cache["vt"] = cache["kt"], cache["vt"]
+        logits, new = _multi_forward_paged(
+            cfg, params, toks, lane_cache, tables, limit=limits,
+            mesh=mesh, quant=quant,
+            lane_mask=(mask if quant else None),
+            lora=tuple(lora_args) if lora_args else None,
+            aligned=not quant)
+        out = {"k": new["k"], "v": new["v"], "pos": cache["pos"]}
+        if quant:
+            out["ks"], out["vs"] = new["ks"], new["vs"]
+            out["kt"], out["vt"] = new["kt"], new["vt"]
+        # per-lane last REAL row's logits, clamped so masked lanes
+        # (n_rows 0) index row 0 harmlessly
+        rows = jnp.take_along_axis(
+            logits, jnp.maximum(n_rows - 1, 0)[:, None, None],
+            axis=1)[:, 0]
+        keys = jax.vmap(jax.random.PRNGKey)(seeds)
+        firsts = _sample_tokens(rows, temps.astype(jnp.float32), keys,
+                                starts + jnp.maximum(n_rows - 1, 0),
+                                top_k, top_p)
+        return out, firsts
+
+    return jax.jit(final)
+
+
+class PrefillPrefixCache:
+    """The prefill pod's OWN radix prefix cache (ISSUE 14): completed
+    full blocks' exact pool bytes, host-resident, keyed by the SAME
+    ``utils/radixkey`` rolling-hash chain the decode radix (and the
+    router's affinity) use — so a repeated system prompt prefills only
+    its suffix ON THE PREFILL SIDE too.  A hit's payloads upload into
+    the job's lane blocks through the promote scatter (byte-exact, no
+    requantization), which is what keeps a hit bit-identical to cold.
+    Bounded LRU by block count; stored chunks are compared on hit (the
+    radix collision check).  Payloads may briefly be device arrays
+    (async D2H in flight) — :meth:`materialize` settles them before
+    the next engine touch, the ``_demote_lazy`` pattern."""
+
+    def __init__(self, capacity_blocks: int) -> None:
+        from collections import OrderedDict
+
+        self.cap = int(capacity_blocks)
+        self._d: "OrderedDict[Any, tuple]" = OrderedDict()
+        self._lazy: List[Dict[str, Any]] = []
+        self.hits = 0
+        self.lookups = 0
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def materialize(self) -> None:
+        for p in self._lazy:
+            for key, val in p.items():
+                if not isinstance(val, np.ndarray):
+                    p[key] = np.asarray(val)
+        self._lazy.clear()
+
+    def put(self, key, chunk: Tuple[int, ...],
+            payload: Dict[str, Any], lazy: bool = False) -> None:
+        if self.cap <= 0 or key in self._d:
+            if key in self._d:
+                self._d.move_to_end(key)
+            return
+        self._d[key] = (chunk, payload)
+        if lazy:
+            self._lazy.append(payload)
+        while len(self._d) > self.cap:
+            self._d.popitem(last=False)
+
+    def get(self, key, chunk: Tuple[int, ...]
+            ) -> Optional[Dict[str, Any]]:
+        ent = self._d.get(key)
+        if ent is None or ent[0] != tuple(chunk):
+            return None
+        self._d.move_to_end(key)
+        return ent[1]
+
+
+class _EngineJob:
+    """One in-flight job's host state on the N-lane prefill engine."""
+
+    __slots__ = ("req", "slot", "n", "start", "hit", "frames_done",
+                 "prompt")
+
+    def __init__(self, req, slot, start, hit):
+        self.req = req
+        self.slot = slot
+        self.prompt = [int(t) for t in req.prompt]
+        self.n = len(self.prompt)
+        self.start = start          # next absolute row to prefill
+        self.hit = hit              # prefix-cache rows (block-aligned)
+        self.frames_done = 0        # blocks already posted as frames
+
+
 class PrefillExecutor:
     """The disaggregated prefill engine: its OWN thread and its OWN
-    small block pool, so a cold 2k-token prefill never occupies the
-    decode ring's dispatch stream.  The decode scheduler submits
-    ``(request, slot)`` jobs; this thread prefills the whole prompt
-    into its private pool (one job at a time — prefill batches
-    independently of decode, which is the DistServe argument) and posts
-    ``(request, slot, k, v, n_blocks, first_token)`` results.  Because
-    jax arrays are immutable, the posted k/v SNAPSHOT stays valid while
-    the next job writes a fresh pool version — no block-release
-    protocol is needed and the pool is exactly one lane wide.
+    block pool, so a cold 2k-token prefill never occupies the decode
+    ring's dispatch stream.  The decode scheduler submits ``(request,
+    slot)`` jobs; this thread prefills prompts into its private pool
+    and posts results the scheduler lands through the handoff path.
 
-    Fault isolation: a prefill dispatch failure posts ``(request, slot,
-    error)`` — the scheduler fails THAT request only; the decode ring
-    (and its watchdog/heal machinery) never sees the fault.  Drain and
-    close() flush the queue; jobs whose request resolved meanwhile
-    (cancel, deadline, heal) are dropped at either end."""
+    **Two engine shapes** (ISSUE 14):
+
+    - ``lanes == 1`` (default): the ORIGINAL monolithic engine — one
+      job at a time, whole prompt in one bucketed compiled forward,
+      one ``(request, slot, snapshot, n_blocks, first)`` result.  This
+      path is byte-for-byte the PR 6 engine and stays the parity
+      ORACLE for everything below.
+    - ``lanes >= 2``: a throughput engine.  The pool is N lanes wide
+      (lane ``i`` owns the FIXED identity blocks ``[1 + i*M,
+      1 + (i+1)*M)``; block 0 stays trash) and the loop is a
+      mini-ring: each iteration coalesces every active job into ONE
+      batched compiled slice (``make_pool_prefill_slice`` — per-lane
+      tables and positions, the ``make_disagg_prefill`` trace
+      generalized to the batch dim), amortizing weight streaming and
+      dispatch overhead across cold arrivals, and long jobs advance
+      one ``prefill_chunk`` slice per iteration ALONGSIDE short jobs
+      (chunk-interleaved scheduling — a 40-token prompt is never
+      stuck behind a 2k-token one; the Sarathi-Serve argument applied
+      to the prefill pool).  Finishing jobs run the lm head + shared
+      first-token sample in the batched final program.  Intermediate
+      slices append KV only (the ``head=False`` forwards), so the
+      interleave is prompt-proportional work.
+
+    **Streamed handoff + the snapshot-lifetime rule.**  With
+    ``stream=True`` completed block groups post to ``results`` as
+    ``("frame", req, slot, snapshot, lane, j0, j1)`` items the decode
+    side uploads WHILE this engine computes the rest — long-prompt
+    TTFT collapses to last-chunk + attach.  The terminal item
+    ``("final", req, slot, snapshot, lane, j0, n_blocks, first,
+    t_done)`` carries the remaining blocks, the (quant) staging tail
+    and the sampled first token.  A multi-lane pool with REUSED lanes
+    needs a real release protocol where the 1-lane engine needed
+    none; the rule is: **a lane is reassigned only after its previous
+    job's terminal item has been POSTED, and every posted item pins
+    the pool VERSION it snapshotted** — jax arrays are immutable, so
+    the next job's writes produce new versions and can never corrupt
+    an outstanding snapshot; no engine program donates the pool for
+    exactly this reason.  What bounds memory is the decode side
+    draining ``results`` every loop pass: at most one pool version per
+    undrained item stays alive, and the queue never outlives its
+    scheduler.
+
+    **Prefix reuse** (``prefix_blocks > 0``, lanes >= 2): a
+    :class:`PrefillPrefixCache` keyed on the shared radix chain; a hit
+    uploads cached block bytes into the job's lane and prefill starts
+    at the (block-aligned) hit frontier — bit-identical to cold
+    because the uploaded bytes ARE a cold run's bytes.  Adapter jobs
+    skip the cache (deltas change the KV; the decode radix namespaces
+    per adapter, the prefill pool simply abstains).
+
+    Fault isolation: a prefill dispatch failure posts ``(request,
+    slot, error)`` tuples — batch-granular on the N-lane engine (one
+    fused dispatch serves every active job, so all of them fail and
+    retry; the pool is rebuilt lane-clean by the next assignments) —
+    and the decode ring (with its watchdog/heal machinery) never sees
+    the fault.  Jobs whose request resolved meanwhile (cancel,
+    deadline, heal) are dropped at either end."""
 
     def __init__(self, params: Any, cfg: LlamaConfig, *, max_len: int,
                  block_size: int, buckets: Tuple[int, ...],
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None, mesh=None,
-                 kv_quant: str = "none", adapters=None) -> None:
+                 kv_quant: str = "none", adapters=None,
+                 lanes: int = 1, prefill_chunk: int = 64,
+                 stream: bool = False,
+                 prefix_blocks: int = 0) -> None:
         from paddle_operator_tpu.infer import paged as PG
 
         # adapter registry shared with the decode ring (ISSUE 10): a
@@ -922,57 +1147,141 @@ class PrefillExecutor:
         self.mesh = mesh
         self.kv_quant = kv_quant
         self.quant = kv_quant == "int8"
+        self.lanes = max(1, int(lanes))
+        self.stream = bool(stream) and self.lanes > 1
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        if self.lanes > 1 and not self.quant:
+            # the bf16 slice/final programs write WHOLE BLOCKS
+            # (aligned=True), which needs every slice start
+            # block-aligned: round the scheduling quantum up to a
+            # block multiple.  The interleave bound coarsens to one
+            # block when block_size > chunk — the price of
+            # O(blocks) instead of O(rows) traced writes.  The quant
+            # engine keeps the configured chunk: its staging-tail
+            # protocol is per-row regardless.
+            self.prefill_chunk = (-(-self.prefill_chunk
+                                    // self.block_size)
+                                  * self.block_size)
         alloc = D.cache_alloc_len(max_len)
         self.max_blocks = -(-alloc // self.block_size)
+        m = self.max_blocks
         # block 0 stays the trash block, same convention as the decode
-        # pool; the job's blocks are the FIXED identity row 1..M — one
-        # job at a time needs no allocator at all
-        self.cache = PG.init_paged_cache(cfg, 1, self.max_blocks + 1,
-                                         self.block_size, mesh=mesh,
-                                         quant=kv_quant)
-        self.table_row = jnp.arange(1, self.max_blocks + 1,
-                                    dtype=jnp.int32)
-        # the prefill engine's OWN bucket ladder, FINER than the ring's
-        # (block-multiple powers of two up to the ring's largest
-        # bucket): the decode ring keeps its compile set small because
-        # every admission insert is resident state it must carry, but
-        # prefill here is stateless-per-job, so it can afford shapes
-        # near the prompt length — a 300-token cold prompt runs a
-        # 512-row forward instead of the ring's padded 2048-row bucket.
-        # Phases shaping independently is the DistServe argument, and
-        # it is where the disagg TTFT win comes from in-process.
-        cap = max(buckets)
-        ladder = []
-        b = self.block_size
-        while b < cap:
-            ladder.append(b)
-            b *= 2
-        self.buckets = tuple(ladder) + (cap,)
-        self._progs = {b: make_disagg_prefill(cfg, b, self.block_size,
-                                              top_k, top_p, mesh=mesh,
-                                              quant=self.quant)
-                       for b in self.buckets}
+        # pool; lane i's job owns the FIXED identity blocks
+        # [1 + i*M, 1 + (i+1)*M) — fixed ownership needs no allocator
+        self.cache = PG.init_paged_cache(
+            cfg, self.lanes, self.lanes * m + 1, self.block_size,
+            mesh=mesh, quant=kv_quant)
+        self.table_row = jnp.arange(1, m + 1, dtype=jnp.int32)
+        self.tables = np.stack(
+            [np.arange(1 + i * m, 1 + (i + 1) * m, dtype=np.int32)
+             for i in range(self.lanes)])
+        # test hook: a callable the loop invokes at each iteration top
+        # — the deterministic pause-gate pattern (tests/test_qos.py)
+        self.pause_gate = None
+        # throughput telemetry (ISSUE 14): batch occupancy EMA (lanes
+        # busy / N per engine iteration) and per-job head-of-line
+        # queue wait samples — the tpujob_serve_prefill_batch_occupancy
+        # / _hol_wait_ms gauges
+        self._occ_ema = 0.0
+        self._hol: List[float] = []
+        self._stats_lock = threading.Lock()
+        self.iterations = 0
+        self.prefix_hits = 0
+        # the prefill pod's own radix prefix cache (multi-lane engine
+        # only — the 1-lane path stays the byte-identical oracle)
+        self.prefix = (PrefillPrefixCache(prefix_blocks)
+                       if prefix_blocks > 0 and self.lanes > 1
+                       else None)
+        if self.lanes > 1:
+            self.buckets = (self.prefill_chunk,)
+            self._slice_prog = make_pool_prefill_slice(
+                cfg, mesh=mesh, quant=self.quant)
+            self._final_prog = make_pool_prefill_final(
+                cfg, top_k, top_p, mesh=mesh, quant=self.quant)
+            self._progs: Dict[int, Any] = {}
+            if self.prefix is not None:
+                self._fetch_prog = PG.make_block_fetch(quant=self.quant)
+                self._upload_prog = PG.make_promote_blocks(
+                    self.block_size, quant=self.quant, donate=False)
+        else:
+            # the prefill engine's OWN bucket ladder, FINER than the
+            # ring's (block-multiple powers of two up to the ring's
+            # largest bucket): prefill is stateless-per-job, so it can
+            # afford shapes near the prompt length — a 300-token cold
+            # prompt runs a 512-row forward instead of the ring's
+            # padded 2048-row bucket.  Phases shaping independently is
+            # the DistServe argument.
+            cap = max(buckets)
+            ladder = []
+            b = self.block_size
+            while b < cap:
+                ladder.append(b)
+                b *= 2
+            self.buckets = tuple(ladder) + (cap,)
+            self._progs = {b: make_disagg_prefill(
+                cfg, b, self.block_size, top_k, top_p, mesh=mesh,
+                quant=self.quant) for b in self.buckets}
         self.jobs: "queue.Queue[tuple]" = queue.Queue()
         self.results: "queue.Queue[tuple]" = queue.Queue()
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="prefill-executor")
+        self._thread = threading.Thread(
+            target=(self._loop_engine if self.lanes > 1 else self._loop),
+            daemon=True, name="prefill-executor")
         self._thread.start()
 
     def submit(self, req, slot: int) -> None:
         # queue depth is tracked scheduler-side (_disagg_waiting feeds
-        # the prefillQueueDepth gauge) — this thread keeps no counters
-        self.jobs.put((req, slot))
+        # the prefillQueueDepth gauge); the enqueue stamp feeds the
+        # head-of-line wait gauge
+        self.jobs.put((req, slot, time.monotonic()))
+
+    # -- telemetry (ISSUE 14) ---------------------------------------------
+
+    def batch_occupancy(self) -> float:
+        """EMA of lanes-busy / N per engine iteration — 1.0 is a
+        saturated batch; the autoscaler divides by it so a half-empty
+        pool never reads as a saturated one."""
+        with self._stats_lock:
+            return round(self._occ_ema, 4)
+
+    def hol_wait_ms_p95(self) -> float:
+        """p95 of recent jobs' queue wait (submit -> lane assignment),
+        ms — the head-of-line blocking proxy."""
+        with self._stats_lock:
+            if not self._hol:
+                return 0.0
+            s = sorted(self._hol)
+            return round(s[min(len(s) - 1,
+                               int(0.95 * (len(s) - 1)))], 3)
+
+    def _note_wait(self, t_enq: float) -> None:
+        with self._stats_lock:
+            self._hol.append((time.monotonic() - t_enq) * 1e3)
+            if len(self._hol) > 256:
+                del self._hol[:len(self._hol) - 256]
+
+    def _note_occ(self, busy: int) -> None:
+        occ = busy / self.lanes
+        with self._stats_lock:
+            self._occ_ema = (occ if not self._occ_ema
+                             else 0.8 * self._occ_ema + 0.2 * occ)
+            self.iterations += 1
+
+    # -- the 1-lane monolithic loop (the PR 6 engine, the oracle) ----------
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
-                req, slot = self.jobs.get(timeout=0.05)
+                req, slot, t_enq = self.jobs.get(timeout=0.05)
             except queue.Empty:
                 continue
+            if self.pause_gate is not None:
+                self.pause_gate()
             try:
                 if req.done.is_set() or req._cancel:
                     continue        # resolved while queued: drop
+                self._note_wait(t_enq)
+                self._note_occ(1)
                 n = len(req.prompt)
                 pb = next(b for b in self.buckets if b >= n)
                 if pb <= req.dev_prompt.shape[1]:
@@ -1008,6 +1317,316 @@ class PrefillExecutor:
                 self.results.put((req, slot, snap, n_blocks, first))
             except Exception as e:      # noqa: BLE001 — isolate per job
                 self.results.put((req, slot, e))
+
+    # -- the N-lane batched, chunk-interleaved engine (ISSUE 14) -----------
+
+    def _snapshot(self) -> Dict[str, Any]:
+        return {key: self.cache[key]
+                for key in ("k", "v", "ks", "vs", "kt", "vt")
+                if key in self.cache}
+
+    def _prefix_walk(self, prompt: List[int]) -> Tuple[int, list]:
+        """Longest cached chain of leading FULL blocks, capped so at
+        least one real token remains to prefill (the final slice needs
+        a real row to sample from — the same n-1 cap the decode radix
+        applies); returns (hit_blocks, payloads)."""
+        from paddle_operator_tpu.utils.radixkey import chain_key
+
+        bs = self.block_size
+        self.prefix.materialize()
+        max_hit = (len(prompt) - 1) // bs
+        key = None
+        payloads = []
+        for j in range(max_hit):
+            chunk = tuple(prompt[j * bs:(j + 1) * bs])
+            key = chain_key(key, chunk)
+            p = self.prefix.get(key, chunk)
+            if p is None:
+                break
+            payloads.append(p)
+        return len(payloads), payloads
+
+    def _prefix_upload(self, lane: int, payloads: list) -> None:
+        """Land prefix-hit payloads in the lane's identity blocks
+        through the (non-donating) promote scatter — byte-exact, the
+        PR 8 host-hit discipline."""
+        n = len(payloads)
+        pad = 1
+        while pad < n:
+            pad *= 2
+        bs = self.block_size
+        p0 = payloads[0]
+        lcount, _, h, _, d = p0["k"].shape
+        slab_k = np.zeros((lcount, 1, h, pad * bs, d), p0["k"].dtype)
+        slab_v = np.zeros_like(slab_k)
+        from paddle_operator_tpu.infer import paged as PG
+
+        ids = np.full((pad,), PG.TRASH_BLOCK, np.int32)
+        for j, payload in enumerate(payloads):
+            ids[j] = self.tables[lane][j]
+            slab_k[:, 0, :, j * bs:(j + 1) * bs] = payload["k"][:, 0]
+            slab_v[:, 0, :, j * bs:(j + 1) * bs] = payload["v"][:, 0]
+        c = self.cache
+        if self.quant:
+            srow_k = np.ones((lcount, pad, h), np.float32)
+            srow_v = np.ones_like(srow_k)
+            for j, payload in enumerate(payloads):
+                srow_k[:, j] = payload["ks"][:, 0]
+                srow_v[:, j] = payload["vs"][:, 0]
+            c["k"], c["v"], c["ks"], c["vs"] = self._upload_prog(
+                c["k"], c["v"], c["ks"], c["vs"], jnp.asarray(slab_k),
+                jnp.asarray(slab_v), jnp.asarray(srow_k),
+                jnp.asarray(srow_v), jnp.asarray(ids))
+        else:
+            c["k"], c["v"] = self._upload_prog(
+                c["k"], c["v"], jnp.asarray(slab_k),
+                jnp.asarray(slab_v), jnp.asarray(ids))
+
+    def _store_prefix(self, lane: int, job: "_EngineJob") -> None:
+        """Store the finished job's full blocks (device bytes fetched
+        async — the lazy-materialize pattern) under their chain keys.
+        Never called for adapter jobs: their KV is delta-dependent."""
+        from paddle_operator_tpu.utils.radixkey import chain_key
+
+        bs = self.block_size
+        key = None
+        c = self.cache
+        for j in range(job.n // bs):
+            chunk = tuple(job.prompt[j * bs:(j + 1) * bs])
+            key = chain_key(key, chunk)
+            if self.prefix.get(key, chunk) is not None:
+                continue
+            blk = int(self.tables[lane][j])
+            if self.quant:
+                kb, vb, ksb, vsb = self._fetch_prog(
+                    c["k"], c["v"], c["ks"], c["vs"], blk)
+                payload = {"k": kb, "v": vb, "ks": ksb, "vs": vsb}
+            else:
+                kb, vb = self._fetch_prog(c["k"], c["v"], blk)
+                payload = {"k": kb, "v": vb}
+            for val in payload.values():
+                try:
+                    val.copy_to_host_async()
+                except AttributeError:
+                    pass
+            self.prefix.put(key, chunk, payload, lazy=True)
+
+    def _start_job(self, lane: int, req, slot: int) -> "_EngineJob":
+        hit = 0
+        if (self.prefix is not None
+                and not getattr(req, "adapter_idx", 0)):
+            try:
+                n_hit, payloads = self._prefix_walk(
+                    [int(t) for t in req.prompt])
+            except Exception:       # cache is an optimization only
+                n_hit, payloads = 0, []
+            if n_hit:
+                self._prefix_upload(lane, payloads)
+                hit = n_hit * self.block_size
+                self.prefix_hits += 1
+        return _EngineJob(req, slot, hit, hit)
+
+    def _lora_tail(self, active: Dict[int, "_EngineJob"]) -> tuple:
+        if self.adapters is None:
+            return ()
+        aid = np.zeros((self.lanes,), np.int32)
+        for lane, job in active.items():
+            aid[lane] = getattr(job.req, "adapter_idx", 0)
+        return (self.adapters.arrays(), jnp.asarray(aid))
+
+    def _width(self, rows_max: int) -> int:
+        """Table width (in blocks) for one batched dispatch:
+        smallest power-of-two block count covering the deepest
+        participating lane's attended rows, capped at the pool lane
+        width.  The gathered lane view — and with it the dense
+        attention score width — is the TABLE's width, so slicing the
+        table keeps slice work prompt-proportional (the 1-lane
+        ladder's property, which a fixed max_len-wide view would
+        forfeit: a 256-token job would attend max_len columns of
+        masked-out keys).  Power-of-two rounding bounds the compile
+        set at log2(max_blocks) shapes per program — jit
+        shape-specializes, and each shape is cheap to compile under
+        the whole-block write path."""
+        need = -(-rows_max // self.block_size)
+        w = 1
+        while w < need:
+            w *= 2
+        return min(w, self.max_blocks)
+
+    def _advance(self, active: Dict[int, "_EngineJob"],
+                 free: List[int]) -> None:
+        """One engine iteration: ONE batched intermediate slice for
+        every long job + ONE batched final slice for every finishing
+        job, then frame/terminal posts."""
+        sb = self.prefill_chunk
+        bs = self.block_size
+        nl = self.lanes
+        inter = [ln for ln, j in sorted(active.items())
+                 if j.n - j.start > sb]
+        fin = [ln for ln, j in sorted(active.items())
+               if j.n - j.start <= sb]
+        self._note_occ(len(active))
+        tail = self._lora_tail(active)
+        from paddle_operator_tpu.infer import paged as PG
+
+        if inter:
+            mw = self._width(max(active[ln].start + sb
+                                 for ln in inter))
+            toks = np.zeros((nl, sb), np.int32)
+            starts = np.zeros((nl,), np.int32)
+            limits = np.zeros((nl,), np.int32)
+            tables = np.full((nl, mw), PG.TRASH_BLOCK, np.int32)
+            mask = np.zeros((nl,), bool)
+            for ln in inter:
+                j = active[ln]
+                toks[ln] = j.prompt[j.start:j.start + sb]
+                starts[ln] = j.start
+                limits[ln] = j.start + sb
+                tables[ln] = self.tables[ln][:mw]
+                mask[ln] = True
+            self.cache = self._slice_prog(
+                self.params, self.cache, jnp.asarray(tables),
+                jnp.asarray(toks), jnp.asarray(starts),
+                jnp.asarray(limits), jnp.asarray(mask), *tail)
+            for ln in inter:
+                active[ln].start += sb
+        firsts = None
+        if fin:
+            mw = self._width(max(active[ln].start + sb
+                                 for ln in fin))
+            toks = np.zeros((nl, sb), np.int32)
+            starts = np.zeros((nl,), np.int32)
+            limits = np.zeros((nl,), np.int32)
+            n_rows = np.zeros((nl,), np.int32)
+            temps = np.zeros((nl,), np.float32)
+            seeds = np.zeros((nl,), np.int32)
+            tables = np.full((nl, mw), PG.TRASH_BLOCK, np.int32)
+            mask = np.zeros((nl,), bool)
+            for ln in fin:
+                j = active[ln]
+                rem = j.n - j.start
+                toks[ln, :rem] = j.prompt[j.start:]
+                starts[ln] = j.start
+                limits[ln] = j.n
+                n_rows[ln] = rem
+                temps[ln] = float(j.req.temperature)
+                seeds[ln] = int(j.req.seed)
+                tables[ln] = self.tables[ln][:mw]
+                mask[ln] = True
+            self.cache, firsts = self._final_prog(
+                self.params, self.cache, jnp.asarray(tables),
+                jnp.asarray(toks), jnp.asarray(n_rows),
+                jnp.asarray(starts), jnp.asarray(temps),
+                jnp.asarray(seeds), jnp.asarray(limits),
+                jnp.asarray(mask), *tail)
+            try:
+                firsts.copy_to_host_async()
+            except AttributeError:
+                pass
+            for ln in fin:
+                active[ln].start = active[ln].n
+        # streamed frames: post every lane's newly COMPLETED blocks
+        # (frames carry full blocks only; the moving write frontier
+        # crosses once, on the terminal item).  ONE snapshot after
+        # both dispatches serves every post — it pins the pool
+        # VERSION, and completed blocks never change after commit.
+        snap = (self._snapshot()
+                if fin or (self.stream and inter) else None)
+        if self.stream:
+            for ln in inter:
+                j = active[ln]
+                done = j.start // bs
+                if done > j.frames_done:
+                    self.results.put(("frame", j.req, j.slot, snap, ln,
+                                      j.frames_done, done))
+                    j.frames_done = done
+        for ln in fin:
+            j = active.pop(ln)
+            free.append(ln)
+            n_blocks = -(-j.n // bs)
+            first = firsts[ln]
+            try:
+                first.copy_to_host_async()
+            except AttributeError:
+                pass
+            self.results.put(("final", j.req, j.slot, snap, ln,
+                              j.frames_done, n_blocks, first,
+                              time.monotonic()))
+            if (self.prefix is not None
+                    and not getattr(j.req, "adapter_idx", 0)):
+                try:
+                    self._store_prefix(ln, j)
+                except Exception:
+                    pass            # cache is an optimization only
+        free.sort()
+
+    def _loop_engine(self) -> None:
+        from collections import deque
+
+        pending: "deque[tuple]" = deque()
+        active: Dict[int, _EngineJob] = {}
+        free = list(range(self.lanes))
+        # depth-2 dispatch pacing (the megastep double-buffer
+        # discipline): jax dispatch is async, so an unpaced loop would
+        # enqueue a long job's ENTIRE prefill ahead of a short prompt
+        # that arrives one host-tick later — the chunk-interleave HOL
+        # bound holds in DEVICE order only if host run-ahead is
+        # bounded.  Two iterations in flight keep the device busy
+        # while a late arrival waits at most ~2 slice quanta to reach
+        # the front of the queue.
+        fences: "deque[Any]" = deque()
+        while not self._stop.is_set():
+            if self.pause_gate is not None:
+                self.pause_gate()
+            # drain the submit queue; block briefly only when idle
+            try:
+                if not active and not pending:
+                    pending.append(self.jobs.get(timeout=0.05))
+                while True:
+                    pending.append(self.jobs.get_nowait())
+            except queue.Empty:
+                pass
+            # assign free lanes FIFO (lowest lane first — the batch
+            # index is the pool lane, determinism matters to tests)
+            while free and pending:
+                req, slot, t_enq = pending.popleft()
+                if req.done.is_set() or req._cancel:
+                    continue        # resolved while queued: drop
+                lane = free.pop(0)
+                try:
+                    self._note_wait(t_enq)
+                    active[lane] = self._start_job(lane, req, slot)
+                except Exception as e:  # noqa: BLE001
+                    self.results.put((req, slot, e))
+                    free.append(lane)
+                    free.sort()
+            if not active:
+                continue
+            try:
+                # the fence wait is INSIDE the batch-granular handler:
+                # jax dispatch is async, so a device-side failure in a
+                # prior slice/final dispatch surfaces HERE, not in
+                # _advance — an uncaught one would kill this thread
+                # and wedge every queued prefill
+                while len(fences) >= 2:
+                    fence = fences.popleft()
+                    try:
+                        fence.block_until_ready()
+                    except AttributeError:
+                        pass
+                self._advance(active, free)
+                fences.append(self.cache["k"])
+            except Exception as e:      # noqa: BLE001 — batch-granular
+                # one fused dispatch served every active job: fail all
+                # of them (their clients retry); lanes free clean, and
+                # stale fences drop so the failed dispatch cannot
+                # re-raise at the next wait
+                fences.clear()
+                for lane, job in list(active.items()):
+                    self.results.put((job.req, job.slot, e))
+                    free.append(lane)
+                active.clear()
+                free.sort()
 
     def close(self) -> None:
         self._stop.set()
@@ -1054,7 +1673,10 @@ class RingExecutor:
                  host_cache_blocks: int = 0,
                  adapters=None,
                  megastep: int = 1,
-                 prefill_client=None) -> None:
+                 prefill_client=None,
+                 prefill_lanes: int = 1,
+                 prefill_stream: bool = False,
+                 prefill_prefix_blocks: int = 0) -> None:
         # many-adapter serving (ISSUE 10, infer/qos.py AdapterRegistry):
         # stacked LoRA deltas served off the one base param set.  The
         # registry's arrays ride every dispatch as trailing operands
@@ -1224,6 +1846,10 @@ class RingExecutor:
         # whole-prompt compiles.
         self.prefill_exec: Optional[Any] = None
         self.prefill_remote = False
+        self.prefill_lanes = max(1, int(prefill_lanes))
+        self.prefill_stream = bool(prefill_stream)
+        self._frame_transfer = None
+        self._tail_copy = None
         if prefill_mode == "disagg":
             if not self.paged:
                 raise ValueError("prefill_mode='disagg' requires the "
@@ -1237,9 +1863,24 @@ class RingExecutor:
                     self.params, cfg, max_len=max_len,
                     block_size=self.block_size, buckets=self.buckets,
                     top_k=top_k, top_p=top_p, mesh=mesh,
-                    kv_quant=self.kv_quant, adapters=adapters)
-                self._transfer = self._pg.make_pool_transfer(
-                    self.pool.max_blocks, quant=self.quant)
+                    kv_quant=self.kv_quant, adapters=adapters,
+                    lanes=self.prefill_lanes,
+                    prefill_chunk=self.prefill_chunk,
+                    stream=self.prefill_stream,
+                    prefix_blocks=int(prefill_prefix_blocks))
+                if self.prefill_lanes > 1:
+                    # N-lane engine handoffs land frame-wise: block
+                    # groups via the frame transfer, the (quant)
+                    # staging tail once via the lane-addressed copy —
+                    # the 1-lane monolithic path keeps the fused
+                    # make_pool_transfer (the oracle trace, untouched)
+                    self._frame_transfer = self._pg.make_pool_frame_transfer(
+                        self.pool.max_blocks, quant=self.quant)
+                    if self.quant:
+                        self._tail_copy = self._pg.make_pool_tail_copy()
+                else:
+                    self._transfer = self._pg.make_pool_transfer(
+                        self.pool.max_blocks, quant=self.quant)
 
         self.reset_state()
 
@@ -1855,12 +2496,12 @@ class RingExecutor:
                     del out
                     pad *= 2
         if self.prefill_exec is not None and not self.prefill_remote:
-            # the disagg engine's whole-prompt programs compile on the
-            # PREFILL thread (they never stall decode), but the first
-            # cold prompt would still pay them in its TTFT — run each
-            # bucket against the executor's own pool (no donation, and
-            # pool content only matters mid-job, so racing a live job
-            # is safe); the handoff transfer + attach ride along.
+            # the disagg engine's programs compile on the PREFILL
+            # thread (they never stall decode), but the first cold
+            # prompt would still pay them in its TTFT — run each
+            # against the executor's own pool (no donation, and pool
+            # content only matters mid-job, so racing a live job is
+            # safe); the handoff transfer + attach ride along.
             # (Remote rings skip this: their whole-prompt programs
             # live — and prewarm — in the prefill pods.)
             pe = self.prefill_exec
@@ -1869,7 +2510,50 @@ class RingExecutor:
                      jnp.zeros((1, b), jnp.int32), 1, 0.0, 0, *it)
             m = self.pool.max_blocks
             ids = jnp.zeros((m,), jnp.int32)
-            if self.quant:
+            if pe.lanes > 1:
+                # the N-lane engine's batched slice/final programs —
+                # one compile PER table-width ladder rung (_width's
+                # power-of-two set: dispatches pass only as many
+                # blocks as the deepest active job needs, and jit
+                # shape-specializes) — plus the frame-wise handoff
+                # ops (ISSUE 14)
+                nl, sb = pe.lanes, pe.prefill_chunk
+                z = lambda *s: jnp.zeros(s, jnp.int32)   # noqa: E731
+                ptail = (pe.adapters.arrays(),
+                         z(nl)) if pe.adapters is not None else ()
+                mask = jnp.zeros((nl,), bool)
+                w = 1
+                while True:
+                    mw = min(w, pe.max_blocks)
+                    pe._slice_prog(self.params, pe.cache,
+                                   z(nl, mw), z(nl, sb), z(nl),
+                                   z(nl), mask, *ptail)
+                    pe._final_prog(self.params, pe.cache,
+                                   z(nl, mw), z(nl, sb),
+                                   jnp.ones((nl,), jnp.int32), z(nl),
+                                   jnp.zeros((nl,), jnp.float32),
+                                   z(nl), z(nl), mask, *ptail)
+                    if w >= pe.max_blocks:
+                        break
+                    w *= 2
+                if self.quant:
+                    self._frame_transfer(
+                        jnp.zeros_like(cache["k"]),
+                        jnp.zeros_like(cache["v"]),
+                        jnp.zeros_like(cache["ks"]),
+                        jnp.zeros_like(cache["vs"]),
+                        pe.cache["k"], pe.cache["v"],
+                        pe.cache["ks"], pe.cache["vs"], ids, ids)
+                    self._tail_copy(jnp.zeros_like(cache["kt"]),
+                                    jnp.zeros_like(cache["vt"]),
+                                    pe.cache["kt"], pe.cache["vt"],
+                                    0, 0)
+                else:
+                    self._frame_transfer(jnp.zeros_like(cache["k"]),
+                                         jnp.zeros_like(cache["v"]),
+                                         pe.cache["k"], pe.cache["v"],
+                                         ids, ids)
+            elif self.quant:
                 self._transfer(jnp.zeros_like(cache["k"]),
                                jnp.zeros_like(cache["v"]),
                                jnp.zeros_like(cache["ks"]),

@@ -1021,6 +1021,40 @@ def _write_rows_paged(pool: jax.Array, kv: jax.Array, li: jax.Array,
     return pool
 
 
+def _write_blocks_paged(pool: jax.Array, kv: jax.Array, li: jax.Array,
+                        table: jax.Array, pos: jax.Array,
+                        block_size: int,
+                        limit: Optional[jax.Array] = None) -> jax.Array:
+    """:func:`_write_rows_paged` for the BLOCK-ALIGNED case (the
+    N-lane prefill engine's slice programs, ISSUE 14): ``pos`` is a
+    block multiple and ``t`` a multiple of ``block_size`` — both
+    guaranteed statically by the caller — so the slab lands as
+    whole-block writes, O(lanes x blocks) dynamic_update_slice ops
+    instead of the per-row unroll's O(lanes x rows).  At production
+    slice widths the per-row trace is pathological to COMPILE (the
+    ops sit inside the layer scan's body), not just slow to run.
+
+    Padding follows :func:`ops.decode_attention.scatter_prefill_blocks`
+    — the exactness-with-padding contract, block-granular: a block
+    whose FIRST row is real writes whole (pad rows past ``limit`` land
+    in the lane's real block, never attendable — masked in-slice,
+    overwritten by decode before its reads); a block entirely past
+    ``limit`` routes to the trash block."""
+    b, _, t, _ = kv.shape
+    for lane in range(b):
+        for jb in range(t // block_size):
+            p0 = pos[lane] + jb * block_size
+            blk = table[lane, p0 // block_size]
+            if limit is not None:
+                blk = jnp.where(p0 < limit[lane], blk, TRASH_BLOCK)
+            pool = jax.lax.dynamic_update_slice(
+                pool,
+                kv[lane, :, jb * block_size:(jb + 1) * block_size][
+                    None, None],
+                (li, blk, 0, 0, 0))
+    return pool
+
+
 def _write_token_quant(pool: jax.Array, scales: jax.Array,
                        tail: jax.Array, kv: jax.Array, li: jax.Array,
                        table: jax.Array, pos: jax.Array,
@@ -1868,6 +1902,67 @@ def make_pool_transfer(max_blocks: int, quant: bool = False):
     return jax.jit(transfer, donate_argnums=(0, 1))
 
 
+@functools.lru_cache(maxsize=8)
+def make_pool_frame_transfer(max_blocks: int, quant: bool = False):
+    """One streamed-handoff FRAME's device-to-device copy (ISSUE 14):
+    like :func:`make_pool_transfer` but blocks only — no staging tail
+    and no lane addressing — because intermediate frames carry only
+    COMPLETE block groups (the tail is by definition the still-moving
+    write frontier, and it crosses exactly once, on the terminal
+    frame via :func:`make_pool_tail_copy`).  Id vectors pad with the
+    trash block as everywhere else, so ONE compile serves every frame
+    width.
+
+    ``transfer(dst_k, dst_v[, dst_ks, dst_vs], src_k, src_v[, src_ks,
+    src_vs], src_ids [M], dst_ids [M]) -> dst arrays``"""
+
+    def transfer(dst_k, dst_v, src_k, src_v, src_ids, dst_ids):
+        return (dst_k.at[:, dst_ids].set(jnp.take(src_k, src_ids,
+                                                  axis=1)),
+                dst_v.at[:, dst_ids].set(jnp.take(src_v, src_ids,
+                                                  axis=1)))
+
+    def transfer_quant(dst_k, dst_v, dst_ks, dst_vs, src_k, src_v,
+                       src_ks, src_vs, src_ids, dst_ids):
+        dst_k, dst_v = transfer(dst_k, dst_v, src_k, src_v, src_ids,
+                                dst_ids)
+        return (dst_k, dst_v,
+                dst_ks.at[:, dst_ids].set(jnp.take(src_ks, src_ids,
+                                                   axis=1)),
+                dst_vs.at[:, dst_ids].set(jnp.take(src_vs, src_ids,
+                                                   axis=1)))
+
+    if quant:
+        return jax.jit(transfer_quant, donate_argnums=(0, 1, 2, 3))
+    return jax.jit(transfer, donate_argnums=(0, 1))
+
+
+@functools.lru_cache(maxsize=2)
+def make_pool_tail_copy():
+    """The terminal streamed-handoff's staging-tail copy (int8 pools
+    only): src tail row ``src_row`` — the prefill ENGINE lane that ran
+    the job, now that the pool is N lanes wide (ISSUE 14) — lands in
+    decode tail row ``slot``.  The 1-lane monolithic path keeps the
+    fused tail copy inside :func:`make_pool_transfer`; this exists for
+    the multi-lane engine whose tail row is job-dependent.
+
+    ``cp(dst_kt, dst_vt, src_kt, src_vt, src_row, slot)
+    -> (dst_kt', dst_vt')``"""
+
+    def cp(dst_kt, dst_vt, src_kt, src_vt, src_row, slot):
+        lcount, _, h, bs, d = src_kt.shape
+        kt = jax.lax.dynamic_slice(src_kt, (0, src_row, 0, 0, 0),
+                                   (lcount, 1, h, bs, d))
+        vt = jax.lax.dynamic_slice(src_vt, (0, src_row, 0, 0, 0),
+                                   (lcount, 1, h, bs, d))
+        return (jax.lax.dynamic_update_slice(dst_kt, kt,
+                                             (0, slot, 0, 0, 0)),
+                jax.lax.dynamic_update_slice(dst_vt, vt,
+                                             (0, slot, 0, 0, 0)))
+
+    return jax.jit(cp, donate_argnums=(0, 1))
+
+
 @functools.lru_cache(maxsize=4)
 def make_block_fetch(quant: bool = False):
     """The DEMOTE read: slice ONE pool block's exact device bytes (all
@@ -1895,8 +1990,9 @@ def make_block_fetch(quant: bool = False):
     return jax.jit(fetch_quant if quant else fetch)
 
 
-@functools.lru_cache(maxsize=4)
-def make_promote_blocks(block_size: int, quant: bool = False):
+@functools.lru_cache(maxsize=8)
+def make_promote_blocks(block_size: int, quant: bool = False,
+                        donate: bool = True):
     """The PROMOTE upload: scatter a batch of host payloads into their
     reserved pool blocks in ONE donated jit — the bf16 path is exactly
     the whole-block ``scatter_prefill_blocks`` write the prefill path
@@ -1912,7 +2008,13 @@ def make_promote_blocks(block_size: int, quant: bool = False):
     ``up(pool_k, pool_v, rows_k, rows_v, ids) -> (pool_k', pool_v')``;
     quant: ``up(pool_k, pool_v, ks, vs, rows_k, rows_v, srow_k,
     srow_v, ids) -> (pool_k', pool_v', ks', vs')`` with ``srow_*``
-    [L, n, H] scale rows."""
+    [L, n, H] scale rows.
+
+    ``donate=False`` (ISSUE 14): the multi-lane prefill engine's
+    prefix-hit upload — its streamed-handoff frames hold version
+    snapshots of the SAME pool arrays, and donating a buffer a posted
+    frame still references would delete it under the decode side's
+    transfer."""
     from paddle_operator_tpu.ops.decode_attention import (
         scatter_prefill_blocks,
         scatter_promote_blocks_quant,
@@ -1932,8 +2034,9 @@ def make_promote_blocks(block_size: int, quant: bool = False):
         return pool_k, pool_v, ks, vs
 
     if quant:
-        return jax.jit(up_quant, donate_argnums=(0, 1, 2, 3))
-    return jax.jit(up, donate_argnums=(0, 1))
+        return jax.jit(up_quant,
+                       donate_argnums=(0, 1, 2, 3) if donate else ())
+    return jax.jit(up, donate_argnums=(0, 1) if donate else ())
 
 
 @functools.lru_cache(maxsize=4)

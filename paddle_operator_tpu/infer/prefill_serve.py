@@ -77,14 +77,18 @@ def handoff_fingerprint(cfg, *, block_size: int, kv_quant: str,
 class _Job:
     """The request shim the PrefillExecutor thread reads (it only
     touches prompt/dev_prompt/temperature/seed/adapter_idx and the
-    done/_cancel lifecycle flags)."""
+    done/_cancel lifecycle flags).  ``wants_frames`` (ISSUE 14
+    streamed handoff): the matcher routes the engine's block-group
+    frame items into ``frames`` for the chunked HTTP response;
+    without it frames are dropped and only the terminal result
+    lands."""
 
     __slots__ = ("prompt", "temperature", "seed", "adapter_idx",
                  "done", "_cancel", "dev_prompt", "result", "error",
-                 "t0", "accounted")
+                 "t0", "accounted", "wants_frames", "frames")
 
     def __init__(self, prompt: Sequence[int], temperature: float,
-                 seed: int) -> None:
+                 seed: int, wants_frames: bool = False) -> None:
         import jax.numpy as jnp
 
         self.prompt = [int(t) for t in prompt]
@@ -95,7 +99,7 @@ class _Job:
         self._cancel = False
         self.dev_prompt = jnp.asarray(
             np.asarray(self.prompt, np.int32)[None, :])
-        self.result: Optional[Tuple[Any, int, int]] = None
+        self.result: Optional[Tuple[Any, ...]] = None
         self.error: Optional[Exception] = None
         self.t0 = time.monotonic()
         # exactly-once depth accounting (under the frontend lock): a
@@ -103,6 +107,9 @@ class _Job:
         # (no result ever posted) or may still finish and post one —
         # whichever side settles first decrements, the other skips
         self.accounted = False
+        self.wants_frames = bool(wants_frames)
+        self.frames: Optional["queue.Queue[tuple]"] = (
+            queue.Queue() if wants_frames else None)
 
 
 class PrefillFrontend:
@@ -116,7 +123,9 @@ class PrefillFrontend:
                  max_len: int, buckets: Tuple[int, ...] = (),
                  top_k: Optional[int] = None,
                  top_p: Optional[float] = None, mesh=None,
-                 kv_quant: str = "none") -> None:
+                 kv_quant: str = "none", lanes: int = 1,
+                 prefill_chunk: int = 64,
+                 prefix_blocks: int = 0) -> None:
         from paddle_operator_tpu.infer import decode as D
         from paddle_operator_tpu.infer import executor as X
 
@@ -127,10 +136,16 @@ class PrefillFrontend:
         self.kv_quant = kv_quant
         self.quant = kv_quant == "int8"
         self.top_k, self.top_p = top_k, top_p
+        self.lanes = max(1, int(lanes))
+        # the N-lane engine always produces frame items (streaming
+        # clients consume them; the matcher drops them for jobs that
+        # did not ask) — the 1-lane oracle engine never does
         self.exec = X.PrefillExecutor(
             params, cfg, max_len=max_len, block_size=self.block_size,
             buckets=tuple(buckets) or (max_len,), top_k=top_k,
-            top_p=top_p, mesh=mesh, kv_quant=kv_quant)
+            top_p=top_p, mesh=mesh, kv_quant=kv_quant,
+            lanes=self.lanes, prefill_chunk=prefill_chunk,
+            stream=self.lanes > 1, prefix_blocks=prefix_blocks)
         self.draining = False
         self._lock = threading.Lock()
         self._depth = 0
@@ -162,21 +177,90 @@ class PrefillFrontend:
                 item = results.get(timeout=0.05)
             except queue.Empty:
                 continue
+            if isinstance(item[0], str):
+                # N-lane engine protocol (ISSUE 14): frames route to
+                # streaming jobs; the terminal item completes the job
+                kind = item[0]
+                job = item[1]
+                if kind == "frame":
+                    if job.wants_frames and not job.done.is_set():
+                        job.frames.put(item)
+                    continue
+                # ("final", job, slot, snap, lane, j0, n_blocks,
+                #  first, t_done)
+                job.result = (item[3], item[4], item[5], item[6],
+                              int(np.asarray(item[7])), item[8])
+                if job.wants_frames:
+                    job.frames.put(item)
+                self._settle(job)
+                continue
             job = item[0]
             if len(item) == 3:
                 job.error = item[2]
+                if job.wants_frames:
+                    job.frames.put(("error", job, item[2]))
             else:
                 _, _, snap, n_blocks, first = item
-                job.result = (snap, n_blocks, int(np.asarray(first)))
-            ms = (time.monotonic() - job.t0) * 1e3
-            with self._lock:
-                if not job.accounted:
-                    job.accounted = True
-                    self._depth -= 1
-                    self.prefill_ms_avg = (
-                        ms if not self.prefill_ms_avg
-                        else 0.8 * self.prefill_ms_avg + 0.2 * ms)
-            job.done.set()
+                job.result = (snap, None, 0, n_blocks,
+                              int(np.asarray(first)), time.monotonic())
+            self._settle(job)
+
+    def _settle(self, job: "_Job") -> None:
+        ms = (time.monotonic() - job.t0) * 1e3
+        with self._lock:
+            if not job.accounted:
+                job.accounted = True
+                self._depth -= 1
+                self.prefill_ms_avg = (
+                    ms if not self.prefill_ms_avg
+                    else 0.8 * self.prefill_ms_avg + 0.2 * ms)
+        job.done.set()
+
+    def _block_ids(self, lane: Optional[int], j0: int,
+                   j1: int) -> np.ndarray:
+        """Pool block ids backing a job's blocks [j0, j1): the 1-lane
+        engine's fixed identity rows 1..M, or lane ``lane``'s identity
+        rows on the N-lane engine."""
+        if lane is None:
+            return np.arange(1 + j0, 1 + j1)
+        return self.exec.tables[lane][j0:j1]
+
+    def _host_blocks(self, snap, lane: Optional[int], j0: int,
+                     j1: int) -> Dict[str, np.ndarray]:
+        """Snapshot -> host bytes for blocks [j0, j1).  jax arrays are
+        immutable, so this read races nothing even while the engine
+        writes fresh pool versions."""
+        ids = self._block_ids(lane, j0, j1)
+        arrays: Dict[str, np.ndarray] = {
+            "k": np.asarray(snap["k"])[:, ids],
+            "v": np.asarray(snap["v"])[:, ids],
+        }
+        if self.quant:
+            arrays["ks"] = np.asarray(snap["ks"])[:, ids]
+            arrays["vs"] = np.asarray(snap["vs"])[:, ids]
+        return arrays
+
+    def _submit(self, tokens: Sequence[int], temperature: float,
+                seed: int, wants_frames: bool = False) -> "_Job":
+        job = _Job(tokens, temperature, seed,
+                   wants_frames=wants_frames)
+        with self._lock:
+            self._depth += 1
+        self.exec.submit(job, 0)
+        return job
+
+    def _timeout(self, job: "_Job", timeout: float) -> None:
+        job._cancel = True      # dropped at the executor if queued
+        # a QUEUED cancelled job never posts a result, so the
+        # matcher never sees it — settle the depth here (the
+        # ``accounted`` flag keeps a mid-flight job that still
+        # finishes from decrementing twice)
+        with self._lock:
+            if not job.accounted:
+                job.accounted = True
+                self._depth -= 1
+        raise TimeoutError(
+            f"prefill did not finish within {timeout}s")
 
     def prefill(self, tokens: Sequence[int], temperature: float,
                 seed: int,
@@ -187,43 +271,22 @@ class PrefillFrontend:
         fails (or retries) that one request."""
         from paddle_operator_tpu.utils import fleetkv as FK
 
-        job = _Job(tokens, temperature, seed)
-        with self._lock:
-            self._depth += 1
-        self.exec.submit(job, 0)
+        job = self._submit(tokens, temperature, seed)
         if not job.done.wait(timeout):
-            job._cancel = True      # dropped at the executor if queued
-            # a QUEUED cancelled job never posts a result, so the
-            # matcher never sees it — settle the depth here (the
-            # ``accounted`` flag keeps a mid-flight job that still
-            # finishes from decrementing twice)
-            with self._lock:
-                if not job.accounted:
-                    job.accounted = True
-                    self._depth -= 1
-            raise TimeoutError(
-                f"prefill did not finish within {timeout}s")
+            self._timeout(job, timeout)
         if job.error is not None:
             with self._lock:
                 self.stats["errors"] += 1
             raise job.error
-        snap, n_blocks, first = job.result
-        # snapshot -> host bytes: the executor's pool rows 1..n are the
-        # job's FIXED identity blocks (block 0 is its trash block);
-        # jax arrays are immutable, so this read races nothing even
-        # while the next job writes a fresh pool version
-        arrays: Dict[str, np.ndarray] = {
-            "k": np.asarray(snap["k"])[:, 1:n_blocks + 1],
-            "v": np.asarray(snap["v"])[:, 1:n_blocks + 1],
-        }
+        snap, lane, _, n_blocks, first, _ = job.result
+        arrays = self._host_blocks(snap, lane, 0, n_blocks)
         if self.quant:
-            arrays["ks"] = np.asarray(snap["ks"])[:, 1:n_blocks + 1]
-            arrays["vs"] = np.asarray(snap["vs"])[:, 1:n_blocks + 1]
             # the prompt's partial last block lives EXACT in the
-            # executor pool's one staging-tail row — it lands in the
-            # decode tail row ``slot`` at attach
-            arrays["kt"] = np.asarray(snap["kt"])[:, 0:1]
-            arrays["vt"] = np.asarray(snap["vt"])[:, 0:1]
+            # engine lane's staging-tail row — it lands in the decode
+            # tail row ``slot`` at attach
+            trow = 0 if lane is None else lane
+            arrays["kt"] = np.asarray(snap["kt"])[:, trow:trow + 1]
+            arrays["vt"] = np.asarray(snap["vt"])[:, trow:trow + 1]
         with self._lock:
             self.stats["jobs"] += 1
             self.stats["prompt_tokens"] += len(job.prompt)
@@ -231,6 +294,79 @@ class PrefillFrontend:
                 "nBlocks": int(n_blocks),
                 "fingerprint": self.fingerprint()}
         return FK.encode_handoff(meta, arrays)
+
+    def prefill_stream(self, tokens: Sequence[int], temperature: float,
+                       seed: int, timeout: float = PREFILL_TIMEOUT_S):
+        """STREAMED prefill (ISSUE 14): yield length-prefixed wire
+        frames — completed block groups as they finish, then the
+        terminal frame (remaining blocks + staging tail + first token
+        + fingerprint) — so the decode side's upload and the wire
+        transfer overlap the remaining prefill compute.  Raises
+        TimeoutError/executor errors BEFORE the first yield (mapped to
+        HTTP statuses); after the first frame the handler can only
+        drop the connection, which the client refuses wholesale."""
+        from paddle_operator_tpu.utils import fleetkv as FK
+
+        job = self._submit(tokens, temperature, seed,
+                           wants_frames=self.lanes > 1)
+        if job.frames is None:
+            # 1-lane oracle engine: no frames exist — one terminal
+            # frame carries the whole handoff (a valid 1-frame stream)
+            buf = None
+            if not job.done.wait(timeout):
+                self._timeout(job, timeout)
+            if job.error is not None:
+                with self._lock:
+                    self.stats["errors"] += 1
+                raise job.error
+            snap, lane, _, n_blocks, first, t_done = job.result
+            arrays = self._host_blocks(snap, lane, 0, n_blocks)
+            if self.quant:
+                arrays["kt"] = np.asarray(snap["kt"])[:, 0:1]
+                arrays["vt"] = np.asarray(snap["vt"])[:, 0:1]
+            with self._lock:
+                self.stats["jobs"] += 1
+                self.stats["prompt_tokens"] += len(job.prompt)
+            yield FK.encode_handoff_final(
+                {"seq": 0, "nFrames": 1, "j0": 0, "first": first,
+                 "promptLen": len(job.prompt), "nBlocks": int(n_blocks),
+                 "fingerprint": self.fingerprint(),
+                 "tDone": t_done}, arrays)
+            return
+        deadline = time.monotonic() + timeout
+        seq = 0
+        while True:
+            try:
+                item = job.frames.get(
+                    timeout=max(0.0, deadline - time.monotonic()))
+            except queue.Empty:
+                self._timeout(job, timeout)
+            if item[0] == "error":
+                with self._lock:
+                    self.stats["errors"] += 1
+                raise item[2]
+            if item[0] == "frame":
+                _, _, _, snap, lane, j0, j1 = item
+                yield FK.encode_handoff_frame(
+                    seq, j0, self._host_blocks(snap, lane, j0, j1))
+                seq += 1
+                continue
+            # terminal
+            snap, lane, j0, n_blocks, first, t_done = job.result
+            arrays = self._host_blocks(snap, lane, j0, n_blocks)
+            if self.quant:
+                arrays["kt"] = np.asarray(snap["kt"])[:, lane:lane + 1]
+                arrays["vt"] = np.asarray(snap["vt"])[:, lane:lane + 1]
+            with self._lock:
+                self.stats["jobs"] += 1
+                self.stats["prompt_tokens"] += len(job.prompt)
+            yield FK.encode_handoff_final(
+                {"seq": seq, "nFrames": seq + 1, "j0": int(j0),
+                 "first": int(first), "promptLen": len(job.prompt),
+                 "nBlocks": int(n_blocks),
+                 "fingerprint": self.fingerprint(),
+                 "tDone": float(t_done)}, arrays)
+            return
 
     def serving_status(self) -> Dict[str, Any]:
         """The prefill pod's status block.  ``role: "prefill"`` is the
@@ -250,6 +386,15 @@ class PrefillFrontend:
                 "prefillJobs": self.stats["jobs"],
                 "prefillErrors": self.stats["errors"],
                 "refusedHandoffs": self.stats["refused"],
+                # prefill-pool throughput (ISSUE 14): engine width,
+                # batch occupancy EMA (busy lanes / N per iteration)
+                # and head-of-line wait p95 — what the SLO autoscaler
+                # divides by so a half-empty batch never reads as a
+                # saturated pool
+                "prefillLanes": self.lanes,
+                "prefillBatchOccupancy": self.exec.batch_occupancy(),
+                "prefillHolWaitMs": self.exec.hol_wait_ms_p95(),
+                "prefillPrefixHits": self.exec.prefix_hits,
                 "draining": self.draining,
             }
 
@@ -270,6 +415,15 @@ class PrefillFrontend:
             f'{float(st["prefillJobs"])}',
             f'tpujob_serve_tokens_per_sec{lbl} '
             f'{float(st["tokensPerSec"])}',
+            # prefill-pool throughput gauges (ISSUE 14) — the router
+            # scrapes these into /statusz and the autoscaler's prefill
+            # denominator reads occupancy + lanes
+            f'tpujob_serve_prefill_lanes{lbl} '
+            f'{float(st["prefillLanes"])}',
+            f'tpujob_serve_prefill_batch_occupancy{lbl} '
+            f'{float(st["prefillBatchOccupancy"])}',
+            f'tpujob_serve_prefill_hol_wait_ms{lbl} '
+            f'{float(st["prefillHolWaitMs"])}',
             f'tpujob_serve_draining{lbl} '
             f'{1.0 if st["draining"] else 0.0}',
         ]
@@ -366,6 +520,8 @@ class _PrefillHandler(BaseHTTPRequestHandler):
                 json.JSONDecodeError) as e:
             self._send_json(400, {"error": str(e)})
             return
+        if req.get("stream"):
+            return self._stream_prefill(fe, req, tokens)
         try:
             buf = fe.prefill(tokens,
                              float(req.get("temperature", 0.0)),
@@ -389,6 +545,58 @@ class _PrefillHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(buf)
 
+    def _stream_prefill(self, fe, req, tokens) -> None:
+        """``"stream": true`` (ISSUE 14): chunked transfer of
+        length-prefixed handoff frames as block groups complete — the
+        decode side uploads each frame while this pod still computes
+        the rest of the prompt.  Errors BEFORE the first frame map to
+        HTTP statuses exactly like the monolithic path; after it the
+        only honest signal is dropping the connection, which the
+        receiver refuses wholesale (per-frame CRC + the terminal
+        frame's count make any partial stream unusable by
+        construction)."""
+        gen = fe.prefill_stream(tokens,
+                                float(req.get("temperature", 0.0)),
+                                int(req.get("seed", 0)))
+        try:
+            first_frame = next(gen)
+        except TimeoutError as e:
+            self._send_json(503, {"error": str(e)},
+                            headers={"Retry-After": 2})
+            return
+        except StopIteration:
+            self._send_json(500, {"error": "empty handoff stream"})
+            return
+        except Exception as e:      # noqa: BLE001
+            self._send_json(500, {"error": str(e)})
+            return
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "application/octet-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def emit(wire: bytes) -> None:
+                self.wfile.write(f"{len(wire):x}\r\n".encode() + wire
+                                 + b"\r\n")
+                self.wfile.flush()
+
+            emit(first_frame)
+            for wire in gen:
+                emit(wire)
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            return      # client gone mid-stream: nothing to say
+        except Exception:   # noqa: BLE001 — engine died mid-stream
+            # drop the connection: the receiver sees a truncated
+            # frame and refuses the whole stream
+            try:
+                self.wfile.flush()
+            except OSError:
+                pass
+            self.close_connection = True
+
 
 def make_prefill_server(host: str, port: int, params: Any, cfg, *,
                         block_size: int = 256,
@@ -397,13 +605,17 @@ def make_prefill_server(host: str, port: int, params: Any, cfg, *,
                         top_k: Optional[int] = None,
                         top_p: Optional[float] = None, mesh=None,
                         kv_quant: str = "none", job: str = "local",
-                        replica: str = "") -> ThreadingHTTPServer:
+                        replica: str = "", lanes: int = 1,
+                        prefill_chunk: int = 64,
+                        prefix_blocks: int = 0) -> ThreadingHTTPServer:
     """HTTP shell around a PrefillFrontend.  The returned server
     carries ``.frontend`` — close it when tearing down."""
     fe = PrefillFrontend(params, cfg, block_size=block_size,
                          max_len=max_len or cfg.max_seq_len,
                          buckets=buckets, top_k=top_k, top_p=top_p,
-                         mesh=mesh, kv_quant=kv_quant)
+                         mesh=mesh, kv_quant=kv_quant, lanes=lanes,
+                         prefill_chunk=prefill_chunk,
+                         prefix_blocks=prefix_blocks)
     handler = type("PrefillHandler", (_PrefillHandler,),
                    {"frontend": fe, "job_key": job,
                     "replica_id": replica})
@@ -439,7 +651,8 @@ class RemotePrefillClient:
     def __init__(self, broker: str = "", peers: Sequence[str] = (), *,
                  timeout: float = PREFILL_TIMEOUT_S, workers: int = 2,
                  max_attempts: int = 4,
-                 backoff_s: float = 0.2) -> None:
+                 backoff_s: float = 0.2,
+                 stream: bool = False) -> None:
         self.broker = broker.strip().rstrip("/")
         self.peers = [p.strip() for p in peers if p.strip()]
         if not self.broker and not self.peers:
@@ -447,12 +660,20 @@ class RemotePrefillClient:
         self.timeout = timeout
         self.max_attempts = max_attempts
         self.backoff_s = backoff_s
+        # streamed handoff (ISSUE 14): frames post to the scheduler as
+        # they arrive off the wire, so the promote upload overlaps the
+        # pod's remaining prefill compute AND the DCN transfer
+        self.stream = bool(stream)
         # the ring's handoff fingerprint — stamped by the scheduler at
         # construction (it owns cfg/block_size/quant/top-k/top-p)
         self.fingerprint: Optional[Dict[str, Any]] = None
         self.jobs: "queue.Queue[tuple]" = queue.Queue()
         self.results: "queue.Queue[tuple]" = queue.Queue()
-        self.stats = {"posted": 0, "retries": 0, "failed": 0}
+        self.stats = {"posted": 0, "retries": 0, "failed": 0,
+                      # streams refused WHOLESALE: mid-stream pod
+                      # death, truncated / CRC-bad / out-of-order
+                      # frames (each walked to the next candidate)
+                      "refused_streams": 0}
         self._stop = threading.Event()
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
@@ -487,6 +708,7 @@ class RemotePrefillClient:
                 "seed": int(req.seed),
                 "requestId": getattr(req, "request_id", None),
                 "fingerprint": self.fingerprint,
+                "stream": self.stream,
             }).encode()
             outcome = None
             for i, ep in enumerate(self._targets()):
@@ -495,6 +717,12 @@ class RemotePrefillClient:
                 if i:
                     self.stats["retries"] += 1
                     time.sleep(min(self.backoff_s * i, 1.0))
+                if self.stream:
+                    res = self._stream_attempt(ep, body, req, slot)
+                    if res == "next":
+                        continue
+                    outcome = res
+                    break
                 try:
                     code, raw = FK.http_post(
                         ep, "/v1/prefill", body,
@@ -523,12 +751,91 @@ class RemotePrefillClient:
                 outcome = (req, slot, arrays, int(meta["nBlocks"]),
                            int(meta["first"]))
                 break
+            if outcome == "done":
+                continue    # streamed final already posted
             if outcome is None:
                 self.stats["failed"] += 1
                 outcome = (req, slot, RetriableError(
                     "no prefill pod accepted the handoff "
                     f"({self.max_attempts} attempts); retry"))
             self.results.put(outcome)
+
+    def _stream_attempt(self, ep: str, body: bytes, req, slot: int):
+        """One STREAMED prefill attempt against ``ep``: frames post to
+        the scheduler AS THEY ARRIVE (the decode upload overlaps both
+        the wire and the pod's remaining compute); the terminal frame
+        posts the remainder + first token.  Returns ``"done"`` (final
+        posted), ``"next"`` (retry another candidate — 503, connection
+        failure, mid-stream death, or a truncated/CRC-bad/out-of-order
+        frame, all refused WHOLESALE; prefill is side-effect-free and
+        already-uploaded frames are idempotently overwritten by the
+        retry), or a terminal error outcome tuple (deterministic
+        rejection)."""
+        import json as _json
+
+        from http.client import HTTPConnection, HTTPException
+
+        from paddle_operator_tpu.utils import fleetkv as FK
+
+        host, _, port = ep.rpartition(":")
+        conn = HTTPConnection(host, int(port), timeout=self.timeout)
+        streaming = False       # past the 200: failures = broken stream
+        try:
+            # Connection: close — one stream per connection, and the
+            # server tears it down cleanly after the terminal frame
+            # (a lingering keep-alive would just log a reset when
+            # this side closes)
+            conn.request("POST", "/v1/prefill", body=body,
+                         headers={"Content-Type": "application/json",
+                                  "Connection": "close"})
+            resp = conn.getresponse()
+            if resp.status == 503:
+                resp.read()
+                return "next"       # draining / backlogged pod
+            if resp.status != 200:
+                raw = resp.read()
+                try:
+                    msg = _json.loads(raw).get("error", raw[:120])
+                except Exception:   # noqa: BLE001
+                    msg = raw[:120]
+                return (req, slot, RuntimeError(
+                    f"remote prefill rejected ({resp.status}): {msg}"))
+            streaming = True
+            seq = 0
+            while True:
+                buf = FK.read_wire_frame(resp.read)
+                if buf is None:
+                    raise FK.EnvelopeError(
+                        "handoff stream ended before its terminal "
+                        "frame")
+                kind, meta, arrays = FK.decode_handoff_frame(buf, seq)
+                if kind == FK.FRAME_KIND:
+                    width = arrays["k"].shape[1]
+                    self.results.put(
+                        ("frame", req, slot, arrays, None,
+                         int(meta["j0"]), int(meta["j0"]) + width))
+                    seq += 1
+                    continue
+                if self.fingerprint is not None:
+                    FK.check_fingerprint(meta, self.fingerprint)
+                self.stats["posted"] += 1
+                self.results.put(
+                    ("final", req, slot, arrays, None,
+                     int(meta["j0"]), int(meta["nBlocks"]),
+                     int(meta["first"]), time.monotonic()))
+                return "done"
+        except FK.EnvelopeError:
+            self.stats["refused_streams"] += 1
+            return "next"
+        except (OSError, ValueError, HTTPException):
+            # connection refused/reset, or the pod died mid-chunk
+            # (IncompleteRead) — a started stream refuses WHOLESALE
+            # either way; retry elsewhere
+            if streaming:
+                self.stats["refused_streams"] += 1
+            return "next"
+        finally:
+            conn.close()
 
     def close(self) -> None:
         self._stop.set()
@@ -555,7 +862,12 @@ def remote_prefill_client_from_env() -> Optional[RemotePrefillClient]:
               "SERVE_PREFILL_BROKER or SERVE_PREFILL_PEERS",
               flush=True)
         return None
-    return RemotePrefillClient(broker=broker, peers=peers)
+    # SERVE_PREFILL_STREAM=1 (ISSUE 14): consume the pool's chunked
+    # handoff frames, uploading each block group while the pod still
+    # prefills the rest — long-prompt TTFT ≈ last chunk + attach
+    return RemotePrefillClient(
+        broker=broker, peers=peers,
+        stream=os.environ.get("SERVE_PREFILL_STREAM", "0") == "1")
 
 
 def main() -> int:
@@ -602,15 +914,27 @@ def main() -> int:
     max_len = int(os.environ.get("SERVE_MAX_LEN", "0")) \
         or cfg.max_seq_len
     kv_quant = os.environ.get("SERVE_KV_QUANT", "none")
+    # ISSUE 14: SERVE_PREFILL_LANES widens the pool into an N-lane
+    # batched, chunk-interleaved engine (1 keeps the monolithic
+    # oracle); SERVE_PREFILL_CHUNK is the interleave slice width;
+    # SERVE_PREFILL_PREFIX_BLOCKS caps the pod's own radix prefix
+    # cache (0 disables; engine-only)
+    lanes = int(os.environ.get("SERVE_PREFILL_LANES", "1") or 1)
     srv = make_prefill_server(
         "0.0.0.0", env.port, params, cfg,
         block_size=int(os.environ.get("SERVE_BLOCK_SIZE", "256")),
         max_len=max_len, kv_quant=kv_quant, mesh=mesh,
         job=os.environ.get("TPUJOB_NAME", "local"),
-        replica=os.environ.get("TPUJOB_REPLICA_ID", ""))
+        replica=os.environ.get("TPUJOB_REPLICA_ID", ""),
+        lanes=lanes,
+        prefill_chunk=int(os.environ.get("SERVE_PREFILL_CHUNK",
+                                         "64") or 64),
+        prefix_blocks=int(os.environ.get(
+            "SERVE_PREFILL_PREFIX_BLOCKS", "256") or 0))
     print(f"prefill pool {os.environ.get('MODEL_PRESET', '7b')} "
           f"(resumed={resumed}, tp={tp}, kv_quant={kv_quant}, "
-          f"max_len={max_len}) on :{env.port}", flush=True)
+          f"lanes={lanes}, max_len={max_len}) on :{env.port}",
+          flush=True)
     budget = float(os.environ.get("SERVE_DRAIN_BUDGET_S", "30"))
     code = [0]
 

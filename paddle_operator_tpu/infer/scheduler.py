@@ -268,7 +268,10 @@ class ContinuousBatcher:
                  qos: Optional[QOS.QoSConfig] = None,
                  adapters: Optional[QOS.AdapterRegistry] = None,
                  megastep: int = 1,
-                 prefill_client=None) -> None:
+                 prefill_client=None,
+                 prefill_lanes: int = 1,
+                 prefill_stream: bool = False,
+                 prefill_prefix_blocks: int = 0) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
                              f"{PREFILL_MODES}")
@@ -348,7 +351,9 @@ class ContinuousBatcher:
             prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
             check_finite=self._check_finite, kv_quant=kv_quant,
             host_cache_blocks=host_cache_blocks, adapters=adapters,
-            megastep=self.megastep, prefill_client=prefill_client)
+            megastep=self.megastep, prefill_client=prefill_client,
+            prefill_lanes=prefill_lanes, prefill_stream=prefill_stream,
+            prefill_prefix_blocks=prefill_prefix_blocks)
         self.mesh = mesh
         self.paged = self.executor.paged
         self.kv_quant = self.executor.kv_quant
@@ -380,6 +385,11 @@ class ContinuousBatcher:
         # away on the prefill executor (slot -> _PrefillState / request)
         self._prefilling: Dict[int, _PrefillState] = {}
         self._disagg_waiting: Dict[int, _Request] = {}
+        # streamed handoff (ISSUE 14): per-slot upload timestamps of
+        # frames landed BEFORE the terminal item — the overlap proof
+        # (an uploaded frame whose stamp precedes the engine's
+        # prefill-done stamp provably overlapped prefill compute)
+        self._handoff_frame_t: Dict[int, List[float]] = {}
         self._admit_seq = 0
 
         # bounded admission queue (max_queue > 0): submit() blocks up to
@@ -449,6 +459,12 @@ class ContinuousBatcher:
                       # prompts whose prefill ran in a PREFILL POOL
                       # pod and handed off over the wire
                       "remote_prefills": 0,
+                      # streamed handoff (ISSUE 14): block-group
+                      # frames landed ahead of their terminal item,
+                      # and the subset whose upload stamp PRECEDES the
+                      # engine's prefill-done stamp — the
+                      # transfer-overlaps-compute proof the gate pins
+                      "handoff_frames": 0, "overlapped_frames": 0,
                       "cow_copies": 0,
                       # hierarchical-cache accounting (ISSUE 8): blocks
                       # uploaded back from the host tier — cumulative
@@ -761,6 +777,17 @@ class ContinuousBatcher:
         depth = len(self._prefilling) + len(self._disagg_waiting)
         return depth
 
+    def _prefill_engine_stat(self, name: str, default):
+        """A LOCAL prefill engine's telemetry (lanes, batch occupancy,
+        HOL wait) — 0s on rings without one (inline/chunked/remote):
+        remote pools export their own via prefill_serve."""
+        pe = self.executor.prefill_exec
+        if pe is None or self.executor.prefill_remote:
+            return default
+        if name == "lanes":
+            return pe.lanes
+        return getattr(pe, name)()
+
     def serving_status(self) -> Dict[str, Any]:
         """The ``TPUJob.status.serving`` block (camelCase, like
         GoodputTracker.to_status): cumulative served-token throughput,
@@ -803,6 +830,18 @@ class ContinuousBatcher:
             # in interleaved chunked slices
             "prefillMode": self.prefill_mode,
             "prefillQueueDepth": self.prefill_queue_depth(),
+            # prefill-pool throughput (ISSUE 14): engine lanes, batch
+            # occupancy EMA, head-of-line wait p95 and streamed-frame
+            # counters — the tpujob_serve_prefill_batch_occupancy /
+            # _hol_wait_ms / _lanes gauges (a REMOTE ring reports 0s
+            # here; the prefill pods export their own)
+            "prefillLanes": self._prefill_engine_stat("lanes", 0),
+            "prefillBatchOccupancy": self._prefill_engine_stat(
+                "batch_occupancy", 0.0),
+            "prefillHolWaitMs": self._prefill_engine_stat(
+                "hol_wait_ms_p95", 0.0),
+            "handoffFrames": self.stats["handoff_frames"],
+            "overlappedFrames": self.stats["overlapped_frames"],
             # quantized-pool visibility (SERVE_KV_QUANT): which storage
             # mode the pool runs and its device bytes (codes + scales +
             # staging tails, or the bf16 pool/ring) — the capacity an
@@ -994,6 +1033,7 @@ class ContinuousBatcher:
         self._lane_first = [None] * self.slots
         self._prefilling.clear()
         self._disagg_waiting.clear()
+        self._handoff_frame_t.clear()
         if not healing:
             return False
         backoff = self._budget.spend()
@@ -1381,13 +1421,76 @@ class ContinuousBatcher:
         self._disagg_waiting[slot] = req
         ex.prefill_exec.submit(req, slot)
 
+    def _land_handoff_blocks(self, slot: int, payload, lane, j0: int,
+                             j1: int) -> None:
+        """Upload one handoff block group ``[j0, j1)`` into the lane's
+        already-reserved decode-pool blocks: the batched promote
+        scatter for remote (host) payloads, the frame transfer for
+        in-process (device snapshot) payloads.  Shared by streamed
+        frames and the terminal item's remainder — both async
+        dispatches that overlap whatever chunk is decoding."""
+        if j1 <= j0:
+            return
+        ex = self.executor
+        if ex.prefill_remote:
+            promotes = []
+            for i, j in enumerate(range(j0, j1)):
+                p = {"k": payload["k"][:, i:i + 1],
+                     "v": payload["v"][:, i:i + 1]}
+                if ex.quant:
+                    p["ks"] = payload["ks"][:, i:i + 1]
+                    p["vs"] = payload["vs"][:, i:i + 1]
+                promotes.append(
+                    (int(self.pool.table[slot][j]), p, None))
+            ex.dispatch_promotions(promotes)
+            return
+        m = self.pool.max_blocks
+        n = j1 - j0
+        src_ids = np.zeros((m,), np.int32)
+        dst_ids = np.zeros((m,), np.int32)
+        src_ids[:n] = ex.prefill_exec.tables[lane][j0:j1]
+        dst_ids[:n] = self.pool.table[slot][j0:j1]
+        if ex.quant:
+            (ex.cache["k"], ex.cache["v"], ex.cache["ks"],
+             ex.cache["vs"]) = ex._frame_transfer(
+                ex.cache["k"], ex.cache["v"], ex.cache["ks"],
+                ex.cache["vs"], payload["k"], payload["v"],
+                payload["ks"], payload["vs"], jnp.asarray(src_ids),
+                jnp.asarray(dst_ids))
+        else:
+            ex.cache["k"], ex.cache["v"] = ex._frame_transfer(
+                ex.cache["k"], ex.cache["v"], payload["k"],
+                payload["v"], jnp.asarray(src_ids),
+                jnp.asarray(dst_ids))
+
+    def _land_remote_tail(self, slot: int, payload) -> None:
+        """A REMOTE handoff's (int8) staging tail: the wire payload's
+        exact bf16 tail row lands in decode tail row ``slot``."""
+        ex = self.executor
+        ex.cache["kt"] = ex.cache["kt"].at[:, slot].set(
+            jnp.asarray(payload["kt"][:, 0]))
+        ex.cache["vt"] = ex.cache["vt"].at[:, slot].set(
+            jnp.asarray(payload["vt"][:, 0]))
+
     def _drain_handoffs(self) -> None:
         """Attach completed disaggregated prefills: device-to-device
         block copy from the prefill executor's pool into the lane's
         already-mapped decode-pool blocks, then one tiny attach
         dispatch (pos/tok/temp/keys).  Results for requests that
         resolved meanwhile (cancel, deadline, heal) are dropped — their
-        decode blocks were already retired with the lane."""
+        decode blocks were already retired with the lane.
+
+        STREAMED handoff (ISSUE 14): the N-lane engine (and the
+        streaming remote client) post ``("frame", req, slot, payload,
+        lane, j0, j1)`` block-group items WHILE the prompt is still
+        prefilling, then a terminal ``("final", req, slot, payload,
+        lane, j0, n_blocks, first, t_done)`` with the remainder +
+        (int8) staging tail + first token — so the decode-side upload
+        (and the DCN wire, remote) overlaps the remaining prefill
+        compute.  Frames for a resolved request drop exactly like
+        stale results; a retried stream simply re-uploads from block
+        0 (uploads are idempotent by destination — the blocks were
+        reserved at admission)."""
         ex = self.executor
         pexec = ex.prefill_exec
         while True:
@@ -1395,6 +1498,39 @@ class ContinuousBatcher:
                 item = pexec.results.get_nowait()
             except queue.Empty:
                 return
+            if isinstance(item[0], str):
+                kind, req, slot = item[0], item[1], item[2]
+                if (self._disagg_waiting.get(slot) is not req
+                        or self.lane[slot] is not req
+                        or req.done.is_set()):
+                    continue                # stale frame/final: drop
+                if kind == "frame":
+                    _, _, _, payload, lane, j0, j1 = item
+                    self._land_handoff_blocks(slot, payload, lane,
+                                              j0, j1)
+                    self.stats["handoff_frames"] += 1
+                    self._handoff_frame_t.setdefault(slot, []).append(
+                        time.monotonic())
+                    continue
+                _, _, _, payload, lane, j0, n_blocks, first, t_done = \
+                    item
+                del self._disagg_waiting[slot]
+                self._land_handoff_blocks(slot, payload, lane, j0,
+                                          n_blocks)
+                if ex.quant:
+                    if ex.prefill_remote:
+                        self._land_remote_tail(slot, payload)
+                    else:
+                        ex.cache["kt"], ex.cache["vt"] = ex._tail_copy(
+                            ex.cache["kt"], ex.cache["vt"],
+                            payload["kt"], payload["vt"], lane, slot)
+                stamps = self._handoff_frame_t.pop(slot, [])
+                self.stats["overlapped_frames"] += sum(
+                    1 for t in stamps if t < t_done)
+                if ex.prefill_remote:
+                    self.stats["remote_prefills"] += 1
+                self._attach_handoff(slot, req, len(req.prompt), first)
+                continue
             req, slot = item[0], item[1]
             if (self._disagg_waiting.get(slot) is not req
                     or self.lane[slot] is not req or req.done.is_set()):
@@ -1409,29 +1545,16 @@ class ContinuousBatcher:
             if ex.prefill_remote:
                 # cross-host handoff (ISSUE 13): ``snap`` is the wire
                 # envelope's HOST payload — per-block pool bytes the
-                # prefill pod captured.  Land them in the lane's
-                # already-reserved blocks through the SAME batched
-                # promote scatter a host-tier hit uses (PR 8 — byte-
-                # exact upload, codes+scales verbatim under int8),
-                # then the identical attach path as in-process.
-                promotes = []
-                for j in range(n_blocks):
-                    payload = {"k": snap["k"][:, j:j + 1],
-                               "v": snap["v"][:, j:j + 1]}
-                    if ex.quant:
-                        payload["ks"] = snap["ks"][:, j:j + 1]
-                        payload["vs"] = snap["vs"][:, j:j + 1]
-                    promotes.append(
-                        (int(self.pool.table[slot][j]), payload, None))
-                if promotes:
-                    ex.dispatch_promotions(promotes)
+                # prefill pod captured.  Land the whole range through
+                # the streamed path's shared helper (the batched
+                # promote scatter a host-tier hit uses, PR 8 — byte-
+                # exact upload, codes+scales verbatim under int8) +
+                # the exact wire tail, then the identical attach path
+                # as in-process.
+                self._land_handoff_blocks(slot, snap, None, 0,
+                                          n_blocks)
                 if ex.quant:
-                    # the prompt's partial-block staging tail crosses
-                    # the wire exact — it lands in decode tail ``slot``
-                    ex.cache["kt"] = ex.cache["kt"].at[:, slot].set(
-                        jnp.asarray(snap["kt"][:, 0]))
-                    ex.cache["vt"] = ex.cache["vt"].at[:, slot].set(
-                        jnp.asarray(snap["vt"][:, 0]))
+                    self._land_remote_tail(slot, snap)
                 self.stats["remote_prefills"] += 1
                 self._attach_handoff(slot, req, n, first)
                 continue
@@ -1536,6 +1659,7 @@ class ContinuousBatcher:
         # identity check in _drain_handoffs
         self._prefilling.pop(slot, None)
         self._disagg_waiting.pop(slot, None)
+        self._handoff_frame_t.pop(slot, None)
         if self.pool is not None:
             # return the lane's blocks: published prompt blocks become
             # reclaimable cache, private ones rejoin the free list; the

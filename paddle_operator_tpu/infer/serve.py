@@ -833,6 +833,23 @@ def main() -> int:
             rp = remote_prefill_client_from_env()
             if rp is not None:
                 ring_kw["prefill_client"] = rp
+            # Prefill-pool throughput (ISSUE 14): SERVE_PREFILL_LANES
+            # widens the IN-PROCESS engine into an N-lane batched,
+            # chunk-interleaved pool (1, the default, keeps the PR 6
+            # monolithic engine — the parity oracle);
+            # SERVE_PREFILL_STREAM=1 streams completed block groups to
+            # the decode side while the rest of the prompt prefills;
+            # SERVE_PREFILL_PREFIX_BLOCKS caps the engine's own radix
+            # prefix cache (0 disables).  All three are engine-side
+            # and greedy-bit-identical to the 1-lane monolithic path
+            # (dryrun serve-prefillpool pins it).
+            ring_kw["prefill_lanes"] = int(
+                os.environ.get("SERVE_PREFILL_LANES", "1") or 1)
+            ring_kw["prefill_stream"] = os.environ.get(
+                "SERVE_PREFILL_STREAM", "0") == "1"
+            ring_kw["prefill_prefix_blocks"] = int(
+                os.environ.get("SERVE_PREFILL_PREFIX_BLOCKS", "0")
+                or 0)
         if os.environ.get("SERVE_PREFILL_CHUNK"):
             ring_kw["prefill_chunk"] = int(
                 os.environ["SERVE_PREFILL_CHUNK"])

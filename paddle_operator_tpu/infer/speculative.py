@@ -208,16 +208,24 @@ def _layer_multi_paged(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
                        cos: jax.Array, sin: jax.Array, k_pool: jax.Array,
                        v_pool: jax.Array, li: jax.Array, table: jax.Array,
                        pos: jax.Array, limit: Optional[jax.Array],
-                       lora=None
+                       lora=None, aligned: bool = False
                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """:func:`_layer_multi` over the PAGED pool (infer/paged.py): new
     rows land in whatever pool block the lane's table maps for their
     absolute position (rows past ``limit`` route to the trash block —
     suffix-prefill pads), and the attention walks the table through the
     gathered lane view.  Same einsum/mask sequence as the contiguous
-    verify, so greedy paged-vs-contiguous streams stay bit-identical."""
+    verify, so greedy paged-vs-contiguous streams stay bit-identical.
+
+    ``aligned=True`` (callers that guarantee block-aligned ``pos`` and
+    a block-multiple row count — the N-lane prefill engine's slice
+    programs): writes go whole-block (``_write_blocks_paged``) instead
+    of per-row, collapsing the traced write-op count by
+    ``block_size``x — at production slice widths the per-row unroll is
+    pathological to compile, not just to run."""
     from paddle_operator_tpu.infer.paged import (
         _gather_lane_view,
+        _write_blocks_paged,
         _write_rows_paged,
     )
 
@@ -236,10 +244,11 @@ def _layer_multi_paged(cfg: LlamaConfig, lp: Dict[str, Any], x: jax.Array,
 
     q, k = rot(q), rot(k)
     block_size = k_pool.shape[3]
-    k_pool = _write_rows_paged(k_pool, k.transpose(0, 2, 1, 3), li,
-                               table, pos, block_size, limit)
-    v_pool = _write_rows_paged(v_pool, v.transpose(0, 2, 1, 3), li,
-                               table, pos, block_size, limit)
+    write = _write_blocks_paged if aligned else _write_rows_paged
+    k_pool = write(k_pool, k.transpose(0, 2, 1, 3), li, table, pos,
+                   block_size, limit)
+    v_pool = write(v_pool, v.transpose(0, 2, 1, 3), li, table, pos,
+                   block_size, limit)
     k_view = _gather_lane_view(k_pool, table, li)
     v_view = _gather_lane_view(v_pool, table, li)
 
@@ -376,7 +385,7 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
                          mesh=None, head: bool = True,
                          quant: bool = False,
                          lane_mask: Optional[jax.Array] = None,
-                         lora=None
+                         lora=None, aligned: bool = False
                          ) -> Tuple[Optional[jax.Array],
                                     Dict[str, jax.Array]]:
     """:func:`_multi_forward` with the target cache PAGED: the
@@ -392,7 +401,11 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
     the per-lane staging tails ride the carry too; ``lane_mask`` [B]
     (the spec round's ``active``) additionally redirects masked lanes'
     writes to the trash tail — their tail rows may be live prefill
-    state (see :func:`_layer_multi_paged_quant`)."""
+    state (see :func:`_layer_multi_paged_quant`).
+
+    ``aligned=True`` (bf16 only — the quant tail protocol is
+    inherently per-row): block-aligned whole-block writes, see
+    :func:`_layer_multi_paged`."""
     pos = cache["pos"]
     adp, aid = lora if lora is not None else (None, None)
     x = params["tok_embed"]["embedding"].astype(cfg.dtype)[toks]
@@ -432,7 +445,7 @@ def _multi_forward_paged(cfg: LlamaConfig, params: Dict[str, Any],
             lp, li, lo = _unpack(layer_in)
             y, kc, vc = _layer_multi_paged(cfg, lp, x, cos, sin, kc, vc,
                                            li, table, pos, limit,
-                                           lora=lo)
+                                           lora=lo, aligned=aligned)
             return (y, kc, vc), ()
 
         (x, k_new, v_new), _ = jax.lax.scan(

@@ -92,6 +92,13 @@ _GAUGE_KEYS = {
     # it a freshly-joined pod's one slow reading counts as much as a
     # seasoned pod's thousands
     "tpujob_serve_prefill_jobs_total": "prefillJobs",
+    # prefill-pool throughput (ISSUE 14): batch occupancy + engine
+    # lanes feed the autoscaler's prefill denominator (a half-empty
+    # batch must not read as a saturated pool); HOL wait p95 surfaces
+    # queueing the depth gauge alone can hide
+    "tpujob_serve_prefill_lanes": "prefillLanes",
+    "tpujob_serve_prefill_batch_occupancy": "prefillBatchOccupancy",
+    "tpujob_serve_prefill_hol_wait_ms": "prefillHolWaitMs",
 }
 
 _GAUGE_RE = re.compile(
@@ -222,6 +229,27 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
         if ms:
             agg["prefillMsAvg"] = round(
                 sum(v * w for v, w in ms) / sum(w for _, w in ms), 3)
+    # prefill-pool throughput fold (ISSUE 14), role-aware: occupancy
+    # and HOL wait come from whichever pods run an engine — prefill
+    # pods, or decode pods with the IN-PROCESS engine — weighted by
+    # served prefill jobs (a fresh pod's empty batch must not drag
+    # the fleet occupancy the autoscaler divides by); lanes folds as
+    # the per-pod width (max — pools are homogeneous by construction,
+    # and mid-rollout the wider generation is the capacity truth)
+    eng = [b for b in blocks_all
+           if float(b.get("prefillLanes", 0) or 0) > 0]
+    if eng:
+        agg["prefillLanes"] = int(max(
+            float(b.get("prefillLanes", 0) or 0) for b in eng))
+        ws = [max(1.0, float(b.get("prefillJobs",
+                                   b.get("tokensTotal", 0)) or 0))
+              for b in eng]
+        agg["prefillBatchOccupancy"] = round(
+            sum(float(b.get("prefillBatchOccupancy", 0.0) or 0.0) * w
+                for b, w in zip(eng, ws)) / sum(ws), 4)
+        agg["prefillHolWaitMs"] = round(max(
+            float(b.get("prefillHolWaitMs", 0.0) or 0.0)
+            for b in eng), 3)
     if any("draining" in b for b in blocks_all):
         agg["draining"] = any(bool(b.get("draining"))
                               for b in blocks_all)

@@ -274,6 +274,110 @@ def decode_handoff(buf: bytes) -> Tuple[Dict[str, Any],
     return meta, arrays
 
 
+# ---------------------------------------------------------------------------
+# Streamed handoff frames (ISSUE 14): chunked block-group transfer
+# ---------------------------------------------------------------------------
+
+# Each streamed-handoff frame is a full envelope (magic + version +
+# manifest + per-frame CRC) carried length-prefixed on a chunked HTTP
+# response, so the decode side can upload completed block groups WHILE
+# the prefill pod is still computing the rest of the prompt.  The
+# terminal frame carries the handoff meta (first token, prompt length,
+# fingerprint) plus the frame count — a receiver that saw any gap,
+# reorder, CRC failure or truncation refuses the WHOLE stream
+# (EnvelopeError): partially-applied prefill KV must never activate a
+# lane.
+_FRAME_LEN = struct.Struct("<I")
+
+FRAME_KIND = "hframe"
+FINAL_KIND = "hfinal"
+
+
+def frame_wire(envelope: bytes) -> bytes:
+    """Length-prefix one frame envelope for the chunked stream."""
+    return _FRAME_LEN.pack(len(envelope)) + envelope
+
+
+def encode_handoff_frame(seq: int, j0: int,
+                         arrays: Dict[str, np.ndarray]) -> bytes:
+    """One INTERMEDIATE streamed-handoff frame: a completed block
+    group ``[j0, j0 + width)`` (k/v — plus verbatim scale rows under
+    int8).  Returns the WIRE bytes (length prefix included)."""
+    return frame_wire(encode_envelope(
+        FRAME_KIND, {"seq": int(seq), "j0": int(j0)}, arrays))
+
+
+def encode_handoff_final(meta: Dict[str, Any],
+                         arrays: Dict[str, np.ndarray]) -> bytes:
+    """The TERMINAL streamed-handoff frame: the remaining blocks
+    ``[j0, nBlocks)`` plus (int8) the exact staging tail, and the
+    handoff meta — ``first``, ``promptLen``, ``nBlocks``, ``seq``,
+    ``nFrames`` and the fingerprint the receiver validates before ANY
+    frame's bytes are trusted."""
+    return frame_wire(encode_envelope(FINAL_KIND, meta, arrays))
+
+
+def read_wire_frame(read) -> Optional[bytes]:
+    """Read one length-prefixed frame from ``read(n)`` (an HTTP
+    response or socket-like).  Returns None on clean EOF BEFORE a
+    frame starts; raises EnvelopeError on a frame cut short (the
+    mid-stream-death signature the chaos legs pin)."""
+    head = b""
+    while len(head) < _FRAME_LEN.size:
+        got = read(_FRAME_LEN.size - len(head))
+        if not got:
+            if head:
+                raise EnvelopeError(
+                    "streamed handoff died mid-frame (length prefix "
+                    "cut short)")
+            return None
+        head += got
+    (n,) = _FRAME_LEN.unpack(head)
+    buf = b""
+    while len(buf) < n:
+        got = read(n - len(buf))
+        if not got:
+            raise EnvelopeError(
+                f"streamed handoff died mid-frame ({len(buf)} of {n} "
+                "bytes)")
+        buf += got
+    return buf
+
+
+def decode_handoff_frame(buf: bytes, expect_seq: int
+                         ) -> Tuple[str, Dict[str, Any],
+                                    Dict[str, np.ndarray]]:
+    """Validate one streamed-handoff frame (magic/CRC/manifest via
+    :func:`decode_envelope`, kind, sequence continuity).  Returns
+    ``(kind, meta, arrays)`` — kind is FRAME_KIND or FINAL_KIND.  The
+    terminal frame's fingerprint/meta checks are the CALLER's (it owns
+    the ring fingerprint); everything frame-local is enforced here."""
+    kind, meta, arrays = decode_envelope(buf)
+    if kind not in (FRAME_KIND, FINAL_KIND):
+        raise EnvelopeError(
+            f"expected a streamed-handoff frame, got {kind!r}")
+    if int(meta.get("seq", -1)) != int(expect_seq):
+        raise EnvelopeError(
+            f"handoff frame out of order: seq {meta.get('seq')} != "
+            f"expected {expect_seq} — refusing the stream")
+    if kind == FINAL_KIND:
+        for req_key in ("first", "promptLen", "nBlocks", "nFrames",
+                        "j0"):
+            if req_key not in meta:
+                raise EnvelopeError(
+                    f"terminal handoff frame missing meta {req_key!r}")
+        if int(meta["nFrames"]) != int(meta["seq"]) + 1:
+            raise EnvelopeError(
+                f"terminal frame count {meta['nFrames']} disagrees "
+                f"with its own seq {meta['seq']} — refusing")
+    else:
+        if "j0" not in meta:
+            raise EnvelopeError("handoff frame missing meta 'j0'")
+        if "k" not in arrays or "v" not in arrays:
+            raise EnvelopeError("handoff frame missing k/v arrays")
+    return kind, meta, arrays
+
+
 def encode_prefix(meta: Dict[str, Any],
                   chunks: Sequence[Sequence[int]],
                   block_idx: Sequence[int],

@@ -182,6 +182,18 @@ def _serving_gauges_one(status_serving: dict, job: str,
             float(status_serving.get("prefillQueueDepth", 0.0)),
         f"tpujob_serve_chunked_prefill_token_share{lbl}":
             float(status_serving.get("chunkedPrefillTokenShare", 0.0)),
+        # prefill-pool throughput (ISSUE 14): engine lanes, batch
+        # occupancy EMA (busy lanes / N per engine iteration) and
+        # head-of-line queue-wait p95 — exported by in-process disagg
+        # rings AND prefill_serve pods; the SLO autoscaler divides the
+        # pool's load by occupancy x lanes so a half-empty batch never
+        # reads as a saturated pool
+        f"tpujob_serve_prefill_lanes{lbl}":
+            float(status_serving.get("prefillLanes", 0.0)),
+        f"tpujob_serve_prefill_batch_occupancy{lbl}":
+            float(status_serving.get("prefillBatchOccupancy", 0.0)),
+        f"tpujob_serve_prefill_hol_wait_ms{lbl}":
+            float(status_serving.get("prefillHolWaitMs", 0.0)),
         # quantized-pool serving (SERVE_KV_QUANT): device bytes held by
         # the KV pool (int8 codes + scale planes + staging tails, or
         # the bf16 pool/ring), labeled with the storage mode so

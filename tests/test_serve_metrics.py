@@ -142,6 +142,13 @@ class TestGaugeNaming:
             # prefilled in the prefill pool and handed off over the
             # wire (zero on in-process/inline rings)
             'tpujob_serve_remote_prefills_total{job="default/j"}',
+            # prefill-pool throughput shape (ISSUE 14): engine width,
+            # batch occupancy EMA and head-of-line wait p95 (zero on
+            # rings without a local engine; prefill pods export their
+            # own)
+            'tpujob_serve_prefill_lanes{job="default/j"}',
+            'tpujob_serve_prefill_batch_occupancy{job="default/j"}',
+            'tpujob_serve_prefill_hol_wait_ms{job="default/j"}',
             # multi-tenant QoS shape (ISSUE 10): one queue-depth gauge
             # per class in the block, preemptions, adapter count + one
             # marker per loaded adapter name
@@ -331,6 +338,10 @@ class TestBatcherServingStatus:
                            "peerPrefixFetches", "hostCacheEvictions",
                            # cross-host disaggregation block (ISSUE 13)
                            "remotePrefills",
+                           # prefill-pool throughput block (ISSUE 14)
+                           "prefillLanes", "prefillBatchOccupancy",
+                           "prefillHolWaitMs", "handoffFrames",
+                           "overlappedFrames",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
@@ -343,6 +354,11 @@ class TestBatcherServingStatus:
         assert st["priorityQueueDepth"] == [0, 0]   # 2 classes default
         assert st["preemptedLanes"] == 0
         assert st["remotePrefills"] == 0       # no prefill pool by default
+        assert st["prefillLanes"] == 0         # no local engine (inline)
+        assert st["prefillBatchOccupancy"] == 0.0
+        assert st["prefillHolWaitMs"] == 0.0
+        assert st["handoffFrames"] == 0
+        assert st["overlappedFrames"] == 0
         assert st["laneMigrations"] == 0       # fleet KV off by default
         assert st["adoptedLanes"] == 0
         assert st["peerPrefixFetches"] == 0
