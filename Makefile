@@ -67,7 +67,9 @@ bench:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
 # serve-disagg, serve-kvquant, serve-hostcache, serve-fleet,
 # serve-qos, serve-megastep, serve-fleetkv, serve-xdisagg,
-# serve-prefillpool, ft-drain)
+# serve-prefillpool, serve-trace — tracing-on parity vs the
+# tracing-off oracle + cross-pod span-tree completeness + the chaos
+# flight-recorder dump naming its fault — and ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

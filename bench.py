@@ -2427,6 +2427,85 @@ def measure_recovery(rates=(0, 2, 6), *, steps_per_hour: int = 24,
     return out
 
 
+def measure_trace_overhead(*, slots: int = 4, requests: int = 12,
+                           prompt_len: int = 12, new_tokens: int = 32,
+                           max_len: int = 64, chunk: int = 4,
+                           reps: int = 4) -> list:
+    """Span-capture cost (ISSUE 15): aggregate tok/s of the SAME
+    saturated workload with tracing OFF vs ON (every request carrying
+    a trace context, spans riding to completion).  Tracing is host
+    timestamps at points the scheduler already touches, so the
+    acceptance bar is <2% tok/s overhead — ``trace_overhead_ratio``
+    (on/off, 1.0 = free) is the summary key.  Runs alternate off/on
+    ``reps`` times and keep each mode's BEST rep: this box's ±20%
+    contention swamps a 2% effect in single runs, and best-of compares
+    the two modes' uncontended behavior."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+    from paddle_operator_tpu.models import llama as L
+    from paddle_operator_tpu.utils import tracing as TR
+
+    cfg = L.CONFIGS["tiny"]
+    params = L.Llama(cfg).init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, 8), jnp.int32))["params"]
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+               for _ in range(requests)]
+
+    def run(trace: bool) -> float:
+        b = ContinuousBatcher(params, cfg, slots=slots,
+                              max_len=max_len, chunk_tokens=chunk,
+                              prefill_buckets=(16, max_len),
+                              trace=trace)
+        try:
+            # warm the compiles out of the timed region
+            b.submit(prompts[0], max_new_tokens=chunk,
+                     trace_ctx=(TR.new_id(), None) if trace else None
+                     ).result(timeout=600)
+            done = []
+            lock = threading.Lock()
+
+            def client(i):
+                h = b.submit(
+                    prompts[i], max_new_tokens=new_tokens,
+                    request_id=f"b/{i}",
+                    trace_ctx=((TR.new_id(), None) if trace
+                               else None))
+                h.result(timeout=600)
+                with lock:
+                    done.append(i)
+
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(requests)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            assert len(done) == requests
+            return requests * new_tokens / wall
+        finally:
+            b.close()
+
+    best = {"off": 0.0, "on": 0.0}
+    for _ in range(reps):
+        best["off"] = max(best["off"], run(False))
+        best["on"] = max(best["on"], run(True))
+    return [{
+        "trace_tok_s_off": round(best["off"], 2),
+        "trace_tok_s_on": round(best["on"], 2),
+        "trace_overhead_ratio": round(best["on"] / best["off"], 4),
+        "trace_reps": reps,
+        "trace_requests": requests,
+    }]
+
+
 def measure_resilience(fault_rates=(0, 1, 5), *, slots: int = 2,
                        requests: int = 8, prompt_len: int = 12,
                        new_tokens: int = 24, max_len: int = 64,
@@ -3089,6 +3168,19 @@ def main() -> int:
     _fold_autoscaler_summary(
         guarded("autoscaler", lambda: measure_autoscaler()),
         summary, emit)
+
+    # tracing overhead (ISSUE 15): tok/s with span capture ON over OFF
+    # on the same saturated tiny-ring workload, best-of-reps to shed
+    # this box's contention — trace_overhead_ratio, bar >= 0.98
+    trace_rows = guarded("trace", lambda: measure_trace_overhead())
+    if isinstance(trace_rows, list):
+        for entry in trace_rows:
+            emit("trace_overhead", entry)
+            if "trace_overhead_ratio" in entry:
+                summary["trace_overhead_ratio"] = \
+                    entry["trace_overhead_ratio"]
+    else:
+        emit("trace_overhead", trace_rows)
 
     latency = guarded("latency", measure_submit_latency)
     # submit->ConfigMap anomaly guard, same rationale as first_step_s:

@@ -64,7 +64,8 @@ def prefill_load_ratio(queue_depth: float, ready: int,
                        prefill_ms_avg: float,
                        ttft_target_ms: float,
                        lanes: int = 1,
-                       batch_occupancy: Optional[float] = None
+                       batch_occupancy: Optional[float] = None,
+                       ttft_p95_ms: Optional[float] = None
                        ) -> float:
     """Observed prefill load over SLO capacity.  Queued jobs
     serialize per pod in batches of ``lanes`` (the ISSUE 14 N-lane
@@ -87,7 +88,21 @@ def prefill_load_ratio(queue_depth: float, ready: int,
     half-empty batch never reads as a saturated pool.  A SATURATED
     batch (occupancy 1.0) keeps the full reading: at saturation the
     depth gauge cannot distinguish running from waiting, and the
-    conservative read is that arrivals queue."""
+    conservative read is that arrivals queue.
+
+    ``ttft_p95_ms`` (ISSUE 15): the MEASURED fleet TTFT p95, folded
+    from the replicas' histogram exports over a rolling window
+    (utils/tracing.py, ``status.serving.ttftP95Ms``).  The queue/
+    service-time model above PREDICTS load; the p95 is the SLO as
+    experienced — when it breaches the target the ratio floors at the
+    burn rate ``p95 / target`` (>1 -> scale up proportionally to the
+    breach) even when the queue model reads idle, which it does
+    exactly when the model's assumptions broke (skewed prompt
+    lengths, a slow replica dragging the tail, handoff-wire
+    congestion the depth gauge never sees).  The windowed fold means
+    a resolved burst stops breaching within ~two windows, so the
+    p95 floor composes with the law's hysteresis instead of pinning
+    the pool scaled-up forever."""
     if ttft_target_ms <= 0:
         return 0.0
     ready = max(1, int(ready))
@@ -102,7 +117,10 @@ def prefill_load_ratio(queue_depth: float, ready: int,
     depth = float(queue_depth)
     if batch_occupancy is not None and 0.0 <= batch_occupancy < 1.0:
         depth = max(0.0, depth - batch_occupancy * lanes * ready)
-    return depth / (ready * allowed_per_pod)
+    ratio = depth / (ready * allowed_per_pod)
+    if ttft_p95_ms is not None and ttft_p95_ms > 0:
+        ratio = max(ratio, float(ttft_p95_ms) / ttft_target_ms)
+    return ratio
 
 
 def decode_load_ratio(tokens_per_sec: float, queue_depth: float,
@@ -200,13 +218,20 @@ class FleetAutoscaler:
             float(serving.get("kvBlocksFree", 0.0) or 0.0),
             max(decode_ready, d_cur), a.tok_s_per_replica)
         occ = serving.get("prefillBatchOccupancy")
+        # histogram-derived TTFT p95 (ISSUE 15): the replicas export
+        # fixed-bucket latency histograms, aggregate_fleet_serving
+        # folds their rolling windows fleet-wide, and the fold's p95
+        # lands here as ttftP95Ms — the law scales against the SLO as
+        # MEASURED, not just the queue model's prediction
+        p95 = serving.get("ttftP95Ms")
         p_ratio = prefill_load_ratio(
             float(serving.get("prefillQueueDepth", 0.0) or 0.0),
             max(prefill_ready, p_cur),
             float(serving.get("prefillMsAvg", 0.0) or 0.0),
             a.ttft_target_ms,
             lanes=int(serving.get("prefillLanes", 1) or 1),
-            batch_occupancy=(float(occ) if occ is not None else None))
+            batch_occupancy=(float(occ) if occ is not None else None),
+            ttft_p95_ms=(float(p95) if p95 else None))
 
         d_new, d_why = step(
             a.min_replicas, a.max_replicas, d_cur, d_ratio, now=now,

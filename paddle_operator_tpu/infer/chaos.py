@@ -118,6 +118,17 @@ class ChaosInjector:
 
     def _apply(self, ev: ChaosEvent, idx: int, args) -> None:
         self.fired.append((ev.kind, idx))
+        # flight recorder (ISSUE 15): every injected fault lands in
+        # the pod's event ring AND forces a dump — the chaos suite
+        # asserts the dump NAMES the injected fault, which is exactly
+        # the property a real incident's post-mortem needs.  Recorded
+        # BEFORE the fault fires: dispatch_fail raises out of this
+        # frame.
+        fr = getattr(self.batcher, "flightrec", None)
+        if fr is not None:
+            fr.record("chaos_injected", fault=ev.kind, dispatch=idx,
+                      arg=ev.arg)
+            fr.dump_file(f"chaos:{ev.kind}")
         if ev.kind == "dispatch_fail":
             raise RuntimeError(
                 f"chaos: injected dispatch failure @ dispatch {idx}")

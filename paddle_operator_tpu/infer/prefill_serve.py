@@ -50,6 +50,8 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
+from paddle_operator_tpu.utils import tracing as TRC
+
 # One whole-prompt forward per job, bounded by model size — generous
 # enough for a cold 7B 2k-token prefill on real chips, small enough
 # that a wedged pod sheds its waiters onto healthy peers.
@@ -154,6 +156,13 @@ class PrefillFrontend:
         # rolling per-job wall EMA — the gauge the SLO autoscaler
         # converts a TTFT target into a queue-depth bound with
         self.prefill_ms_avg = 0.0
+        # flight recorder (ISSUE 15): the prefill pod's own bounded
+        # event ring — refusals, per-job errors, drain transitions —
+        # served at /debug/flightrec and dumped on SIGTERM
+        import os as _os
+
+        self.flightrec = TRC.FlightRecorder(
+            pod=_os.environ.get("TPUJOB_REPLICA_ID", ""))
         self._t_start = time.monotonic()
         self._stop = threading.Event()
         self._matcher = threading.Thread(target=self._match_loop,
@@ -273,10 +282,14 @@ class PrefillFrontend:
 
         job = self._submit(tokens, temperature, seed)
         if not job.done.wait(timeout):
+            self.flightrec.record("prefill_timeout",
+                                  tokens=len(job.prompt))
             self._timeout(job, timeout)
         if job.error is not None:
             with self._lock:
                 self.stats["errors"] += 1
+            self.flightrec.record("prefill_error",
+                                  error=str(job.error)[:200])
             raise job.error
         snap, lane, _, n_blocks, first, _ = job.result
         arrays = self._host_blocks(snap, lane, 0, n_blocks)
@@ -480,6 +493,10 @@ class _PrefillHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/debug/flightrec":
+            # the prefill pod's event ring (ISSUE 15) — same contract
+            # as the decode replicas' endpoint
+            self._send_json(200, fe.flightrec.dump("debug_endpoint"))
         else:
             self._send_json(404, {})
 
@@ -501,6 +518,7 @@ class _PrefillHandler(BaseHTTPRequestHandler):
             # jobs below this point finish and flush
             with fe._lock:
                 fe.stats["refused"] += 1
+            fe.flightrec.record("handoff_refused", reason="draining")
             self._send_json(503, {"error": "draining"},
                             headers={"Retry-After": 2})
             return
@@ -711,6 +729,7 @@ class RemotePrefillClient:
                 "stream": self.stream,
             }).encode()
             outcome = None
+            t_wire0 = time.monotonic()
             for i, ep in enumerate(self._targets()):
                 if req.done.is_set() or req._cancel:
                     break           # late resolution: stop POSTing
@@ -721,6 +740,9 @@ class RemotePrefillClient:
                     res = self._stream_attempt(ep, body, req, slot)
                     if res == "next":
                         continue
+                    if res == "done":
+                        self._wire_span(req, t_wire0, ep, i,
+                                        stream=True)
                     outcome = res
                     break
                 try:
@@ -748,6 +770,7 @@ class RemotePrefillClient:
                     outcome = (req, slot, e)
                     break
                 self.stats["posted"] += 1
+                self._wire_span(req, t_wire0, ep, i, stream=False)
                 outcome = (req, slot, arrays, int(meta["nBlocks"]),
                            int(meta["first"]))
                 break
@@ -759,6 +782,22 @@ class RemotePrefillClient:
                     "no prefill pod accepted the handoff "
                     f"({self.max_attempts} attempts); retry"))
             self.results.put(outcome)
+
+    @staticmethod
+    def _wire_span(req, t0: float, ep: str, attempts: int,
+                   stream: bool) -> None:
+        """Remote-handoff wire span (ISSUE 15): POST -> decoded
+        envelope (streamed: first frame -> terminal frame), stamped
+        from this worker thread onto the request's trace — the
+        RequestTrace is thread-safe for exactly this.  Covers pod
+        queue + prefill compute + the DCN transfer; the pod's own
+        ``prefillMsAvg`` gauge splits out the compute share."""
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            # NB: "pod" is make_span's own field (the POSTING pod);
+            # the serving prefill pod rides as the target attr
+            tr.add("remote_prefill", t0, target=ep,
+                   attempts=attempts + 1, stream=stream)
 
     def _stream_attempt(self, ep: str, body: bytes, req, slot: int):
         """One STREAMED prefill attempt against ``ep``: frames post to
@@ -940,6 +979,8 @@ def main() -> int:
 
     def drain(reason: str) -> None:
         fe = srv.frontend
+        fe.flightrec.record("drain_start", reason=str(reason))
+        fe.flightrec.dump_file("sigterm")
         fe.draining = True          # /readyz false, new prefills 503
         deadline = time.monotonic() + budget
         while fe.depth() > 0 and time.monotonic() < deadline:

@@ -438,9 +438,22 @@ class ServingDrain:
             return
         self._started.set()
         self.state.draining = True
+        # flight recorder (ISSUE 15): persist the pod's event ring the
+        # moment the drain starts — the process exits at the end of
+        # this sequence, and the dump is the post-mortem record of the
+        # final moments (the batcher's own drain appends drain_start/
+        # drain_done events on top)
+        fr = getattr(self.batcher, "flightrec", None)
+        if fr is not None:
+            fr.record("sigterm", reason=str(reason))
+            fr.dump_file("sigterm")
         try:
             if self.batcher is not None:
                 self.batcher.drain(self.budget_s)
+                if fr is not None:
+                    # re-dump with the drain's own events appended —
+                    # the early dump above covered a crash mid-drain
+                    fr.dump_file("sigterm")
             try:
                 self.server.shutdown()
             except Exception:
@@ -478,5 +491,8 @@ class ServingDrain:
                     "server killed (second SIGTERM)"))
             except Exception:
                 pass
+            fr = getattr(self.batcher, "flightrec", None)
+            if fr is not None:
+                fr.dump_file("second_sigterm")
         self.done.set()
         self._exit(EXIT_PREEMPTED)

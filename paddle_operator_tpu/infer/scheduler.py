@@ -30,6 +30,7 @@ with spec decode, paged KV, deadlines, drain, and the watchdog rebuild
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -42,6 +43,7 @@ import jax.numpy as jnp
 
 from paddle_operator_tpu.infer import executor as X
 from paddle_operator_tpu.infer import qos as QOS
+from paddle_operator_tpu.utils import tracing as TR
 from paddle_operator_tpu.infer.resilience import (
     DispatchWatchdog,
     LaneMigrated,
@@ -82,7 +84,9 @@ class _Request:
                  "dev_prompt", "bucket", "accepted", "drafted",
                  "deadline", "deadline_exceeded",
                  "priority", "adapter", "adapter_idx", "ns", "preempts",
-                 "request_id", "migrate_state")
+                 "request_id", "migrate_state",
+                 "trace", "t_submit", "t_first", "t_last_tok",
+                 "t_prefill0")
 
     def __init__(self, prompt, max_new, temperature, seed, eos,
                  wants_stream=False, deadline=None):
@@ -121,6 +125,16 @@ class _Request:
         # "failed" (peer refused; never re-offered, resumes locally)
         self.request_id: Optional[str] = None
         self.migrate_state: Optional[str] = None
+        # observability (ISSUE 15): per-request span accumulator
+        # (None = tracing off for this request — every capture site is
+        # one attribute check) + the host timestamps the latency
+        # histograms observe at the scheduler's EXISTING blocking
+        # points (submit, first-token materialization, chunk consume)
+        self.trace: Optional[TR.RequestTrace] = None
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.t_last_tok: Optional[float] = None
+        self.t_prefill0: Optional[float] = None
         # padded prompt, transferred to device on the SUBMIT thread
         # (batcher.submit): on relayed chips a host->device copy costs a
         # full round-trip, and paying it on the decode-ring thread
@@ -271,7 +285,8 @@ class ContinuousBatcher:
                  prefill_client=None,
                  prefill_lanes: int = 1,
                  prefill_stream: bool = False,
-                 prefill_prefix_blocks: int = 0) -> None:
+                 prefill_prefix_blocks: int = 0,
+                 trace: Optional[bool] = None) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
                              f"{PREFILL_MODES}")
@@ -339,6 +354,23 @@ class ContinuousBatcher:
         # byte-identical to the pre-QoS ring
         self.qos = qos if qos is not None else QOS.QoSConfig()
         self.adapters = adapters
+
+        # observability (ISSUE 15, utils/tracing.py).  Span capture is
+        # OPT-IN (``trace=`` / SERVE_TRACE=1) and zero-cost when off:
+        # requests then carry ``trace=None`` and every capture site is
+        # one attribute check.  Spans only wrap host timestamps around
+        # blocking points the loop already has — capture never adds a
+        # device sync, and greedy token streams are byte-identical
+        # either way (dryrun ``serve-trace``).  The latency histograms
+        # (TTFT / inter-token / e2e / queue-wait) and the flight
+        # recorder are always-on metrics, like the gauges.
+        pod = os.environ.get("TPUJOB_REPLICA_ID", "")
+        if trace is None:
+            trace = TR.trace_enabled()
+        self.tracer: Optional[TR.Tracer] = (
+            TR.Tracer(pod=pod) if trace else None)
+        self.hist = TR.ServeHistograms()
+        self.flightrec = TR.FlightRecorder(pod=pod)
 
         # the device half: compiled programs + cache/pool/lane state
         self.executor = X.RingExecutor(
@@ -601,9 +633,18 @@ class ContinuousBatcher:
                request_id: Optional[str] = None,
                deadline_s: Optional[float] = None,
                priority: Optional[int] = None,
-               adapter: Optional[str] = None) -> _Request:
+               adapter: Optional[str] = None,
+               trace_ctx: Optional[tuple] = None) -> _Request:
         """Queue one generation request; returns a handle whose
         ``result()``/``stream()`` deliver the tokens.
+
+        ``trace_ctx`` (ISSUE 15): ``(trace_id, parent_span_id|None)``
+        from the ``X-Tpujob-Trace`` header — on a tracing-enabled ring
+        (SERVE_TRACE=1) the request accumulates phase spans under that
+        context and ``handle.trace`` rides response metadata so the
+        router stitches one cross-pod timeline.  Ignored (zero-cost)
+        when tracing is off; a tracing ring with no context still
+        traces under a locally-minted trace id.
 
         ``deadline_s`` (serve.py: the ``X-Request-Deadline`` header):
         relative budget in seconds for the WHOLE generation.  When it
@@ -718,6 +759,9 @@ class ContinuousBatcher:
                        eos_token, wants_stream=stream,
                        deadline=(time.monotonic() + deadline_s
                                  if deadline_s is not None else None))
+        if self.tracer is not None:
+            req.trace = self.tracer.begin(ctx=trace_ctx,
+                                          request_id=request_id)
         req.priority = prio
         req.adapter = adapter
         req.adapter_idx = adapter_idx
@@ -886,6 +930,13 @@ class ContinuousBatcher:
             "dispatchesPerToken": (
                 round(self.stats["chunks"] / self._tokens_emitted, 4)
                 if self._tokens_emitted else 0.0),
+            # observability (ISSUE 15): the four latency histogram
+            # snapshots (cumulative counts for /metrics exposition,
+            # rolling-window counts for folding) and the window's TTFT
+            # p95 — what aggregate_fleet_serving folds fleet-wide and
+            # the SLO autoscaler reads instead of a point gauge
+            "latencyHist": self.hist.snapshot(),
+            "ttftP95Ms": round(self.hist.ttft.p95() or 0.0, 3),
             # fault tolerance (infer/resilience.py): drain/rebuild
             # visibility for /readyz and the CRD's status.serving block
             "draining": self._draining,
@@ -912,6 +963,10 @@ class ContinuousBatcher:
         prefill and their decode like any resident), cancel stragglers
         at the budget (their callers receive the tokens produced so
         far; paged blocks verifiably return to the pool), then close."""
+        self.flightrec.record(
+            "drain_start", residents=sum(r is not None
+                                         for r in self.lane),
+            parked=len(self._parked), queued=self._pending.qsize())
         self._draining = True
         self._wake.set()
         deadline = time.monotonic() + budget_s
@@ -930,6 +985,9 @@ class ContinuousBatcher:
                and self._thread.is_alive()
                and time.monotonic() < grace):
             time.sleep(0.02)
+        self.flightrec.record(
+            "drain_done", stragglers=sum(r is not None
+                                         for r in self.lane))
         self.close()
 
     def abort(self, error: Optional[Exception] = None) -> None:
@@ -937,6 +995,9 @@ class ContinuousBatcher:
         requests RESOLVE with their partial tokens (best-effort flush —
         an undrained kill would have lost them entirely); queued ones
         fail with ShuttingDown."""
+        self.flightrec.record("abort",
+                              error=(str(error)[:200] if error
+                                     else None))
         self._draining = True
         self._stop.set()
         self._wake.set()
@@ -1016,6 +1077,15 @@ class ContinuousBatcher:
             self.stats["watchdog_restarts"] += 1
         else:
             self.healthy = False
+        # flight recorder (ISSUE 15): the rebuild is exactly the event
+        # a crash-time dump exists for — record it and persist the
+        # whole ring NOW, before the backoff sleep a hard kill could
+        # land inside
+        self.flightrec.record("watchdog_rebuild",
+                              error=str(err)[:200], healing=healing,
+                              residents=sum(r is not None
+                                            for r in self.lane))
+        self.flightrec.dump_file("watchdog_rebuild")
         for req in list(self.lane):
             if req is not None and not req.done.is_set():
                 self._finish(req, wrapped)
@@ -1049,6 +1119,8 @@ class ContinuousBatcher:
                     and now >= req.deadline and not req.done.is_set()):
                 req.deadline_exceeded = True
                 self.stats["deadline_exceeded"] += 1
+                self.flightrec.record("deadline_expired", lane=i,
+                                      rid=req.request_id)
                 self._evict(i)        # resolves with the partial tokens
         # parked lanes keep their deadline semantics: an expired one
         # resolves with the tokens it had at the spill boundary (the
@@ -1149,6 +1221,18 @@ class ContinuousBatcher:
         hits stay inline — the suffix insert is already cheap)."""
         ex = self.executor
         n = len(req.prompt)
+        # queue-wait telemetry (ISSUE 15): submit -> this admission,
+        # observed into the queue-wait histogram (and, traced, a span
+        # carrying the QoS class) — the p95 the autoscaler's depth
+        # model can finally be checked against
+        now = time.monotonic()
+        self.hist.queue_wait.observe((now - req.t_submit) * 1e3)
+        if req.trace is not None:
+            req.trace.add("queue_wait", req.t_submit, now,
+                          prio=req.priority)
+        self.flightrec.record("admit", rid=req.request_id, slot=slot,
+                              prio=req.priority,
+                              mode=self.prefill_mode)
         # reserve the lane FIRST: the admin surface's in-use snapshot
         # (serve.py lanes_in_use) reads lane/parked/queue from another
         # thread, and a request popped from the queue but not yet
@@ -1308,6 +1392,7 @@ class ContinuousBatcher:
         n = len(req.prompt)
         sb = ex.prefill_chunk
         remaining = n - st.start
+        t_slice0 = time.monotonic()
         if remaining > sb:
             # intermediate slice: KV only, no logits, no lane state
             toks = np.zeros((1, sb), np.int32)
@@ -1329,6 +1414,9 @@ class ContinuousBatcher:
             self.stats["prefill_calls"] += 1
             self.stats["prefill_tokens"] += sb
             self.stats["chunked_prefill_tokens"] += sb
+            if req.trace is not None:
+                req.trace.add("prefill_slice", t_slice0,
+                              start=st.start - sb, tokens=sb)
             return
         # final slice
         toks = np.zeros((1, sb), np.int32)
@@ -1367,6 +1455,9 @@ class ContinuousBatcher:
         self.stats["prefill_calls"] += 1
         self.stats["prefill_tokens"] += remaining
         self.stats["chunked_prefill_tokens"] += remaining
+        if req.trace is not None:
+            req.trace.add("prefill_slice", t_slice0, start=st.start,
+                          tokens=remaining, final=True)
         del self._prefilling[slot]
         if self.paged:
             self.pool.publish(slot, req.prompt, ns=req.ns)
@@ -1419,6 +1510,7 @@ class ContinuousBatcher:
             self._activate(slot, req, first)
             return
         self._disagg_waiting[slot] = req
+        req.t_prefill0 = time.monotonic()
         ex.prefill_exec.submit(req, slot)
 
     def _land_handoff_blocks(self, slot: int, payload, lane, j0: int,
@@ -1506,11 +1598,19 @@ class ContinuousBatcher:
                     continue                # stale frame/final: drop
                 if kind == "frame":
                     _, _, _, payload, lane, j0, j1 = item
+                    t_fr0 = time.monotonic()
                     self._land_handoff_blocks(slot, payload, lane,
                                               j0, j1)
                     self.stats["handoff_frames"] += 1
                     self._handoff_frame_t.setdefault(slot, []).append(
                         time.monotonic())
+                    if req.trace is not None:
+                        # host time of the streamed-frame upload
+                        # dispatch (async — it overlaps the decoding
+                        # chunk; the overlap proof is the stats
+                        # counter, the span is the timeline marker)
+                        req.trace.add("handoff_frame", t_fr0, j0=j0,
+                                      j1=j1)
                     continue
                 _, _, _, payload, lane, j0, n_blocks, first, t_done = \
                     item
@@ -1594,6 +1694,13 @@ class ContinuousBatcher:
         DRAFT lane here, which is why the handoff snapshot never
         carries draft state — then publish + activate."""
         ex = self.executor
+        t_att0 = time.monotonic()
+        if req.trace is not None and req.t_prefill0 is not None:
+            # the whole off-ring prefill phase: executor-queue wait +
+            # prefill compute (+ the DCN wire, remote — whose own span
+            # the RemotePrefillClient stamps) up to this attach
+            req.trace.add("disagg_prefill", req.t_prefill0, t_att0,
+                          remote=bool(ex.prefill_remote))
         if self.spec_k:
             (ex.dcache, ex.cache["pos"], ex.tok, ex.temp,
              ex.keys) = ex.spec_attach(req.bucket)(
@@ -1608,6 +1715,8 @@ class ContinuousBatcher:
         self.stats["prefill_calls"] += 1
         self.stats["prefill_tokens"] += n
         self.stats["disagg_prefills"] += 1
+        if req.trace is not None:
+            req.trace.add("handoff_attach", t_att0, slot=slot)
         self.pool.publish(slot, req.prompt, ns=req.ns)
         self._activate(slot, req, first)
 
@@ -1622,6 +1731,17 @@ class ContinuousBatcher:
             return
         self._lane_first[i] = None
         t = int(fd)
+        # TTFT (ISSUE 15): submit -> the first token's host
+        # materialization, observed ONCE per request (adopted lanes
+        # produced their first token at the origin — ``t_first`` is
+        # pre-stamped there, so a migrated stream never double-counts)
+        now = time.monotonic()
+        if req.t_first is None:
+            req.t_first = now
+            self.hist.ttft.observe((now - req.t_submit) * 1e3)
+            if req.trace is not None:
+                req.trace.add("ttft", req.t_submit, now)
+        req.t_last_tok = now
         self._lane_out[i].append(t)
         self._tokens_emitted += 1
         if req._stream is not None:
@@ -1630,14 +1750,25 @@ class ContinuousBatcher:
         if req.eos is not None and t == req.eos:
             self._lane_left[i] = 0
 
-    @staticmethod
-    def _finish(req: _Request, error: Optional[Exception] = None) -> None:
+    def _finish(self, req: _Request,
+                error: Optional[Exception] = None) -> None:
         # a request that already RESOLVED keeps its outcome: attaching a
         # late error (e.g. the loop's shutdown sweep racing abort()'s
         # partial flush) would turn a delivered partial into a raise
         if error is not None and req.error is None \
                 and not req.done.is_set():
             req.error = error
+        if not req.done.is_set():
+            # e2e latency (ISSUE 15): successful resolutions only —
+            # deadline partials included (they ARE the request's e2e),
+            # errors excluded (a 503 shed in 2ms is not a latency)
+            if req.error is None:
+                self.hist.e2e.observe(
+                    (time.monotonic() - req.t_submit) * 1e3)
+            if req.trace is not None:
+                req.trace.finish(
+                    error=(type(req.error).__name__
+                           if req.error is not None else None))
         # done BEFORE the stream sentinel: a stream() consumer that sees
         # the close must find result() already resolvable
         req.done.set()
@@ -1742,7 +1873,13 @@ class ContinuousBatcher:
         if self._lane_left[slot] <= 0 or req.done.is_set():
             self._evict(slot)       # finished at the boundary anyway
             return
+        t_sp0 = time.monotonic()
         spill = self.executor.spill_lane(slot)
+        if req.trace is not None:
+            req.trace.add("spill", t_sp0,
+                          pos=int(self._lane_pos[slot]))
+        self.flightrec.record("preempt", rid=req.request_id,
+                              slot=slot, prio=req.priority)
         self._admit_seq += 1
         self._parked.append(_ParkedLane(
             req, spill, self._lane_out[slot], self._lane_left[slot],
@@ -1770,11 +1907,14 @@ class ContinuousBatcher:
                 self._finish(req)
             return True
         slot = self.lane.index(None)
+        t_rs0 = time.monotonic()
         try:
             self.executor.restore_lane(slot, pk.spill)
         except self.executor._pg.NoFreeBlocks:
             self.pool.retire(slot)  # roll back ensure's partial mapping
             return False
+        if req.trace is not None:
+            req.trace.add("restore", t_rs0, slot=slot)
         self._parked.remove(pk)
         self.lane[slot] = req
         self._lane_out[slot] = pk.out
@@ -1836,6 +1976,12 @@ class ContinuousBatcher:
                 # 504-partial-at-deadline contract
                 "deadlineS": (round(req.deadline - time.monotonic(), 3)
                               if req.deadline is not None else None),
+                # ISSUE 15: the origin's completed spans travel with
+                # the lane so the adopter's trace seeds from them and
+                # the stitched cross-pod timeline stays ONE tree (the
+                # adopter's request root parents onto the origin's)
+                "trace": (req.trace.to_wire()
+                          if req.trace is not None else None),
                 "fingerprint": self._fingerprint()}
 
     def adopt(self, meta: Dict[str, Any],
@@ -1907,6 +2053,26 @@ class ContinuousBatcher:
         req.adapter_idx = aidx
         req.ns = ns if aidx else 0
         req.request_id = meta.get("requestId")
+        # TTFT was produced (and observed) at the ORIGIN — pre-stamp
+        # t_first so this ring can never double-count a migrated
+        # stream's first token into its own TTFT histogram
+        req.t_first = time.monotonic()
+        if self.tracer is not None:
+            wire = meta.get("trace")
+            if isinstance(wire, dict) and wire.get("spans"):
+                # same trace id, parented on the ORIGIN's request root:
+                # the stitched timeline stays one parentless-root tree
+                req.trace = self.tracer.begin(
+                    ctx=(wire.get("traceId"), wire.get("rootId")),
+                    request_id=req.request_id)
+                req.trace.seed(wire["spans"])
+            else:
+                req.trace = self.tracer.begin(
+                    request_id=req.request_id)
+            req.trace.add("adopt", time.monotonic(),
+                          blocks=int(spill["n_blocks"]))
+        self.flightrec.record("adopt", rid=req.request_id,
+                              blocks=int(spill["n_blocks"]))
         spill = dict(spill)
         # adapter SLOT ids are replica-local: re-stamp with OUR slot
         if self.adapters is not None:
@@ -2008,6 +2174,8 @@ class ContinuousBatcher:
             pk, ok = self._migr_done.get_nowait()
             if pk not in self._parked:
                 continue    # healed/cancelled away mid-flight
+            self.flightrec.record("migrate_out", ok=bool(ok),
+                                  rid=pk.req.request_id)
             if ok:
                 self._parked.remove(pk)
                 self.stats["lane_migrations"] += 1
@@ -2161,12 +2329,15 @@ class ContinuousBatcher:
         and no token of the poisoned chunk reaches any consumer.  The
         other lanes are attention-independent, so their streams stay
         bit-identical to a fault-free run."""
+        now = time.monotonic()
         for i, req in chunk_reqs:
             if req is None or self.lane[i] is not req \
                     or req.done.is_set():
                 continue
             if ok is not None and not bool(ok[i]):
                 self.stats["quarantined_lanes"] += 1
+                self.flightrec.record("nan_quarantine", lane=i,
+                                      rid=req.request_id)
                 if self.pool is not None:
                     self._scrub_lane_blocks(i, req)
                 self._finish(req, LaneQuarantined(
@@ -2194,16 +2365,28 @@ class ContinuousBatcher:
                 # (a fused boundary's count is the device advance: full
                 # chunks while live, 0 once dead)
                 self._lane_pos[i] += n
+            emitted = 0
             for t in toks[:n, i]:
                 if self._lane_left[i] <= 0:
                     break
                 self._lane_out[i].append(int(t))
                 self._tokens_emitted += 1
+                emitted += 1
                 if req._stream is not None:
                     req._stream.put(int(t))
                 self._lane_left[i] -= 1
                 if req.eos is not None and int(t) == req.eos:
                     self._lane_left[i] = 0
+            if emitted:
+                # chunk-granular inter-token latency (ISSUE 15): the
+                # consume boundary is the host's only per-token clock;
+                # the mean gap over the chunk's tokens is observed once
+                # per lane-consume (docs/observability.md notes the
+                # granularity)
+                if req.t_last_tok is not None and now > req.t_last_tok:
+                    self.hist.itl.observe(
+                        (now - req.t_last_tok) * 1e3 / emitted)
+                req.t_last_tok = now
             if self._lane_left[i] <= 0:
                 self._evict(i)
 
@@ -2234,6 +2417,17 @@ class ContinuousBatcher:
         per = (time.monotonic() - t0) / res.n_steps
         self._step_s_est = (per if not self._step_s_est
                             else 0.8 * self._step_s_est + 0.2 * per)
+        # decode-phase spans (ISSUE 15): one span per consumed
+        # dispatch per traced lane, covering dispatch -> completion
+        # wait — megastep-granular by construction, and bounded by the
+        # RequestTrace span cap on long generations
+        if any(r is not None and r.trace is not None
+               for _, r in chunk_reqs):
+            t1 = time.monotonic()
+            for _, r in chunk_reqs:
+                if r is not None and r.trace is not None:
+                    r.trace.add("decode_dispatch", t0, t1,
+                                steps=res.n_steps)
         if self._fault is not None:
             return              # stall-failed chunks must not apply
         if res.n_steps == 1:
@@ -2358,8 +2552,16 @@ class ContinuousBatcher:
                     self._finish(req)
                     continue
                 slot = self.lane.index(None)
+                t_admit0 = time.monotonic()
                 try:
                     self._admit(slot, req)
+                    if req.trace is not None:
+                        # host time of the admission dispatch (inline:
+                        # the one compiled insert; chunked/disagg: the
+                        # block map/reserve — the slices/handoff get
+                        # their own spans)
+                        req.trace.add("admit", t_admit0, slot=slot,
+                                      mode=self.prefill_mode)
                 except Exception as e:          # bad request: fail it only
                     self._finish(req, e)
                     self.lane[slot] = None

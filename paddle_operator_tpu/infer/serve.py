@@ -153,7 +153,7 @@ class ContinuousGenerator:
                  top_p: Optional[float] = None,
                  eos_token: Optional[int] = None,
                  seed: int = 0) -> list:
-        rows, _, _ = self.generate_rows(
+        rows, _, _, _ = self.generate_rows(
             tokens, max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, eos_token=eos_token, seed=seed)
         return rows
@@ -166,14 +166,18 @@ class ContinuousGenerator:
                       request_id: Optional[str] = None,
                       deadline_s: Optional[float] = None,
                       priority: Optional[int] = None,
-                      adapter: Optional[str] = None):
+                      adapter: Optional[str] = None,
+                      trace_ctx=None):
         """Rows + per-row speculative accept rates (None entries when
         the ring is not speculative) + per-row deadline-exceeded flags
         (a flagged row carries the PARTIAL tokens produced before its
         ``deadline_s`` budget ran out — the handler's 504-style
-        response).  ``request_id`` (the client's, or the handler's
+        response) + per-row span sets (ISSUE 15 — None entries when
+        tracing is off; the router stitches them into one cross-pod
+        timeline).  ``request_id`` (the client's, or the handler's
         fallback) is threaded into ``submit`` per row so capacity
-        rejections name the offender."""
+        rejections name the offender; ``trace_ctx`` is the parsed
+        ``X-Tpujob-Trace`` context every row traces under."""
         if (top_k, top_p) != (self.batcher._top_k, self.batcher._top_p) \
                 and (top_k is not None or top_p is not None):
             raise ValueError(
@@ -196,7 +200,7 @@ class ContinuousGenerator:
                         temperature=temperature, seed=seed + i,
                         eos_token=eos_token, deadline_s=deadline_s,
                         priority=priority, adapter=adapter,
-                        request_id=rid_row)
+                        request_id=rid_row, trace_ctx=trace_ctx)
                 reqs.append(handle)
             # ragged rows: sequences stop at eos, no rectangular array
             rows = [r.result(timeout=600) for r in reqs]
@@ -209,7 +213,8 @@ class ContinuousGenerator:
                 r.cancel()
             raise
         return (rows, [r.accept_rate for r in reqs],
-                [r.deadline_exceeded for r in reqs])
+                [r.deadline_exceeded for r in reqs],
+                [getattr(r, "trace", None) for r in reqs])
 
     def close(self) -> None:
         self.batcher.close()
@@ -295,8 +300,10 @@ class _Handler(BaseHTTPRequestHandler):
             # per-pod prometheus gauges (the SAME names the manager
             # exports fleet-wide): the router scrapes
             # tpujob_serve_queue_depth / kv_blocks_free /
-            # tokens_per_sec from here to score replica load
+            # tokens_per_sec from here to score replica load — plus
+            # the latency histograms (ISSUE 15) it folds fleet-wide
             from paddle_operator_tpu.utils.observability import (
+                histogram_exposition,
                 serving_gauges,
             )
 
@@ -304,23 +311,38 @@ class _Handler(BaseHTTPRequestHandler):
             st = b.serving_status() if b is not None else {}
             gauges = serving_gauges(st, self.job_key,
                                     replica=self.replica_id or None)
-            body = "".join(f"{k} {v}\n"
-                           for k, v in sorted(gauges.items())).encode()
+            text = "".join(f"{k} {v}\n"
+                           for k, v in sorted(gauges.items()))
+            text += histogram_exposition(st.get("latencyHist"),
+                                         self.job_key,
+                                         self.replica_id or None)
+            body = text.encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/debug/flightrec":
+            # the pod's bounded event ring (ISSUE 15) — the same JSON
+            # a watchdog-restart/chaos/SIGTERM dump writes to disk
+            b = self._batcher()
+            fr = getattr(b, "flightrec", None) if b is not None else None
+            self._send(200, fr.dump("debug_endpoint") if fr is not None
+                       else {"events": []})
         else:
             self._send(404, {})
 
-    def _stream_generate(self, req) -> None:
+    def _stream_generate(self, req, trace_ctx=None,
+                         id_hdrs=None) -> None:
         """``"stream": true`` (continuous mode, single row): emit
         newline-delimited JSON events as the ring produces tokens —
         {"token": t} per generated token, then {"done": true, "tokens":
         [full sequence]}.  Chunked transfer; tokens arrive in
-        chunk-sized bursts (the ring's decode granularity)."""
+        chunk-sized bursts (the ring's decode granularity).  On a
+        tracing ring the done event carries the span set (the router's
+        streaming relay does not parse the stream, so streamed
+        timelines stitch client-side; docs/observability.md)."""
         gen = self.generator
         if not isinstance(gen, ContinuousGenerator):
             raise ValueError("streaming requires the continuous server "
@@ -344,7 +366,7 @@ class _Handler(BaseHTTPRequestHandler):
             stream=True, request_id=req.get("request_id"),
             deadline_s=req.get("deadline_s"),
             priority=int(prio) if prio is not None else None,
-            adapter=req.get("adapter"))
+            adapter=req.get("adapter"), trace_ctx=trace_ctx)
 
         def emit(obj) -> None:
             body = json.dumps(obj).encode() + b"\n"
@@ -360,6 +382,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(200)
             self.send_header("Content-Type", "application/x-ndjson")
             self.send_header("Transfer-Encoding", "chunked")
+            for k, v in (id_hdrs or {}).items():
+                self.send_header(k, str(v))
             self.end_headers()
             for tok in handle.stream(timeout=600):
                 emit({"token": tok})
@@ -368,6 +392,8 @@ class _Handler(BaseHTTPRequestHandler):
                 done_ev["accept_rate"] = handle.accept_rate
             if handle.deadline_exceeded:         # 504-style partial
                 done_ev["deadline_exceeded"] = True
+            if getattr(handle, "trace", None) is not None:
+                done_ev["trace"] = handle.trace.to_wire()
             emit(done_ev)
             self.wfile.write(b"0\r\n\r\n")
         except OSError:
@@ -467,6 +493,12 @@ class _Handler(BaseHTTPRequestHandler):
         except ShuttingDown as e:
             self._send(503, {"error": str(e)})
         except EnvelopeError as e:
+            # flight recorder (ISSUE 15): a refused envelope (CRC,
+            # fingerprint skew, truncation) is exactly the event fleet
+            # debugging needs a durable record of
+            fr = getattr(self._batcher(), "flightrec", None)
+            if fr is not None:
+                fr.record("envelope_refused", error=str(e)[:200])
             self._send(409, {"error": str(e)})
         except Exception as e:      # noqa: BLE001 — refuse, never crash
             self._send(400, {"error": str(e)})
@@ -556,12 +588,32 @@ class _Handler(BaseHTTPRequestHandler):
             phdr = self.headers.get("X-Request-Priority")
             if priority is None and phdr is not None:
                 priority = int(phdr)
+            # trace context (ISSUE 15): the router (or a client)
+            # propagates X-Tpujob-Trace; on a SERVE_TRACE=1 ring every
+            # row traces under it and the span sets ride the response
+            # so the router can stitch one cross-pod timeline
+            from paddle_operator_tpu.utils import tracing as _TR
+
+            trace_ctx = _TR.parse_trace_header(
+                self.headers.get(_TR.TRACE_HEADER))
+            # fleet-debugging identity (ISSUE 15 satellite): every
+            # generate reply names its request and serving replica.
+            # The id is CLIENT input — sanitize before echoing it into
+            # a header (CR/LF would split the response; non-latin-1
+            # raises inside send_header after the status line)
+            id_hdrs = {}
+            if req.get("request_id") is not None:
+                id_hdrs["X-Request-Id"] = _TR.safe_header_value(
+                    req.get("request_id"))
+            if self.replica_id:
+                id_hdrs["X-Tpujob-Replica"] = self.replica_id
             if req.get("stream"):
                 if deadline_s is not None:
                     req["deadline_s"] = float(deadline_s)
                 if priority is not None:
                     req["priority"] = int(priority)
-                return self._stream_generate(req)
+                return self._stream_generate(req, trace_ctx=trace_ctx,
+                                             id_hdrs=id_hdrs)
             tokens = np.asarray(req["tokens"], np.int32)
             if tokens.ndim != 2:
                 raise ValueError("tokens must be [batch, seq]")
@@ -576,31 +628,39 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(gen, ContinuousGenerator):
                 # request_id (client-supplied) flows into submit so
                 # validation errors in multi-request logs name their row
-                rows, rates, expired = gen.generate_rows(
+                rows, rates, expired, traces = gen.generate_rows(
                     tokens, request_id=req.get("request_id"),
                     deadline_s=(float(deadline_s)
                                 if deadline_s is not None else None),
                     priority=(int(priority)
                               if priority is not None else None),
                     adapter=req.get("adapter"),
+                    trace_ctx=trace_ctx,
                     **opts)
                 resp = {"tokens": rows}
                 if getattr(gen.batcher, "spec_k", 0) > 0:
                     # speculative ring: acceptance rides every response
                     resp["accept_rate"] = rates
+                if any(t is not None for t in traces):
+                    # per-row span sets (ISSUE 15): response metadata
+                    # only — the token payload is untouched, so traced
+                    # streams stay byte-identical to untraced ones
+                    resp["trace"] = [t.to_wire() if t is not None
+                                     else None for t in traces]
                 if any(expired):
                     # deadline partials: 504 when EVERY row ran out
                     # (the whole request missed its budget), 200 with
                     # per-row flags on a mixed batch — either way the
                     # partial tokens are delivered, never dropped
                     resp["deadline_exceeded"] = expired
-                    self._send(504 if all(expired) else 200, resp)
+                    self._send(504 if all(expired) else 200, resp,
+                               headers=id_hdrs)
                     return
-                self._send(200, resp)
+                self._send(200, resp, headers=id_hdrs)
                 return
             out = gen(tokens, **opts)
             out = out if isinstance(out, list) else out.tolist()
-            self._send(200, {"tokens": out})
+            self._send(200, {"tokens": out}, headers=id_hdrs)
         except (ShuttingDown, RetriableError) as e:
             # the request was fine, the server was not: an explicit
             # retry signal (drain shed, watchdog rebuild in progress)
@@ -874,6 +934,15 @@ def main() -> int:
         # (the first long prompt then pays the per-bucket insert
         # compile — the lazy-compile cliff the prewarm exists to hide)
         ring_kw["prewarm"] = os.environ.get("SERVE_PREWARM", "1") == "1"
+        # SERVE_TRACE=1 (ISSUE 15, docs/observability.md): per-request
+        # span capture — requests carry X-Tpujob-Trace contexts, phase
+        # spans ride response metadata, and the router stitches
+        # cross-pod timelines at /debug/tracez.  Off (default) every
+        # capture site is one attribute check; on, token streams are
+        # still byte-identical (host timestamps only — the serve-trace
+        # dryrun line pins both).  The latency histograms and the
+        # flight recorder are always on.
+        ring_kw["trace"] = os.environ.get("SERVE_TRACE", "0") == "1"
         # Multi-tenant QoS (ISSUE 10, docs/serving.md):
         # SERVE_PRIORITIES classes (0 most urgent; default 2, requests
         # default to the least urgent — opt-in boosts only), and the

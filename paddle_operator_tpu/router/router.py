@@ -59,12 +59,13 @@ import re
 import socket
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from http.client import HTTPConnection
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from paddle_operator_tpu.utils import tracing as TRC
 from paddle_operator_tpu.utils.radixkey import prefix_chain_key
 from paddle_operator_tpu.router.hashring import HashRing
 
@@ -112,6 +113,71 @@ _GAUGE_RE = re.compile(
 _ADAPTER_RE = re.compile(
     r'^tpujob_serve_adapter_loaded\{[^}]*adapter="(?P<name>[^"]*)"[^}]*\}'
     r"\s+1(?:\.0)?\s*$")
+
+
+# latency-histogram exposition lines (ISSUE 15): the per-replica
+# _bucket/_sum/_count families utils/observability.histogram_exposition
+# renders — the router folds them fleet-wide and derives the windowed
+# TTFT p95 the SLO autoscaler consumes
+_HIST_RE = re.compile(
+    r"^(?P<name>tpujob_serve_(?:ttft|itl|e2e|queue_wait)_ms)_"
+    r"(?P<part>bucket|sum|count)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[-+0-9.eE+Inf]+)\s*$")
+_LE_RE = re.compile(r'le="(?P<le>[^"]+)"')
+
+# the metric name -> family key map (inverse of tracing.HIST_FAMILIES)
+_HIST_KEYS = {name: fam for fam, name in TRC.HIST_FAMILIES.items()}
+
+# the rolling window the router's fleet p95 reads over: wide enough to
+# smooth scrape ticks and per-replica windows, narrow enough that a
+# resolved burst stops breaching within ~two windows
+ROUTER_HIST_WINDOW_S = 120.0
+
+
+def parse_serve_histograms(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse a replica's histogram exposition into snapshot-shaped
+    entries ``{family: {"buckets": [...], "counts": [...per-bucket,
+    +Inf last...], "sum": s, "count": n}}`` (the same shape
+    ``status.serving.latencyHist`` carries, so one fold —
+    tracing.fold_latency_hists — serves both paths).  Cumulative
+    ``_bucket`` lines are de-cumulated here."""
+    acc: Dict[str, Dict[str, Any]] = {}
+    for line in text.splitlines():
+        m = _HIST_RE.match(line.strip())
+        if m is None:
+            continue
+        fam = _HIST_KEYS.get(m.group("name"))
+        if fam is None:
+            continue
+        e = acc.setdefault(fam, {"les": [], "sum": 0.0, "count": 0})
+        part, raw = m.group("part"), m.group("value")
+        if part == "sum":
+            e["sum"] = float(raw)
+        elif part == "count":
+            e["count"] = int(float(raw))
+        else:
+            le = _LE_RE.search(m.group("labels") or "")
+            if le is None:
+                continue
+            bound = le.group("le")
+            e["les"].append((float("inf") if bound == "+Inf"
+                             else float(bound), int(float(raw))))
+    out: Dict[str, Dict[str, Any]] = {}
+    for fam, e in acc.items():
+        les = sorted(e["les"])
+        if not les:
+            continue
+        bounds = [b for b, _ in les if b != float("inf")]
+        cums = [c for _, c in les]
+        counts, prev = [], 0
+        for c in cums:
+            counts.append(max(0, c - prev))
+            prev = c
+        if les[-1][0] != float("inf"):
+            counts.append(max(0, e["count"] - prev))
+        out[fam] = {"buckets": bounds, "counts": counts,
+                    "sum": e["sum"], "count": e["count"]}
+    return out
 
 
 def parse_adapter_gauges(text: str) -> set:
@@ -209,6 +275,20 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
         if vals:
             agg[key] = round(sum(v * w for v, w in vals)
                              / (sum(w for _, w in vals) or 1.0), 4)
+    # latency histograms (ISSUE 15): fixed-bucket counts FOLD by
+    # addition — decode replicas only (prefill pods never emit a TTFT)
+    # — and the folded rolling window yields the one number a p95 can
+    # honestly be at fleet level (averaging per-replica p95s cannot:
+    # quantiles do not average)
+    lh = [b.get("latencyHist") for b in blocks
+          if isinstance(b.get("latencyHist"), dict)]
+    if lh:
+        folded = TRC.fold_latency_hists(lh)
+        if folded:
+            agg["latencyHist"] = folded
+            p95 = TRC.hist_p95(folded.get("ttft"))
+            if p95 is not None:
+                agg["ttftP95Ms"] = round(p95, 3)
     # prefill-pool fold (ISSUE 13): own keys, decode sums untouched
     if prefill:
         agg["prefillReplicasReporting"] = len(prefill)
@@ -269,6 +349,45 @@ class ReplicaState:
     adapters: set = field(default_factory=set)   # loaded LoRA adapters
     last_ok: float = 0.0                # monotonic time of last scrape
     consecutive_failures: int = 0
+    # latency histograms (ISSUE 15): the last parsed snapshot plus a
+    # short history of (t, snapshot) pairs — cumulative scraped counts
+    # turn into a rolling window by differencing against the oldest
+    # retained snapshot (the Prometheus rate() discipline, in-process)
+    hists: Dict[str, Any] = field(default_factory=dict)
+    hist_hist: Any = field(default_factory=deque)
+
+    def record_hists(self, hists: Dict[str, Any], now: float) -> None:
+        if not hists:
+            return
+        self.hists = hists
+        self.hist_hist.append((now, hists))
+        # keep the oldest snapshot as the first one at least a window
+        # old (entries younger than the window stay so it can slide)
+        while (len(self.hist_hist) > 1
+               and now - self.hist_hist[1][0]
+               >= ROUTER_HIST_WINDOW_S):
+            self.hist_hist.popleft()
+
+    def latency_hist_block(self) -> Optional[Dict[str, Any]]:
+        """Snapshot-shaped block with ``window`` = the delta against
+        the oldest retained scrape (full counts before a baseline
+        exists, and on a counter reset — replica restart — where a
+        negative delta would lie)."""
+        if not self.hists:
+            return None
+        old = (self.hist_hist[0][1]
+               if len(self.hist_hist) >= 2 else None)
+        out: Dict[str, Any] = {}
+        for fam, e in self.hists.items():
+            win = list(e.get("counts") or [])
+            oe = (old or {}).get(fam)
+            if oe and oe.get("buckets") == e.get("buckets"):
+                delta = [c - o for c, o in
+                         zip(e["counts"], oe["counts"])]
+                if all(v >= 0 for v in delta):
+                    win = delta
+            out[fam] = dict(e, window=win)
+        return out
 
     @property
     def queue_depth(self) -> float:
@@ -307,7 +426,8 @@ class FleetRouter:
                  vnodes: int = 64, retry_after_s: int = 1,
                  upstream_timeout: float = 600.0,
                  prefill_endpoints: Optional[List[str]] = None,
-                 prefill_endpoints_file: Optional[str] = None) -> None:
+                 prefill_endpoints_file: Optional[str] = None,
+                 trace: Optional[bool] = None) -> None:
         self.block_size = block_size
         self.affinity_blocks = affinity_blocks
         self.hot_queue_depth = hot_queue_depth
@@ -342,6 +462,20 @@ class FleetRouter:
         self._migrations: "OrderedDict[str, str]" = OrderedDict()
         self._migr_cap = 4096
         self._migr_inflight: set = set()
+        # tracing (ISSUE 15): one stitched cross-pod timeline per
+        # trace id, served at /debug/tracez.  Stitching activates per
+        # request when the inbound X-Tpujob-Trace header is present;
+        # ROUTER_TRACE=1 (or trace=True) additionally MINTS a trace
+        # for every generate so a fleet can be inspected without
+        # client cooperation.
+        self.trace_all = (os.environ.get("ROUTER_TRACE", "0") == "1"
+                          if trace is None else bool(trace))
+        self.traces = TRC.TraceStore(
+            cap=int(os.environ.get("ROUTER_TRACE_CAP", "256") or 256))
+        # dedupe replays echo the replica that SERVED the recorded
+        # result (ISSUE 15 satellite) — parallel to _results, pruned
+        # with it
+        self._result_replica: Dict[str, str] = {}
         self.counters: Dict[str, float] = {
             "routed_affinity": 0, "routed_spill": 0,
             "routed_least_loaded": 0, "routed_adapter": 0,
@@ -460,6 +594,8 @@ class FleetRouter:
                     text = body.decode()
                     st.gauges = parse_serve_gauges(text)
                     st.adapters = parse_adapter_gauges(text)
+                    st.record_hists(parse_serve_histograms(text),
+                                    time.monotonic())
                 st.last_ok = time.monotonic()
                 st.consecutive_failures = 0
             except (OSError, socket.timeout, ValueError):
@@ -777,18 +913,31 @@ class FleetRouter:
             return "new", None
 
     def dedupe_end(self, request_id: Optional[str], status: int,
-                   body: Optional[bytes]) -> None:
+                   body: Optional[bytes],
+                   replica: Optional[str] = None) -> None:
         """Record a completed RESULT (200 ok / 504 deadline partial —
         both resolve the request); 503s and errors are not results, so
-        a later retry runs for real."""
+        a later retry runs for real.  ``replica`` (ISSUE 15
+        satellite): the endpoint that served it, echoed on replay so a
+        deduped client can still tell which pod produced its result."""
         if request_id is None:
             return
         with self._lock:
             self._inflight.discard(request_id)
             if body is not None and status in (200, 504):
                 self._results[request_id] = (status, body)
+                if replica:
+                    self._result_replica[request_id] = replica
                 while len(self._results) > self._dedupe_cap:
-                    self._results.popitem(last=False)
+                    k, _ = self._results.popitem(last=False)
+                    self._result_replica.pop(k, None)
+
+    def replay_replica(self, request_id: Optional[str]
+                       ) -> Optional[str]:
+        if request_id is None:
+            return None
+        with self._lock:
+            return self._result_replica.get(request_id)
 
     # -- fleet status ------------------------------------------------------
 
@@ -805,10 +954,18 @@ class FleetRouter:
             per = {ep: dict(st.gauges, ready=st.ready)
                    for ep, st in self.replicas.items()}
             # prefill blocks join the aggregate under their scraped
-            # role marker so the fold stays role-aware
-            fleet_in = {ep: st.gauges
-                        for ep, st in self.replicas.items()
-                        if st.gauges}
+            # role marker so the fold stays role-aware; scraped
+            # latency histograms (ISSUE 15) ride each block so the
+            # fold derives the fleet ttftP95Ms the autoscaler reads
+            fleet_in = {}
+            for ep, st in self.replicas.items():
+                if not st.gauges and not st.hists:
+                    continue
+                blk: Dict[str, Any] = dict(st.gauges)
+                lh = st.latency_hist_block()
+                if lh:
+                    blk["latencyHist"] = lh
+                fleet_in[ep] = blk
             fleet_in.update({ep: dict(st.gauges, role="prefill")
                              for ep, st in self.prefill.items()
                              if st.gauges})
@@ -854,6 +1011,27 @@ class FleetRouter:
                 lines.append(
                     f"tpujob_router_prefill_queue_depth{lbl} "
                     f"{st.gauges.get('prefillQueueDepth', 0.0)}")
+            # fleet-folded latency histograms (ISSUE 15): the scraped
+            # per-replica families summed per bucket under the
+            # tpujob_fleet_* names — what a fleet dashboard's
+            # histogram_quantile should read, one scrape instead of N.
+            # Rendered by THE shared renderer (observability.
+            # render_histogram_lines) so the fleet and replica
+            # expositions cannot drift format-wise.
+            from paddle_operator_tpu.utils.observability import (
+                render_histogram_lines,
+            )
+
+            lh = [b for st in self.replicas.values()
+                  if (b := st.latency_hist_block())]
+            folded = TRC.fold_latency_hists(lh) if lh else {}
+            for fam, name in sorted(TRC.HIST_FAMILIES.items()):
+                e = folded.get(fam)
+                if not e:
+                    continue
+                lines.extend(render_histogram_lines(
+                    name.replace("tpujob_serve_", "tpujob_fleet_"),
+                    e))
             return "\n".join(lines) + "\n"
 
 
@@ -891,6 +1069,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
                            headers={"Retry-After": r.retry_after_s})
         elif self.path == "/statusz":
             self._send(200, r.statusz())
+        elif self.path.split("?", 1)[0] == "/debug/tracez":
+            # stitched cross-pod timelines (ISSUE 15): newest-last
+            # bounded LRU; ?trace_id= narrows to one
+            query = self.path.partition("?")[2]
+            tid = None
+            for part in query.split("&"):
+                k, _, v = part.partition("=")
+                if k == "trace_id" and v:
+                    tid = v
+            if tid is not None:
+                tl = r.traces.get(tid)
+                self._send(200 if tl else 404,
+                           tl or {"error": f"no timeline {tid}"})
+            else:
+                self._send(200, {"timelines": r.traces.timelines()})
         elif self.path == "/metrics":
             body = r.metrics_text().encode()
             self.send_response(200)
@@ -1037,11 +1230,32 @@ class _RouterHandler(BaseHTTPRequestHandler):
         first_row = tokens[0] if (isinstance(tokens, list) and tokens
                                   and isinstance(tokens[0], list)) \
             else tokens
+        # identity echo (ISSUE 15 satellite): EVERY reply names the
+        # request — without it a client cannot correlate fleet logs.
+        # Sanitized: the id is CLIENT input and send_header does no
+        # CR/LF or charset validation (response splitting / a
+        # mid-response UnicodeEncodeError on a non-latin-1 id)
+        id_hdrs = ({"X-Request-Id": TRC.safe_header_value(request_id)}
+                   if request_id is not None else {})
+        # trace context (ISSUE 15): honor an inbound header; with
+        # ROUTER_TRACE=1 mint one per generate.  One parentless root
+        # span per trace lives in the store — every proxy ATTEMPT
+        # (retries after a pod death included) parents under it, so a
+        # retried request stitches into ONE tree, never two.
+        ctx = TRC.parse_trace_header(
+            self.headers.get(TRC.TRACE_HEADER))
+        if ctx is None and r.trace_all:
+            ctx = (TRC.new_id(), None)
         state, recorded = r.dedupe_begin(request_id)
         if state == "replay":
             code, raw = recorded
-            self._send(code, None, raw=raw,
-                       headers={"X-Router-Dedupe": "replay"})
+            hdrs = dict(id_hdrs, **{"X-Router-Dedupe": "replay"})
+            rep = r.replay_replica(request_id)
+            if rep:
+                # the replica that SERVED the recorded result — the
+                # adopter after a migration — even on a cache replay
+                hdrs["X-Router-Replica"] = rep
+            self._send(code, None, raw=raw, headers=hdrs)
             return
         if state == "inflight":
             # the original is still running on some replica; re-running
@@ -1049,9 +1263,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # client to come back — by then the original has either
             # completed (replayed above) or failed (re-routed fresh).
             self._send(503, {"error": "request in flight"},
-                       headers=retry_hdr)
+                       headers=dict(retry_hdr, **id_hdrs))
             return
         status, result = 0, None
+        self.served_replica: Optional[str] = None
         try:
             # fleet-level KV (ISSUE 12): a retry whose lane migrated
             # routes to the ADOPTER — it holds (or is still decoding)
@@ -1066,7 +1281,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     with r._lock:
                         r.counters["routed_migrated"] += 1
                     status, result = self._proxy(mt, "migrated", body,
-                                                 req)
+                                                 req, trace=ctx,
+                                                 id_hdrs=id_hdrs)
                     return
             try:
                 ep, reason = r.choose(first_row,
@@ -1076,24 +1292,59 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # would 400 this — so must the router, or the client
                 # burns its whole retry budget on a connection reset
                 # for a permanently-bad request
-                self._send(400, {"error": f"bad tokens: {e}"})
+                self._send(400, {"error": f"bad tokens: {e}"},
+                           headers=id_hdrs)
                 return
             if ep is None:
                 self._send(503, {"error": "no ready replica"},
-                           headers=retry_hdr)
+                           headers=dict(retry_hdr, **id_hdrs))
                 return
-            status, result = self._proxy(ep, reason, body, req)
+            status, result = self._proxy(ep, reason, body, req,
+                                         trace=ctx, id_hdrs=id_hdrs)
         finally:
-            r.dedupe_end(request_id, status, result)
+            r.dedupe_end(request_id, status, result,
+                         replica=self.served_replica)
 
     def _proxy(self, endpoint: str, reason: str, body: bytes,
-               req: Dict[str, Any]) -> Tuple[int, Optional[bytes]]:
+               req: Dict[str, Any], trace=None,
+               id_hdrs=None) -> Tuple[int, Optional[bytes]]:
         """Forward to ``endpoint``; returns (status, recordable body) —
-        body None for streams/errors (not dedupe-recordable)."""
+        body None for streams/errors (not dedupe-recordable).
+        ``trace`` (ISSUE 15): the ``(trace_id, parent)`` context — the
+        forward carries ``X-Tpujob-Trace`` with a fresh attempt-span
+        id, and the replica's span set (response metadata) stitches
+        into the trace's timeline."""
         r = self.router
         host, _, port = endpoint.rpartition(":")
         conn = HTTPConnection(host, int(port),
                               timeout=r.upstream_timeout)
+        attempt_id = root_id = None
+        t_att_wall = time.time() * 1e3
+        t_att0 = time.monotonic()
+        if trace is not None:
+            tid, parent = trace
+            root_id = r.traces.root(tid, parent=parent,
+                                    request_id=req.get("request_id")
+                                    )["id"]
+            attempt_id = TRC.new_id()
+
+        def stitch(status: int, payload: Optional[bytes]) -> None:
+            if trace is None:
+                return
+            spans = [TRC.make_span(
+                "proxy", root_id, t_att_wall,
+                (time.monotonic() - t_att0) * 1e3,
+                span_id=attempt_id, pod="router", replica=endpoint,
+                reason=reason, status=status)]
+            if payload:
+                try:
+                    rows = json.loads(payload).get("trace") or []
+                    for row in rows:
+                        if isinstance(row, dict):
+                            spans.extend(row.get("spans") or [])
+                except (ValueError, AttributeError):
+                    pass        # non-JSON / traceless payload
+            r.traces.add(trace[0], spans)
         # under the lock: handler threads race, and the SIGTERM drain
         # gates on this counter reaching zero — a lost update either
         # burns the whole drain budget or truncates a live stream
@@ -1110,11 +1361,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
             phdr = self.headers.get("X-Request-Priority")
             if phdr:
                 headers["X-Request-Priority"] = phdr
+            if trace is not None:
+                # the replica's request root parents under THIS
+                # attempt's span — the cross-pod tree by construction
+                headers[TRC.TRACE_HEADER] = TRC.format_trace_header(
+                    trace[0], attempt_id)
             conn.request("POST", "/v1/generate", body=body,
                          headers=headers)
             resp = conn.getresponse()
-            passthrough = {"X-Router-Replica": endpoint,
-                           "X-Router-Reason": reason}
+            self.served_replica = endpoint
+            passthrough = dict(id_hdrs or {},
+                               **{"X-Router-Replica": endpoint,
+                                  "X-Router-Reason": reason})
             ra = resp.getheader("Retry-After")
             if ra is not None:
                 passthrough["Retry-After"] = ra
@@ -1153,8 +1411,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     self.wfile.write(b"0\r\n\r\n")
                 except OSError:
                     pass          # downstream client went away
+                stitch(resp.status, None)  # attempt span only: the
+                # relay never parses the stream (docs/observability.md)
                 return resp.status, None   # streams are not replayable
             payload = resp.read()
+            stitch(resp.status,
+                   payload if resp.status in (200, 504) else None)
             # the UPSTREAM result is in hand: from here on a failure is
             # the downstream client's socket, not the replica's — it
             # must neither mark the replica unready nor lose the
@@ -1170,14 +1432,20 @@ class _RouterHandler(BaseHTTPRequestHandler):
         except (OSError, socket.timeout):
             # the replica vanished mid-proxy (drain finished, pod gone):
             # mark it down NOW and hand the client the same retryable
-            # 503 a draining replica would have sent
+            # 503 a draining replica would have sent.  The failed
+            # attempt still stitches into the timeline — a
+            # retry-after-pod-death trace SHOWS the death.
+            self.served_replica = None
+            stitch(503, None)
             r.mark_unready(endpoint)
             with r._lock:
                 r.counters["upstream_errors"] += 1
             try:
                 self._send(503, {"error":
                                  f"replica {endpoint} unreachable"},
-                           headers={"Retry-After": r.retry_after_s})
+                           headers=dict(id_hdrs or {},
+                                        **{"Retry-After":
+                                           r.retry_after_s}))
             except OSError:
                 pass
             return 503, None

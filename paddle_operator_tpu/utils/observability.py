@@ -24,13 +24,37 @@ _FMT = "%(asctime)s %(levelname).1s %(name)s %(message)s"
 
 
 def get_logger(name: str = "tpujob") -> logging.Logger:
+    """Structured logger with a rank prefix derived from the
+    ENVIRONMENT AT CALL TIME.
+
+    The prefix/level are re-derived on every call (ISSUE 15
+    satellite): the original handlers-already-attached check froze the
+    FIRST caller's ``TPUJOB_RANK``/``TPUJOB_LOG_LEVEL`` forever —
+    subprocess test workers (tests/ft_worker.py) and re-launched
+    trainers inherit the parent's logger registry and logged under a
+    stale rank.  Still idempotent: exactly one handler per logger no
+    matter how often this is called; the formatter/level only update
+    when the env actually changed."""
     logger = logging.getLogger(name)
-    if not logger.handlers:
+    rank = os.environ.get("TPUJOB_RANK", "0")
+    level = os.environ.get("TPUJOB_LOG_LEVEL", "INFO")
+    h = next((h for h in logger.handlers
+              if getattr(h, "_tpujob_rank", None) is not None), None)
+    if h is None:
+        if logger.handlers:
+            # an application configured this logger itself (its own
+            # handlers, its own level) — defer to it, exactly as the
+            # original handlers-present check did; only OUR handler
+            # is ever re-stamped
+            return logger
         h = logging.StreamHandler()
-        rank = os.environ.get("TPUJOB_RANK", "0")
-        h.setFormatter(logging.Formatter(f"[rank {rank}] {_FMT}"))
+        h._tpujob_rank = ""          # marks OUR handler; set below
         logger.addHandler(h)
-        logger.setLevel(os.environ.get("TPUJOB_LOG_LEVEL", "INFO"))
+    if h._tpujob_rank != rank:
+        h.setFormatter(logging.Formatter(f"[rank {rank}] {_FMT}"))
+        h._tpujob_rank = rank
+    if logging.getLevelName(logger.level) != level:
+        logger.setLevel(level)
     return logger
 
 
@@ -256,6 +280,58 @@ def _serving_gauges_one(status_serving: dict, job: str,
         f"tpujob_serve_draining{lbl}":
             1.0 if status_serving.get("draining") else 0.0,
     }
+
+
+def histogram_exposition(latency_hist: Optional[dict], job: str,
+                         replica: str = None) -> str:
+    """Prometheus ``_bucket``/``_sum``/``_count`` exposition for one
+    pod's ``status.serving.latencyHist`` block (ISSUE 15) — rendered
+    NEXT TO the gauges on a replica's ``/metrics`` (serve.py) so the
+    router's scrape folds real latency distributions fleet-wide.
+
+    Lives here (not inline in serve.py) so the metric names cannot
+    drift from the docs/observability.md catalog the doc-drift test
+    pins.  Separate from :func:`serving_gauges` on purpose: gauges are
+    a flat name->float dict callers sort, which would interleave
+    bucket lines lexicographically (le="16" before le="2"); histogram
+    exposition must keep its bounds in increasing order."""
+    if not isinstance(latency_hist, dict) or not latency_hist:
+        return ""
+    from paddle_operator_tpu.utils import tracing as TR
+
+    rep = f',replica="{replica}"' if replica else ""
+    labels = f'{{job="{job}"{rep}}}'
+    lines = []
+    for fam, name in sorted(TR.HIST_FAMILIES.items()):
+        entry = latency_hist.get(fam)
+        if isinstance(entry, dict):
+            lines.extend(render_histogram_lines(name, entry, labels))
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def render_histogram_lines(name: str, entry: dict,
+                           labels: str = "") -> list:
+    """One histogram snapshot entry -> Prometheus
+    ``_bucket``/``_sum``/``_count`` lines (cumulative buckets in bound
+    order, then +Inf).  THE one renderer — the replica-level
+    ``tpujob_serve_*`` exposition above and the router's fleet-folded
+    ``tpujob_fleet_*`` re-export both call it, so the two surfaces'
+    bucket/rounding format cannot drift apart."""
+    bounds = entry.get("buckets") or []
+    counts = entry.get("counts") or []
+    base = labels[:-1] + "," if labels else "{"
+    lines, cum = [], 0
+    for b, c in zip(bounds, counts):
+        cum += int(c)
+        le = int(b) if float(b).is_integer() else b
+        lines.append(f'{name}_bucket{base}le="{le}"}} {cum}')
+    lines.append(f'{name}_bucket{base}le="+Inf"}} '
+                 f'{int(entry.get("count", 0))}')
+    lines.append(f'{name}_sum{labels} '
+                 f'{round(float(entry.get("sum", 0.0)), 3)}')
+    lines.append(f'{name}_count{labels} '
+                 f'{int(entry.get("count", 0))}')
+    return lines
 
 
 @contextlib.contextmanager

@@ -316,6 +316,11 @@ class TestBatcherServingStatus:
             st = b.serving_status()
         finally:
             b.close()
+        # observability block (ISSUE 15): one TTFT/e2e observation per
+        # resolved request, snapshot shape the fold consumes
+        assert st["latencyHist"]["ttft"]["count"] == 1
+        assert st["latencyHist"]["e2e"]["count"] == 1
+        assert st["ttftP95Ms"] > 0
         assert set(st) == {"tokensPerSec", "acceptRate", "queueDepth",
                            "tokensTotal", "activeLanes", "lanePos",
                            "prefixHitRate", "kvBlocksFree", "kvBlocksHwm",
@@ -342,6 +347,10 @@ class TestBatcherServingStatus:
                            "prefillLanes", "prefillBatchOccupancy",
                            "prefillHolWaitMs", "handoffFrames",
                            "overlappedFrames",
+                           # observability block (ISSUE 15): latency
+                           # histogram snapshots + the windowed TTFT
+                           # p95 the SLO autoscaler reads
+                           "latencyHist", "ttftP95Ms",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
                            "watchdogRestarts", "quarantinedLanes"}
