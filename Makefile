@@ -65,11 +65,13 @@ bench:
 # CPU dry-run gate: entry forward + the 8-virtual-device multichip run
 # (all training parallelism axes, plus the serving parity lines:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
-# serve-disagg, serve-kvquant, serve-hostcache, serve-fleet,
-# serve-qos, serve-megastep, serve-fleetkv, serve-xdisagg,
-# serve-prefillpool, serve-trace — tracing-on parity vs the
-# tracing-off oracle + cross-pod span-tree completeness + the chaos
-# flight-recorder dump naming its fault — and ft-drain)
+# serve-disagg, serve-kvquant, serve-wquant — int8 weight codes
+# within the pinned logit bound of the bf16 oracle at tp=1+tp=2 with
+# every quantized admission path token-identical — serve-hostcache,
+# serve-fleet, serve-qos, serve-megastep, serve-fleetkv,
+# serve-xdisagg, serve-prefillpool, serve-trace — tracing-on parity
+# vs the tracing-off oracle + cross-pod span-tree completeness + the
+# chaos flight-recorder dump naming its fault — and ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

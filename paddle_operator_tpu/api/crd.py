@@ -248,6 +248,16 @@ def _spec_schema() -> Dict[str, Any]:
                     # iterations per compiled dispatch (SERVE_MEGASTEP;
                     # 0/unset = the server's single-step default)
                     "megastep": _int(0),
+                    # serving-side weight quantization (ISSUE 16):
+                    # storage mode for the target / speculative-draft
+                    # param trees on every replica
+                    # (SERVE_WEIGHT_QUANT / SERVE_DRAFT_QUANT; unset =
+                    # the bf16 default).  enum'd so a typo'd mode is
+                    # an apiserver 400, not a silently-bf16 fleet
+                    "weightQuant": {"type": "string",
+                                    "enum": ["int8", "int4"]},
+                    "draftQuant": {"type": "string",
+                                   "enum": ["int8", "int4"]},
                     # fleet-level KV (ISSUE 12): drain-by-migration +
                     # router-brokered lane migration
                     # (SERVE_KV_MIGRATE), peer prefix fetch from the

@@ -492,6 +492,20 @@ class ServingSpec:
       also migrate to an idle peer OUTSIDE a drain (0 disables) ->
       SERVE_MIGRATE_PARKED_S.
 
+    Serving-side weight quantization (ISSUE 16, docs/serving.md
+    "Quantized weights"):
+
+    - ``weight_quant``     storage mode for the TARGET model's matmul
+      kernels on every replica ("int8" / "int4"; ""/unset keeps the
+      bf16 default) -> SERVE_WEIGHT_QUANT.  Quantized at checkpoint
+      load with the serving skip list (embeddings/lm_head/norms stay
+      bf16); prefill-pool pods inherit the knob so handed-off KV
+      matches;
+    - ``draft_quant``      same for the speculative DRAFT model ->
+      SERVE_DRAFT_QUANT.  The safe proving ground: spec verify
+      tolerates draft drift, so this is a pure accept-rate/latency
+      trade.
+
     Cross-host disaggregation + SLO autoscaling (ISSUE 13):
 
     - ``prefill_pool``     a :class:`PrefillPoolSpec` — prefill
@@ -518,6 +532,8 @@ class ServingSpec:
     adapter_rank: int = 0
     max_adapters: int = 0
     megastep: int = 0
+    weight_quant: str = ""
+    draft_quant: str = ""
     kv_migration: Optional[bool] = None
     peer_prefix_fetch: Optional[bool] = None
     host_cache_mb: int = 0
@@ -549,6 +565,10 @@ class ServingSpec:
             d["maxAdapters"] = self.max_adapters
         if self.megastep:
             d["megastep"] = self.megastep
+        if self.weight_quant:
+            d["weightQuant"] = self.weight_quant
+        if self.draft_quant:
+            d["draftQuant"] = self.draft_quant
         if self.kv_migration is not None:
             d["kvMigration"] = self.kv_migration
         if self.peer_prefix_fetch is not None:
@@ -582,6 +602,8 @@ class ServingSpec:
             adapter_rank=int(d.get("adapterRank", 0)),
             max_adapters=int(d.get("maxAdapters", 0)),
             megastep=int(d.get("megastep", 0)),
+            weight_quant=str(d.get("weightQuant", "") or ""),
+            draft_quant=str(d.get("draftQuant", "") or ""),
             kv_migration=(bool(d["kvMigration"])
                           if d.get("kvMigration") is not None else None),
             peer_prefix_fetch=(bool(d["peerPrefixFetch"])

@@ -649,6 +649,15 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
         # device-resident megastep (ISSUE 11): fused iterations per
         # compiled dispatch — spec.serving.megastep -> SERVE_MEGASTEP
         _env_setdefault(env, "SERVE_MEGASTEP", str(sv.megastep))
+    # serving-side weight quantization (ISSUE 16): target/draft param
+    # storage mode — unset keeps the server's bf16 default.  Prefill
+    # pods with a derived template inherit the serving container's env
+    # wholesale, so SERVE_WEIGHT_QUANT reaches them automatically (the
+    # handoff fingerprint refuses a mixed fleet regardless).
+    if sv.weight_quant:
+        _env_setdefault(env, "SERVE_WEIGHT_QUANT", sv.weight_quant)
+    if sv.draft_quant:
+        _env_setdefault(env, "SERVE_DRAFT_QUANT", sv.draft_quant)
     # fleet-level KV (ISSUE 12): spec knobs -> SERVE_* surface.  The
     # broker is the fleet's stable client Service — it fronts the
     # router pod, whose /v1/kv/migrate picks adopters from its scrape

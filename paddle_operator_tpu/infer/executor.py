@@ -2069,6 +2069,20 @@ class RingExecutor:
                 total += int(np.prod(buf.shape)) * buf.dtype.itemsize
         return total
 
+    def param_bytes(self) -> int:
+        """HBM bytes of the params tree(s) this ring dispatches (target
+        + draft when speculative) — the ``tpujob_serve_param_bytes``
+        gauge, pool_bytes()'s weight-side sibling.  Pure shape
+        arithmetic, no device sync; int8 code leaves count 1 byte/param
+        + their f32 scale planes, so the gauge shows the quantization
+        saving directly."""
+        from paddle_operator_tpu.infer import quant as Q
+
+        total = Q.param_bytes(self.params)
+        if getattr(self, "draft_params", None) is not None:
+            total += Q.param_bytes(self.draft_params)
+        return total
+
     # -- host spill tier: demote fetch + batched promote (ISSUE 8) --------
 
     def _demote_fetch(self, blk: int) -> Dict[str, Any]:

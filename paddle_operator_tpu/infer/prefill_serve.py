@@ -60,19 +60,25 @@ PREFILL_TIMEOUT_S = 120.0
 
 def handoff_fingerprint(cfg, *, block_size: int, kv_quant: str,
                         top_k: Optional[int],
-                        top_p: Optional[float]) -> Dict[str, Any]:
+                        top_p: Optional[float],
+                        wquant: str = "none") -> Dict[str, Any]:
     """The geometry + sampling rule a handoff envelope must match.
     Narrower than the lane-migration fingerprint on purpose: spec
     depth is absent (the DRAFT lane prefills decode-side at attach —
     the snapshot is target KV only) and tp is absent (host bytes
     re-shard through the promote scatter).  top-k/top-p ARE included:
     the prefill pod samples the FIRST token, so a sampling-rule skew
-    would silently break bit-identity with the in-process path."""
+    would silently break bit-identity with the in-process path.
+    ``wquant`` (ISSUE 16) is the WEIGHT quant mode: handed-off KV is a
+    function of the weights that produced it, so a bf16 prefill pod
+    feeding an int8 decode ring would silently break token-identity
+    with the in-process cold path — refuse the mixed fleet instead."""
     return {"layers": int(cfg.n_layers),
             "kvHeads": int(cfg.n_kv_heads),
             "headDim": int(cfg.head_dim),
             "blockSize": int(block_size),
             "quant": kv_quant,
+            "wquant": wquant,
             "topK": top_k, "topP": top_p}
 
 
@@ -131,11 +137,16 @@ class PrefillFrontend:
         from paddle_operator_tpu.infer import decode as D
         from paddle_operator_tpu.infer import executor as X
 
+        from paddle_operator_tpu.infer import quant as Q
+
         if mesh is not None and D.mesh_tp(mesh) > 1:
             params = D.shard_params_for_serving(params, cfg, mesh)
         self.cfg = cfg
         self.block_size = int(block_size)
         self.kv_quant = kv_quant
+        # detected, not configured: the leaf types of the tree actually
+        # dispatched decide the fingerprint (matches the decode side)
+        self.wquant = Q.weight_quant_mode(params)
         self.quant = kv_quant == "int8"
         self.top_k, self.top_p = top_k, top_p
         self.lanes = max(1, int(lanes))
@@ -173,7 +184,8 @@ class PrefillFrontend:
     def fingerprint(self) -> Dict[str, Any]:
         return handoff_fingerprint(
             self.cfg, block_size=self.block_size,
-            kv_quant=self.kv_quant, top_k=self.top_k, top_p=self.top_p)
+            kv_quant=self.kv_quant, top_k=self.top_k, top_p=self.top_p,
+            wquant=self.wquant)
 
     def depth(self) -> int:
         with self._lock:
@@ -944,6 +956,20 @@ def main() -> int:
     ckpt = CheckpointManager()
     state, resumed = resume_or_init(ckpt, init)
     params = serving_params(state.params, cfg.dtype)
+    # SERVE_WEIGHT_QUANT=int8|int4: match the decode fleet's weight
+    # quantization — handed-off KV is a function of the weights that
+    # produced it, so a mixed fleet breaks token-identity with the
+    # in-process cold path.  builders.py derives this pod's env from
+    # the serving container, so the knob arrives automatically; the
+    # handoff fingerprint refuses skew regardless.
+    wq = os.environ.get("SERVE_WEIGHT_QUANT", "none") or "none"
+    if wq != "none":
+        from paddle_operator_tpu.infer.quant import (
+            SERVING_SKIP,
+            quantize_params,
+        )
+
+        params = quantize_params(params, cfg, mode=wq, skip=SERVING_SKIP)
     mesh = None
     tp = int(os.environ.get("SERVE_TP", "1"))
     if tp > 1:
@@ -972,6 +998,7 @@ def main() -> int:
             "SERVE_PREFILL_PREFIX_BLOCKS", "256") or 0))
     print(f"prefill pool {os.environ.get('MODEL_PRESET', '7b')} "
           f"(resumed={resumed}, tp={tp}, kv_quant={kv_quant}, "
+          f"weight_quant={wq}, "
           f"lanes={lanes}, max_len={max_len}) on :{env.port}",
           flush=True)
     budget = float(os.environ.get("SERVE_DRAIN_BUDGET_S", "30"))

@@ -832,6 +832,23 @@ class ContinuousBatcher:
             return pe.lanes
         return getattr(pe, name)()
 
+    def weight_quant_mode(self) -> str:
+        """Weight-quant storage mode of the TARGET params actually
+        dispatched ("none"/"int8"/"int4") — detected from leaf dtypes
+        (infer/quant.py), not a threaded flag, so the status block
+        stays truthful about the tree on device."""
+        from paddle_operator_tpu.infer import quant as Q
+
+        return Q.weight_quant_mode(getattr(self.executor, "params", {}))
+
+    def draft_quant_mode(self) -> str:
+        """Weight-quant mode of the DRAFT params ("none" on
+        non-speculative rings) — SERVE_DRAFT_QUANT's visibility."""
+        from paddle_operator_tpu.infer import quant as Q
+
+        dp = getattr(self.executor, "draft_params", None)
+        return Q.weight_quant_mode(dp) if dp is not None else "none"
+
     def serving_status(self) -> Dict[str, Any]:
         """The ``TPUJob.status.serving`` block (camelCase, like
         GoodputTracker.to_status): cumulative served-token throughput,
@@ -892,6 +909,15 @@ class ContinuousBatcher:
             # operator sizes num_blocks against
             "kvQuantMode": self.kv_quant,
             "kvPoolBytes": self.executor.pool_bytes(),
+            # weight quantization (SERVE_WEIGHT_QUANT /
+            # SERVE_DRAFT_QUANT): storage mode of the target and draft
+            # param trees actually dispatched (detected from leaf
+            # dtypes) and their summed HBM bytes — the
+            # tpujob_serve_weight_quant_mode / _param_bytes gauges; the
+            # bytes gauge shows the quantization saving directly
+            "weightQuantMode": self.weight_quant_mode(),
+            "draftQuantMode": self.draft_quant_mode(),
+            "paramBytes": self.executor.param_bytes(),
             "chunkedPrefillTokenShare": (
                 round(self.stats["chunked_prefill_tokens"] / pf_tok, 4)
                 if pf_tok else 0.0),
@@ -1954,7 +1980,7 @@ class ContinuousBatcher:
         return handoff_fingerprint(
             self.cfg, block_size=self.executor.block_size,
             kv_quant=self.kv_quant, top_k=self._top_k,
-            top_p=self._top_p)
+            top_p=self._top_p, wquant=self.weight_quant_mode())
 
     def _migration_meta(self, pk: _ParkedLane) -> Dict[str, Any]:
         """The JSON half of a lane envelope: request identity + stream

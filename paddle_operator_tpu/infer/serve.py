@@ -789,6 +789,22 @@ def main() -> int:
         from paddle_operator_tpu.infer.quant import quantize_params
 
         params = quantize_params(params)   # ~1.4-1.5x decode at batch 8
+    # SERVE_WEIGHT_QUANT=int8|int4 (docs/serving.md "Quantized
+    # weights"): quantize the TARGET model's matmul kernels at load —
+    # per-output-channel absmax codes + f32 scale planes replacing the
+    # kernel leaves, dequant fused at the matmul sites, with the serving
+    # skip list (embeddings / lm_head / norms stay bf16).  The codes
+    # ride the params dispatch operand, so bf16-default processes trace
+    # byte-identical programs.  SERVE_DRAFT_QUANT (below, spec rings
+    # only) is the safe proving ground: quantize the draft first.
+    wq = os.environ.get("SERVE_WEIGHT_QUANT", "none") or "none"
+    if wq != "none":
+        from paddle_operator_tpu.infer.quant import (
+            SERVING_SKIP,
+            quantize_params,
+        )
+
+        params = quantize_params(params, cfg, mode=wq, skip=SERVING_SKIP)
     # opt-in: continuous mode fixes top_k/top_p server-side, so flipping
     # it on by default would 400 existing clients that pass them
     continuous = os.environ.get("SERVE_CONTINUOUS", "0") == "1"
@@ -1004,9 +1020,23 @@ def main() -> int:
                 dstate, _ = resume_or_init(CheckpointManager(dpath), dinit)
             else:
                 dstate = dinit()
+            dparams = serving_params(dstate.params, dcfg.dtype)
+            # SERVE_DRAFT_QUANT=int8|int4: quantize the DRAFT only.
+            # Spec verify tolerates draft drift by construction — a
+            # coarser draft can only lower accept rate, never change
+            # emitted tokens — so this is a pure accept-rate/latency
+            # trade and the proving ground before SERVE_WEIGHT_QUANT.
+            dwq = os.environ.get("SERVE_DRAFT_QUANT", "none") or "none"
+            if dwq != "none":
+                from paddle_operator_tpu.infer.quant import (
+                    SERVING_SKIP,
+                    quantize_params,
+                )
+
+                dparams = quantize_params(dparams, dcfg, mode=dwq,
+                                          skip=SERVING_SKIP)
             ring_kw.update(
-                draft_params=serving_params(dstate.params, dcfg.dtype),
-                draft_cfg=dcfg, spec_k=spec_k)
+                draft_params=dparams, draft_cfg=dcfg, spec_k=spec_k)
     # SERVE_TP=n: tensor-parallel serving over the pod's first n chips
     # (weights a single chip cannot hold — the 7B-on-v5e case).  The
     # mesh carries only the tp axis; DP is separate server replicas.
@@ -1019,6 +1049,8 @@ def main() -> int:
     print(f"serving {os.environ.get('MODEL_PRESET', '7b')} "
           f"(resumed={resumed}, "
           f"quantize={os.environ.get('QUANTIZE', 'off')}, "
+          f"weight_quant={wq}, "
+          f"draft_quant={os.environ.get('SERVE_DRAFT_QUANT', 'none') or 'none'}, "
           f"tp={tp}, spec_k={spec_k if continuous else 0}, "
           f"prefill={ring_kw.get('prefill_mode', 'inline') if continuous else '-'}, "
           f"kv_quant={ring_kw.get('kv_quant', 'none') if continuous else '-'}, "

@@ -226,6 +226,22 @@ def _serving_gauges_one(status_serving: dict, job: str,
         ("tpujob_serve_kv_pool_bytes"
          f'{{job="{job}"{rep},mode="{status_serving.get("kvQuantMode", "none")}"}}'):
             float(status_serving.get("kvPoolBytes", 0.0)),
+        # weight quantization (SERVE_WEIGHT_QUANT / SERVE_DRAFT_QUANT):
+        # a marker gauge labeled with the target and draft storage
+        # modes (value 1 when either tree is quantized, 0 on bf16
+        # fleets — the labels, not the value, carry the modes), and
+        # the params-tree HBM bytes (target + draft; codes + scale
+        # planes) so dashboards show the weight-side saving next to
+        # the KV pool's
+        ("tpujob_serve_weight_quant_mode"
+         f'{{job="{job}"{rep}'
+         f',mode="{status_serving.get("weightQuantMode", "none")}"'
+         f',draft="{status_serving.get("draftQuantMode", "none")}"}}'):
+            float(status_serving.get("weightQuantMode", "none") != "none"
+                  or status_serving.get("draftQuantMode", "none")
+                  != "none"),
+        f"tpujob_serve_param_bytes{lbl}":
+            float(status_serving.get("paramBytes", 0.0)),
         # hierarchical KV cache (SERVE_HOST_CACHE_MB/_BLOCKS): blocks
         # resident in the host spill tier, the share of looked-up
         # prefix tokens served from host payloads (promote path), and

@@ -176,6 +176,36 @@ class TestFleetMaterialization:
         assert "SERVE_KV_MIGRATE" not in names
         assert "SERVE_KV_BROKER" not in names
 
+    def test_weight_quant_spec_maps_to_serve_env(self):
+        """ISSUE 16: spec.serving.weightQuant / draftQuant reach every
+        replica as SERVE_WEIGHT_QUANT / SERVE_DRAFT_QUANT, survive the
+        apiserver dict round-trip, and — when unset — emit NO env so
+        the server's bf16 default stays in charge."""
+        from paddle_operator_tpu.api.types import ServingSpec
+
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        job = TPUJob(name="wq", namespace=NS, spec=TPUJobSpec(
+            serving=ServingSpec(
+                replicas=1, template=TMPL, weight_quant="int8",
+                draft_quant="int4")))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, NS, "wq")
+        pod = api.get("Pod", NS, "wq-serve-0")
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env["SERVE_WEIGHT_QUANT"] == "int8"
+        assert env["SERVE_DRAFT_QUANT"] == "int4"
+        # round-trip through the apiserver dict form
+        sv = TPUJob.from_dict(api.get(KIND_JOB, NS, "wq")).spec.serving
+        assert (sv.weight_quant, sv.draft_quant) == ("int8", "int4")
+        # unset: no env injected (bf16 default)
+        api2, rec2, _ = _setup(replicas=1)
+        pod2 = api2.get("Pod", NS, "fj-serve-0")
+        names = {e["name"] for e in pod2["spec"]["containers"][0]["env"]}
+        assert "SERVE_WEIGHT_QUANT" not in names
+        assert "SERVE_DRAFT_QUANT" not in names
+
     def test_user_env_wins_over_injected_defaults(self):
         api = FakeAPI()
         rec = TPUJobReconciler(api)

@@ -26,6 +26,8 @@ SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "prefillMode": "chunked", "prefillQueueDepth": 2,
            "chunkedPrefillTokenShare": 0.85,
            "kvQuantMode": "int8", "kvPoolBytes": 4096,
+           "weightQuantMode": "int8", "draftQuantMode": "int4",
+           "paramBytes": 8192,
            "hostCacheBlocks": 5, "hostHitRate": 0.12,
            "promotedBlocks": 42,
            "priorityQueueDepth": [1, 2], "preemptedLanes": 3,
@@ -54,6 +56,12 @@ class TestGaugeNaming:
         # storage mode, mirroring the prefill queue-depth label scheme
         assert g['tpujob_serve_kv_pool_bytes'
                  '{job="default/j",mode="int8"}'] == 4096.0
+        # weight-quant gauges (ISSUE 16): a marker carrying both the
+        # target and draft storage modes as labels (value 1 when either
+        # is quantized) plus the params-tree HBM bytes
+        assert g['tpujob_serve_weight_quant_mode'
+                 '{job="default/j",mode="int8",draft="int4"}'] == 1.0
+        assert g['tpujob_serve_param_bytes{job="default/j"}'] == 8192.0
         # hierarchical-cache gauges (ISSUE 8): host-tier residency,
         # host-served prefix-token share, cumulative promotions
         assert g['tpujob_serve_host_cache_blocks'
@@ -103,6 +111,8 @@ class TestGaugeNaming:
                 '{job="ns/x",mode="inline"}') in g
         assert ('tpujob_serve_kv_pool_bytes'
                 '{job="ns/x",mode="none"}') in g
+        assert ('tpujob_serve_weight_quant_mode'
+                '{job="ns/x",mode="none",draft="none"}') in g
 
     def test_missing_keys_default_zero(self):
         g = serving_gauges({}, "ns/x")
@@ -125,6 +135,11 @@ class TestGaugeNaming:
             '{job="default/j"}',
             'tpujob_serve_kv_pool_bytes'
             '{job="default/j",mode="int8"}',
+            # weight-quant shape (ISSUE 16): mode marker (target +
+            # draft labels) and the params-tree bytes gauge
+            'tpujob_serve_weight_quant_mode'
+            '{job="default/j",mode="int8",draft="int4"}',
+            'tpujob_serve_param_bytes{job="default/j"}',
             'tpujob_serve_host_cache_blocks{job="default/j"}',
             'tpujob_serve_host_hit_rate{job="default/j"}',
             'tpujob_serve_promoted_blocks_total{job="default/j"}',
@@ -329,6 +344,9 @@ class TestBatcherServingStatus:
                            "chunkedPrefillTokenShare",
                            # quantized-pool block (ISSUE 7)
                            "kvQuantMode", "kvPoolBytes",
+                           # weight-quant block (ISSUE 16)
+                           "weightQuantMode", "draftQuantMode",
+                           "paramBytes",
                            # hierarchical-cache block (ISSUE 8)
                            "hostCacheBlocks", "hostHitRate",
                            "promotedBlocks",
@@ -357,6 +375,9 @@ class TestBatcherServingStatus:
         assert st["prefillMode"] == "inline"
         assert st["prefillQueueDepth"] == 0
         assert st["kvQuantMode"] == "none"     # bf16 default
+        assert st["weightQuantMode"] == "none"  # bf16 params default
+        assert st["draftQuantMode"] == "none"  # non-speculative ring
+        assert st["paramBytes"] > 0
         assert st["hostCacheBlocks"] == 0      # tier off by default
         assert st["hostHitRate"] == 0.0
         assert st["promotedBlocks"] == 0
