@@ -21,16 +21,16 @@ test: native
 # seed.
 #
 # Preflight: orphaned `infer.serve` / `infer.prefill_serve` / `router`
-# / `router.simfleet` processes leaked by a previous session each burn
-# ~5% CPU and ~700MB RSS FOREVER and corrupt tier-1 timing on this
-# contended box (ROADMAP budget note) — detect them BEFORE the timed
-# run and fail loudly with their PIDs so the operator kills them
-# instead of chasing a phantom slowdown.  (`router` alternation also
-# matches `router.simfleet` subprocess replicas; `prefill_serve` needs
-# its own alternation — "infer.serve" is not a substring of
-# "infer.prefill_serve".)
+# / `router.simfleet` / `infer.kvstore` (store janitor) processes
+# leaked by a previous session each burn CPU and RSS FOREVER and
+# corrupt tier-1 timing on this contended box (ROADMAP budget note) —
+# detect them BEFORE the timed run and fail loudly with their PIDs so
+# the operator kills them instead of chasing a phantom slowdown.
+# (`router` alternation also matches `router.simfleet` subprocess
+# replicas; `prefill_serve` needs its own alternation — "infer.serve"
+# is not a substring of "infer.prefill_serve".)
 tier1:
-	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet' || true); \
+	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet|paddle_operator_tpu\.infer\.kvstore' || true); \
 	if [ -n "$$pids" ]; then \
 		echo "tier1 preflight FAILED: orphaned serve/router process(es) from a previous session:"; \
 		ps -o pid,etime,rss,args -p $$pids || true; \
@@ -71,7 +71,11 @@ bench:
 # serve-fleet, serve-qos, serve-megastep, serve-fleetkv,
 # serve-xdisagg, serve-prefillpool, serve-trace — tracing-on parity
 # vs the tracing-off oracle + cross-pod span-tree completeness + the
-# chaos flight-recorder dump naming its fault — and ft-drain)
+# chaos flight-recorder dump naming its fault — serve-kvstore —
+# fleet-restart durable-store hits bit-identical to cold prefill
+# through the normal promote path at tp=1+tp=2 x quant off/on, with
+# the store-off default byte-identical to the pre-store ring — and
+# ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

@@ -933,6 +933,163 @@ def measure_hierarchical_cache(cfg, params, *, n_prompts: int = 8,
     return out
 
 
+def measure_kv_store(cfg, params, *, n_prompts: int = 6,
+                     prompt_len: int = 256, new_tokens: int = 8,
+                     block_size: int = 32, chunk: int = 4,
+                     max_len: int = None,
+                     kv_quants=("none", "int8")) -> list:
+    """Durable-prefix-store sweep (ISSUE 17, docs/serving.md): the
+    fleet-restart warm-start path — serve a shared-prefix corpus on a
+    store-backed ring whose host tier is too small to hold it (the
+    overflow spills to disk), tear the fleet down COMPLETELY, then
+    re-serve the same corpus on a fresh ring over the same store dir.
+
+    Per quant mode the row reports the LIVE revisit hit rate (HBM +
+    host + store re-probe on the original ring), the RESTART hit rate
+    (every hit the fresh ring gets comes off disk through the
+    import -> batched-promote path), their ratio (the >=0.8x
+    acceptance bar), the cold-vs-store-hit TTFT split (cold = the
+    seed round's full prefills; a store hit re-prefills only the
+    partial tail block), and stored bytes per block — the int8 leg
+    pins the `kvstore_bytes_per_block_int8` halving claim.  Absolute
+    TTFTs are CPU-einsum physics; the rates, the ratio, and the
+    stored-bytes accounting are real allocator/store behavior."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from paddle_operator_tpu.infer import decode as ID
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+    from paddle_operator_tpu.infer.kvstore import DirBackend, KVBlockStore
+
+    max_len = max_len or (prompt_len + new_tokens)
+    bpp = -(-prompt_len // block_size)          # blocks per prompt
+    # one lane's worst case under the ROUNDED cache allocation — the
+    # pool floor the allocator itself enforces
+    lane_blocks = -(-ID.cache_alloc_len(max_len) // block_size)
+    # pool ~25% of the working set (forces demotion churn); host tier
+    # holds exactly ONE prompt's chain — big enough that a store
+    # import lands whole (uniform covered length -> one suffix bucket,
+    # warmed outside the timed probes), small enough that the rest of
+    # the working set overflows to the store
+    pool_blocks = max(lane_blocks, (n_prompts * bpp) // 4)
+    host_blocks = bpp + 1
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (prompt_len,)).tolist()
+               for _ in range(n_prompts)]
+
+    def reset_prefix_stats(b):
+        b.pool.stats.update(prefix_lookup_tokens=0, prefix_hit_tokens=0,
+                            prefix_lookups=0, prefix_full_hits=0,
+                            host_hit_tokens=0)
+
+    def probe_ttft(b, p):
+        t1 = time.perf_counter()
+        probe = b.submit(p, max_new_tokens=new_tokens, stream=True)
+        next(probe.stream(timeout=600))
+        dt = (time.perf_counter() - t1) * 1000
+        probe.result(timeout=600)
+        return dt
+
+    out = []
+    for kv_quant in kv_quants:
+        root = tempfile.mkdtemp(prefix="tpujob-kvstore-bench-")
+
+        def ring():
+            return ContinuousBatcher(
+                params, cfg, slots=1, max_len=max_len,
+                chunk_tokens=chunk,
+                prefill_buckets=(prompt_len, max_len), paged=True,
+                block_size=block_size, num_blocks=pool_blocks,
+                host_cache_blocks=host_blocks, kv_quant=kv_quant,
+                prewarm=True)
+
+        def attach(b):
+            s = KVBlockStore(DirBackend(root),
+                             fingerprint=b._fingerprint())
+            b.attach_kv_store(s)
+            return s
+
+        try:
+            # --- live fleet: seed (cold, timed) + revisit (timed)
+            a = ring()
+            store_a = attach(a)
+            try:
+                a.prewarmed.wait(timeout=600)
+                t_cold = [probe_ttft(a, p) for p in prompts]
+                # warm the revisit compile set outside the timed probes
+                a.submit(prompts[0],
+                         max_new_tokens=new_tokens).result(timeout=600)
+                reset_prefix_stats(a)
+                for p in prompts:
+                    probe_ttft(a, p)
+                live_rate = a.pool.hit_rate()
+                spills = a.pool.stats["store_spills"]
+                assert store_a.flush(), "store writer failed to drain"
+                a.pool.check_invariant()
+            finally:
+                a.close()                       # the FULL teardown
+                store_a.close()
+            blocks, size = store_a.usage()
+
+            # --- fleet restart: a fresh ring over the same store dir
+            b = ring()
+            store_b = attach(b)
+            try:
+                b.prewarmed.wait(timeout=600)
+                # warm probe (also the restart's first store hit);
+                # its TTFT is excluded, its hit tokens are not yet
+                # counted — the timed round below re-visits everything
+                b.submit(prompts[0],
+                         max_new_tokens=new_tokens).result(timeout=600)
+                reset_prefix_stats(b)
+                t_hit, t_miss = [], []
+                for p in prompts:
+                    hits0 = b.stats["kv_store_hits"]
+                    dt = probe_ttft(b, p)
+                    (t_hit if b.stats["kv_store_hits"] > hits0
+                     else t_miss).append(dt)
+                restart_rate = b.pool.hit_rate()
+                fetched = store_b.stats["blocks_fetched"]
+                b.pool.check_invariant()
+            finally:
+                b.close()
+                store_b.close()
+
+            row = {
+                "kvstore_quant": kv_quant,
+                "kvstore_pool_blocks": pool_blocks,
+                "kvstore_host_blocks": host_blocks,
+                "kvstore_store_blocks": blocks,
+                "kvstore_store_mb": round(size / 1e6, 2),
+                "kvstore_bytes_per_block": (round(size / blocks)
+                                            if blocks else 0),
+                "kvstore_spilled_blocks": spills,
+                "kvstore_fetched_blocks": fetched,
+                "kvstore_live_hit_rate": live_rate,
+                "kvstore_restart_hit_rate": restart_rate,
+                "kvstore_ttft_cold_p50_ms": round(_pctl(t_cold, 0.5), 1),
+                "kvstore_ttft_cold_p95_ms": round(_pctl(t_cold, 0.95), 1),
+            }
+            if live_rate:
+                row["kvstore_restart_vs_live"] = round(
+                    restart_rate / live_rate, 3)
+            if t_hit:
+                row["kvstore_ttft_hit_p50_ms"] = round(
+                    _pctl(t_hit, 0.5), 1)
+                row["kvstore_hit_probes"] = len(t_hit)
+                # >1.0: a store hit beats re-prefilling the corpus cold
+                row["kvstore_hit_ttft_ratio"] = round(
+                    _pctl(t_cold, 0.5) / _pctl(t_hit, 0.5), 2)
+            if t_miss:
+                row["kvstore_miss_probes"] = len(t_miss)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+        out.append(row)
+    return out
+
+
 def measure_qos(cfg, params, *, slots: int = 2, prompt_len: int = 16,
                 p0_new: int = 8, p1_new: int = 48, probes: int = 6,
                 backlog: int = 8, max_len: int = 128,
@@ -3210,6 +3367,45 @@ def main() -> int:
                         cold / host, 2)
         else:
             emit("hier_sweep", hier)
+
+        # durable-prefix-store sweep on CPU (ISSUE 17): the fleet-
+        # restart warm-start path — corpus served, fleet torn down,
+        # fresh ring re-serves off the store dir.  The restart-vs-live
+        # hit-rate ratio (>=0.8x bar), the store-hit TTFT beating the
+        # cold re-prefill, and the int8 bytes/block halving are real
+        # store/allocator behavior; absolute TTFTs are CPU physics
+        def cpu_kvstore():
+            from paddle_operator_tpu.infer.quant import serving_params
+
+            tcfg = dataclasses.replace(L.CONFIGS["tiny"],
+                                       max_seq_len=128)
+            tparams = serving_params(L.Llama(tcfg).init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"], tcfg.dtype)
+            # small shape: the sweep builds FOUR prewarmed rings (live
+            # + restart, bf16 + int8) and the prewarm ladder is the
+            # dominant CPU cost — the rates/ratios it reports are
+            # shape-independent allocator/store behavior
+            return measure_kv_store(tcfg, tparams, n_prompts=6,
+                                    prompt_len=64, new_tokens=8,
+                                    block_size=8, chunk=8,
+                                    max_len=96)
+
+        kvs_rows = guarded("kvstore", cpu_kvstore)
+        if isinstance(kvs_rows, list):
+            for entry in kvs_rows:
+                emit("kvstore_sweep", entry)
+            by_q = {e.get("kvstore_quant"): e for e in kvs_rows}
+            top = by_q.get("none") or kvs_rows[-1]
+            summary["kvstore_restart_hit_rate"] = top.get(
+                "kvstore_restart_hit_rate")
+            summary["kvstore_hit_ttft_ratio"] = top.get(
+                "kvstore_hit_ttft_ratio")
+            if "int8" in by_q:
+                summary["kvstore_bytes_per_block_int8"] = \
+                    by_q["int8"].get("kvstore_bytes_per_block")
+        else:
+            emit("kvstore_sweep", kvs_rows)
 
         # multi-tenant QoS sweep on CPU (ISSUE 10): the p0-vs-flood
         # TTFT split, the preempt->spill->restore device cost and the

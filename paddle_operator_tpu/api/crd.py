@@ -269,6 +269,17 @@ def _spec_schema() -> Dict[str, Any]:
                     "peerPrefixFetch": {"type": "boolean"},
                     "hostCacheMb": _int(0),
                     "migrateParkedS": {"type": "number", "minimum": 0},
+                    # durable prefix store (ISSUE 17): persistent KV
+                    # tier below host/peer cache — store URL
+                    # ("dir:/path"; SERVE_KV_STORE), janitor TTL by
+                    # last-touch age (SERVE_KV_STORE_TTL_S) and LRU
+                    # size budget (SERVE_KV_STORE_BUDGET_MB).
+                    # pattern'd so a typo'd scheme is an apiserver
+                    # 400, not a silently store-less fleet
+                    "kvStore": {"type": "string",
+                                "pattern": "^dir:/.+"},
+                    "kvStoreTtlS": {"type": "number", "minimum": 0},
+                    "kvStoreBudgetMb": _int(0),
                     # cross-host disaggregation (ISSUE 13): prefill
                     # executors in their OWN pods (standalone prefill
                     # servers decode replicas hand cold prompts to

@@ -492,6 +492,20 @@ class ServingSpec:
       also migrate to an idle peer OUTSIDE a drain (0 disables) ->
       SERVE_MIGRATE_PARKED_S.
 
+    Durable prefix store (ISSUE 17, docs/serving.md "Durable prefix
+    store"):
+
+    - ``kv_store``           store URL ("dir:/path"; a shared volume
+      mount makes it fleet-wide) — host-tier overflow drops persist
+      here and the probe order becomes peer -> store ->
+      SERVE_KV_STORE (needs a host tier — size one with
+      ``host_cache_mb``);
+    - ``kv_store_ttl_s``     janitor expiry for store entries by
+      last-touch age (0 = no TTL) -> SERVE_KV_STORE_TTL_S;
+    - ``kv_store_budget_mb`` store size budget; the janitor evicts
+      LRU-by-last-touch past it (0 = unbounded) ->
+      SERVE_KV_STORE_BUDGET_MB.
+
     Serving-side weight quantization (ISSUE 16, docs/serving.md
     "Quantized weights"):
 
@@ -537,6 +551,9 @@ class ServingSpec:
     kv_migration: Optional[bool] = None
     peer_prefix_fetch: Optional[bool] = None
     host_cache_mb: int = 0
+    kv_store: str = ""
+    kv_store_ttl_s: float = 0.0
+    kv_store_budget_mb: int = 0
     migrate_parked_s: float = 0.0
     prefill_pool: Optional[PrefillPoolSpec] = None
     autoscale: Optional[AutoscaleSpec] = None
@@ -575,6 +592,12 @@ class ServingSpec:
             d["peerPrefixFetch"] = self.peer_prefix_fetch
         if self.host_cache_mb:
             d["hostCacheMb"] = self.host_cache_mb
+        if self.kv_store:
+            d["kvStore"] = self.kv_store
+        if self.kv_store_ttl_s:
+            d["kvStoreTtlS"] = self.kv_store_ttl_s
+        if self.kv_store_budget_mb:
+            d["kvStoreBudgetMb"] = self.kv_store_budget_mb
         if self.migrate_parked_s:
             d["migrateParkedS"] = self.migrate_parked_s
         if self.prefill_pool is not None:
@@ -610,6 +633,9 @@ class ServingSpec:
                                if d.get("peerPrefixFetch") is not None
                                else None),
             host_cache_mb=int(d.get("hostCacheMb", 0)),
+            kv_store=str(d.get("kvStore", "") or ""),
+            kv_store_ttl_s=float(d.get("kvStoreTtlS", 0.0)),
+            kv_store_budget_mb=int(d.get("kvStoreBudgetMb", 0)),
             migrate_parked_s=float(d.get("migrateParkedS", 0.0)),
             prefill_pool=PrefillPoolSpec.from_dict(
                 d.get("prefillPool")),

@@ -674,6 +674,19 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
     if sv.host_cache_mb:
         _env_setdefault(env, "SERVE_HOST_CACHE_MB",
                         str(sv.host_cache_mb))
+    # durable prefix store (ISSUE 17): spec.serving.kvStore -> the
+    # replica env surface.  The URL is passed through verbatim (a
+    # dir: path on a shared volume mount makes the store fleet-wide);
+    # TTL/budget knobs ride along only when set so an unset spec
+    # stays byte-identical to a store-less pod.
+    if sv.kv_store:
+        _env_setdefault(env, "SERVE_KV_STORE", sv.kv_store)
+        if sv.kv_store_ttl_s:
+            _env_setdefault(env, "SERVE_KV_STORE_TTL_S",
+                            str(sv.kv_store_ttl_s))
+        if sv.kv_store_budget_mb:
+            _env_setdefault(env, "SERVE_KV_STORE_BUDGET_MB",
+                            str(sv.kv_store_budget_mb))
     if sv.migrate_parked_s:
         _env_setdefault(env, "SERVE_MIGRATE_PARKED_S",
                         str(sv.migrate_parked_s))
@@ -844,6 +857,12 @@ def construct_router_pod(job: TPUJob) -> Dict[str, Any]:
         _env_setdefault(
             env, "ROUTER_PREFILL_ENDPOINTS_FILE",
             f"{ROUTER_ENDPOINTS_MOUNT}/TPUJOB_PREFILL_REPLICAS")
+    # durable prefix store (ISSUE 17): on a shared dir: volume the
+    # router consults the store directly when the /v1/kv/prefix owner
+    # misses — same URL as the replicas (the user mounts the volume in
+    # both pod templates)
+    if sv.kv_store:
+        _env_setdefault(env, "ROUTER_KV_STORE", sv.kv_store)
     mounts = c0.setdefault("volumeMounts", [])
     if not any(m.get("name") == "fleet-endpoints" for m in mounts):
         mounts.append({"name": "fleet-endpoints",

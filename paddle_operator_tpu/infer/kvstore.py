@@ -1,0 +1,473 @@
+"""Durable prefix store — the persistent KV tier below host/peer cache
+(ISSUE 17).
+
+The cache hierarchy above this module is HBM -> host RAM
+(``paged.HostCacheTier``) -> live peers (fleet prefix fetch), and every
+byte of it dies with the fleet: a full deploy, a scale-to-zero, or a
+rolling restart re-prefills the entire shared-prompt corpus from
+scratch.  Mooncake and AttentionStore both put the KV of long-lived
+shared prefixes in a disaggregated persistent store below DRAM; we
+already have the two ingredients they had to invent — a
+self-describing, CRC'd, fingerprint-refusing wire envelope
+(``utils/fleetkv.py``) and a process-stable radix chain key
+(``utils/radixkey.py``) — so this store is a new tier speaking an
+EXISTING protocol, not a new protocol.
+
+One store entry is one demoted block payload, wrapped in a fleetkv
+envelope of kind ``"kvblock"`` whose meta carries the chain key, the
+namespace, the raw token chunk (so a hash collision is caught by the
+same equality check the radix walk uses) and the ring fingerprint.
+Everything the envelope already refuses — truncation, CRC mismatch,
+version skew, fingerprint skew — the store refuses too, wholesale, and
+garbage-collects the offending file: a store can never poison a ring.
+
+Write path: the host tier's overflow drops (previously a silent
+discard) are offered to a BACKGROUND writer thread through a bounded
+drop-oldest queue — the ring thread never blocks on disk.  Files land
+via write-tmp+rename, so a crash mid-write leaves only a ``*.tmp``
+orphan that readers never see (the janitor sweeps it).
+
+Read path: the submit-thread probe order becomes peer -> store; a
+store hit is queued through the exact ``import_host_blocks`` -> host
+tier -> batched promote scatter path a peer fetch uses, so a store hit
+is bit-identical to a cold prefill by the same construction the
+host/peer tiers already pin.
+
+Lifecycle: a janitor pass applies TTL (last-touch mtime) then a size
+budget (LRU by mtime), and ``python -m paddle_operator_tpu.infer.kvstore``
+runs the same pass offline against a shared volume.
+
+This module must stay import-light (NO jax): the fleet router is a
+jax-free process and consults the store directly on a peer miss when
+``ROUTER_KV_STORE`` points at a shared ``dir:`` volume.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_operator_tpu.utils import fleetkv as FK
+from paddle_operator_tpu.utils.radixkey import chain_key
+
+KIND = "kvblock"
+_SUFFIX = ".tpkv"
+# a *.tmp older than this is a torn write from a dead process — the
+# janitor may reclaim it (a LIVE writer renames within milliseconds)
+TMP_REAP_S = 300.0
+
+_tmp_seq = itertools.count()
+
+
+def parse_store_url(url: str) -> "DirBackend":
+    """``SERVE_KV_STORE`` / ``ROUTER_KV_STORE`` value -> backend.
+    ``dir:/path`` is the local-disk (or shared-volume) backend; the
+    scheme prefix exists so an object-store backend can be a second
+    implementation of the same small interface behind a new scheme."""
+    url = url.strip()
+    scheme, _, rest = url.partition(":")
+    if scheme == "dir" and rest:
+        return DirBackend(rest)
+    raise ValueError(
+        f"unsupported KV store url {url!r} (expected dir:/path)")
+
+
+class DirBackend:
+    """Directory-per-namespace block files, one fleetkv envelope each.
+
+    The interface the store needs from any backend is deliberately
+    small — ``put`` (atomic), ``get``, ``exists``, ``touch``,
+    ``delete``, ``entries`` (size + last-touch listing for the
+    janitor), ``sweep_tmp`` — so an object-store backend is a second
+    impl of the same methods, not a rewrite of the store."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _ns_dir(self, ns: int) -> str:
+        return os.path.join(self.root, f"ns{int(ns)}")
+
+    def path(self, ns: int, key: int) -> str:
+        # chain keys are arbitrary-width Python ints and may be
+        # NEGATIVE (hash of a tuple) — encode the sign explicitly,
+        # hex for compactness
+        k = int(key)
+        sign = "n" if k < 0 else "p"
+        return os.path.join(self._ns_dir(ns),
+                            f"{sign}{abs(k):x}{_SUFFIX}")
+
+    def put(self, ns: int, key: int, blob: bytes) -> None:
+        """Atomic publish: write a sibling ``*.tmp``, fsync, rename.
+        A reader can never observe a torn entry — it sees the old
+        file, the new file, or nothing."""
+        final = self.path(ns, key)
+        os.makedirs(os.path.dirname(final), exist_ok=True)
+        tmp = f"{final}.{os.getpid()}.{next(_tmp_seq)}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+
+    def get(self, ns: int, key: int) -> Optional[bytes]:
+        try:
+            with open(self.path(ns, key), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, NotADirectoryError, IsADirectoryError):
+            return None
+
+    def exists(self, ns: int, key: int) -> bool:
+        return os.path.isfile(self.path(ns, key))
+
+    def touch(self, ns: int, key: int) -> None:
+        """Stamp last-touch time — the janitor's LRU/TTL clock."""
+        try:
+            os.utime(self.path(ns, key), None)
+        except OSError:
+            pass
+
+    def delete(self, ns: int, key: int) -> None:
+        try:
+            os.remove(self.path(ns, key))
+        except OSError:
+            pass
+
+    def entries(self) -> List[Tuple[str, int, float]]:
+        """Every published entry as ``(path, size, last_touch)``."""
+        out: List[Tuple[str, int, float]] = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                if not fn.endswith(_SUFFIX):
+                    continue
+                p = os.path.join(dirpath, fn)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                out.append((p, int(st.st_size), float(st.st_mtime)))
+        return out
+
+    def sweep_tmp(self, max_age_s: float = TMP_REAP_S) -> int:
+        """Reap torn-write ``*.tmp`` orphans older than ``max_age_s``."""
+        now = time.time()
+        reaped = 0
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                if not fn.endswith(".tmp"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                try:
+                    if now - os.stat(p).st_mtime >= max_age_s:
+                        os.remove(p)
+                        reaped += 1
+                except OSError:
+                    continue
+        return reaped
+
+
+class KVBlockStore:
+    """The durable tier: a backend + a background writer + a janitor.
+
+    ``fingerprint`` is the owning ring's geometry dict
+    (``ContinuousBatcher._fingerprint()``); ``None`` means a ring-less
+    consumer (the router), which requires fetched entries to agree
+    with EACH OTHER and stamps their fingerprint onto the prefix
+    envelope it relays — the receiving replica's own
+    ``check_fingerprint`` stays the last word."""
+
+    def __init__(self, backend: DirBackend,
+                 fingerprint: Optional[Dict[str, Any]] = None,
+                 ttl_s: float = 0.0, budget_mb: int = 0,
+                 queue_len: int = 256) -> None:
+        self.backend = backend
+        self.fingerprint = fingerprint
+        self.ttl_s = float(ttl_s)
+        self.budget_mb = int(budget_mb)
+        self._q: "deque[Tuple[int, int, Tuple[int, ...], Dict[str, Any]]]" \
+            = deque()
+        self._q_max = max(1, int(queue_len))
+        self._busy = False
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._writer: Optional[threading.Thread] = None
+        self.stats = {
+            # write side: payloads persisted, offers shed by the
+            # bounded queue (drop-oldest backpressure), bytes written
+            "puts": 0, "put_drops": 0, "bytes_written": 0,
+            # read side: fetch calls, fetch calls that returned >= 1
+            # block, blocks returned, entries refused+GC'd (corrupt /
+            # truncated / fingerprint-skewed)
+            "probes": 0, "hits": 0, "blocks_fetched": 0, "refused": 0,
+            # lifecycle: janitor removals (TTL + budget LRU)
+            "evicted": 0,
+        }
+
+    # -- write path (ring thread -> writer thread) --------------------------
+
+    def offer(self, key: int, chunk: Sequence[int],
+              payload: Dict[str, Any], ns: int = 0) -> None:
+        """Queue one demoted payload for persistence.  NEVER blocks:
+        on backpressure the OLDEST queued offer is shed (it was the
+        coldest — it aged out of the host tier first)."""
+        if self._q_max and len(self._q) >= self._q_max:
+            try:
+                self._q.popleft()
+                self.stats["put_drops"] += 1
+            except IndexError:
+                pass
+        self._q.append((int(ns), int(key),
+                        tuple(int(t) for t in chunk), payload))
+        if self._writer is None:
+            self._writer = threading.Thread(
+                target=self._writer_loop, daemon=True, name="kvstore-writer")
+            self._writer.start()
+        self._wake.set()
+
+    def _writer_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ns, key, chunk, payload = self._q.popleft()
+            except IndexError:
+                self._busy = False
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            self._busy = True
+            try:
+                self._persist_one(ns, key, chunk, payload)
+            except Exception:
+                pass    # persistence is an optimization, never a fault
+
+    def _persist_one(self, ns: int, key: int, chunk: Tuple[int, ...],
+                     payload: Dict[str, Any]) -> None:
+        if self.backend.exists(ns, key):
+            # same chain key = same immutable bytes under the same
+            # fingerprint: refresh the LRU stamp instead of rewriting
+            self.backend.touch(ns, key)
+            return
+        blob = FK.encode_envelope(KIND, {
+            "key": int(key), "ns": int(ns),
+            "chunk": [int(t) for t in chunk],
+            "fingerprint": self.fingerprint,
+        }, {name: np.asarray(a) for name, a in payload.items()})
+        self.backend.put(ns, key, blob)
+        self.stats["puts"] += 1
+        self.stats["bytes_written"] += len(blob)
+
+    def flush(self, timeout: float = 10.0) -> bool:
+        """Drain the writer queue (tests / bench teardown)."""
+        deadline = time.monotonic() + timeout
+        while (self._q or self._busy) and time.monotonic() < deadline:
+            self._wake.set()
+            time.sleep(0.005)
+        return not self._q and not self._busy
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._writer is not None:
+            self._writer.join(timeout=2.0)
+
+    # -- read path ----------------------------------------------------------
+
+    def _decode_one(self, ns: int, key: int, chunk: Tuple[int, ...],
+                    blob: bytes, want_fp: Optional[Dict[str, Any]]
+                    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """One entry's bytes -> ``(meta, payload)``, or EnvelopeError.
+        On top of decode_envelope's magic/CRC/manifest checks: kind,
+        key/ns/chunk identity (the radix equality check, so a file
+        placed under the wrong name can never serve the wrong tokens),
+        k/v presence, and the fingerprint."""
+        kind, meta, arrays = FK.decode_envelope(blob)
+        if kind != KIND:
+            raise FK.EnvelopeError(
+                f"expected a {KIND} envelope, got {kind!r}")
+        if (int(meta.get("key", 0)) != int(key)
+                or int(meta.get("ns", -1)) != int(ns)
+                or [int(t) for t in meta.get("chunk", ())] != list(chunk)):
+            raise FK.EnvelopeError(
+                "store entry identity mismatch (key/ns/chunk disagree "
+                "with its chain position) — refusing")
+        if "k" not in arrays or "v" not in arrays:
+            raise FK.EnvelopeError("store entry missing k/v payload")
+        if want_fp is not None:
+            FK.check_fingerprint(meta, want_fp)
+        return meta, arrays
+
+    def fetch(self, tokens: Sequence[int], block_size: int, ns: int = 0,
+              skip: int = 0) -> Tuple[List[List[int]], List[int],
+                                      List[Dict[str, Any]],
+                                      Optional[Dict[str, Any]]]:
+        """Probe the store for the prompt's chain: returns
+        ``(chunks, block_idx, payloads, fingerprint)`` shaped exactly
+        like ``PagedCacheManager.export_host_chain`` output (chunks =
+        EVERY full block's tokens from the chain start, so the
+        importer can recompute parent keys) plus the entries'
+        fingerprint (what a ring-less router stamps on the relay
+        envelope).  ``skip`` = leading blocks the caller already
+        covers locally; probing stops at the first miss past it
+        (deeper blocks would be parent-gapped and unreachable).
+
+        A refused entry (corrupt, truncated, skewed) is deleted —
+        GC'd, never promoted — and ends the probe.  Adapter
+        namespaces abstain: their chain salts are per-load
+        per-replica, so a persisted entry could never be re-keyed."""
+        self.stats["probes"] += 1
+        empty: Tuple[List[List[int]], List[int], List[Dict[str, Any]],
+                     Optional[Dict[str, Any]]] = ([], [], [], None)
+        if ns:
+            return empty
+        bs = int(block_size)
+        toks = [int(t) for t in tokens]
+        n_full = len(toks) // bs
+        if n_full == 0:
+            return empty
+        chunks: List[List[int]] = []
+        keys: List[int] = []
+        key: Optional[int] = None
+        for j in range(n_full):
+            chunk = tuple(toks[j * bs:(j + 1) * bs])
+            key = chain_key(key, chunk)
+            chunks.append(list(chunk))
+            keys.append(key)
+        block_idx: List[int] = []
+        payloads: List[Dict[str, Any]] = []
+        fp: Optional[Dict[str, Any]] = self.fingerprint
+        for j in range(max(0, int(skip)), n_full):
+            blob = self.backend.get(ns, keys[j])
+            if blob is None:
+                break
+            try:
+                meta, payload = self._decode_one(
+                    ns, keys[j], tuple(chunks[j]), blob, fp)
+            except FK.EnvelopeError:
+                self.backend.delete(ns, keys[j])
+                self.stats["refused"] += 1
+                break
+            if fp is None:
+                # ring-less consumer: later entries must agree with
+                # the first (one coherent chain on the relay envelope)
+                fp = meta.get("fingerprint")
+            self.backend.touch(ns, keys[j])
+            block_idx.append(j)
+            payloads.append(payload)
+        if block_idx:
+            self.stats["hits"] += 1
+            self.stats["blocks_fetched"] += len(block_idx)
+        return chunks, block_idx, payloads, fp
+
+    def fetch_prefix_envelope(self, tokens: Sequence[int],
+                              block_size: int,
+                              ns: int = 0) -> Optional[bytes]:
+        """The router-side consult: probe + re-encode as a standard
+        PREFIX envelope (the same wire shape a peer export produces),
+        stamped with the entries' own fingerprint — the receiving
+        replica's ``check_fingerprint`` is the final gate.  Returns
+        ``None`` on a clean miss."""
+        chunks, idx, payloads, fp = self.fetch(tokens, block_size, ns=ns)
+        if not idx:
+            return None
+        return FK.encode_prefix({"fingerprint": fp}, chunks, idx,
+                                payloads)
+
+    def delete(self, key: int, ns: int = 0) -> None:
+        """Drop one entry (quarantine scrub of a store-resident chain)."""
+        self.backend.delete(ns, key)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def usage(self) -> Tuple[int, int]:
+        """``(blocks, bytes)`` currently resident — the
+        kvStoreBlocks/kvStoreBytes status keys."""
+        ents = self.backend.entries()
+        return len(ents), sum(sz for _, sz, _ in ents)
+
+    def hit_rate(self) -> float:
+        """Share of store probes that returned >= 1 block — the
+        kvStoreHitRate status key."""
+        p = self.stats["probes"]
+        return round(self.stats["hits"] / p, 4) if p else 0.0
+
+    def evictions(self) -> int:
+        return self.stats["evicted"]
+
+    def janitor(self, now: Optional[float] = None) -> Dict[str, int]:
+        """One lifecycle pass: reap torn-write tmp orphans, expire
+        entries past the TTL (last-touch), then enforce the size
+        budget LRU-oldest-first.  Idempotent and safe against
+        concurrent readers/writers — a remove racing a touch loses
+        nothing but one warm entry."""
+        now = time.time() if now is None else float(now)
+        reaped_tmp = self.backend.sweep_tmp()
+        expired = 0
+        ents = self.backend.entries()
+        if self.ttl_s > 0:
+            live: List[Tuple[str, int, float]] = []
+            for p, sz, mt in ents:
+                if now - mt >= self.ttl_s:
+                    try:
+                        os.remove(p)
+                        expired += 1
+                    except OSError:
+                        pass
+                else:
+                    live.append((p, sz, mt))
+            ents = live
+        budget_evicted = 0
+        if self.budget_mb > 0:
+            budget = self.budget_mb * (1 << 20)
+            total = sum(sz for _, sz, _ in ents)
+            for p, sz, _mt in sorted(ents, key=lambda e: e[2]):
+                if total <= budget:
+                    break
+                try:
+                    os.remove(p)
+                    total -= sz
+                    budget_evicted += 1
+                except OSError:
+                    pass
+        self.stats["evicted"] += expired + budget_evicted
+        return {"tmp_reaped": reaped_tmp, "expired": expired,
+                "budget_evicted": budget_evicted}
+
+
+def _janitor_main(argv: Optional[List[str]] = None) -> int:
+    """Offline GC against a (shared-volume) store directory:
+    ``python -m paddle_operator_tpu.infer.kvstore dir:/path --ttl-s ...
+    --budget-mb ... [--interval-s N]`` — one pass by default, a
+    long-running janitor sidecar with ``--interval-s``.  (The tier-1
+    preflight orphan sweep pgreps this module name.)"""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_operator_tpu.infer.kvstore",
+        description="durable prefix store janitor (TTL + size budget)")
+    p.add_argument("store", help="store url, e.g. dir:/var/kvstore")
+    p.add_argument("--ttl-s", type=float, default=0.0,
+                   help="expire entries idle longer than this (0 = off)")
+    p.add_argument("--budget-mb", type=int, default=0,
+                   help="LRU-evict down to this size (0 = unbounded)")
+    p.add_argument("--interval-s", type=float, default=0.0,
+                   help="loop every N seconds (0 = one pass and exit)")
+    args = p.parse_args(argv)
+    store = KVBlockStore(parse_store_url(args.store),
+                         ttl_s=args.ttl_s, budget_mb=args.budget_mb)
+    while True:
+        out = store.janitor()
+        blocks, nbytes = store.usage()
+        print(f"kvstore janitor: {out} now {blocks} blocks "
+              f"{nbytes} bytes", flush=True)
+        if args.interval_s <= 0:
+            return 0
+        time.sleep(args.interval_s)
+
+
+if __name__ == "__main__":
+    raise SystemExit(_janitor_main())

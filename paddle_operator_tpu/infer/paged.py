@@ -126,9 +126,10 @@ class NoFreeBlocks(RuntimeError):
 
 
 class _CacheEntry:
-    __slots__ = ("key", "block", "chunk", "parent", "freed_at")
+    __slots__ = ("key", "block", "chunk", "parent", "freed_at", "ns",
+                 "stored")
 
-    def __init__(self, key, block, chunk, parent):
+    def __init__(self, key, block, chunk, parent, ns=0):
         self.key = key
         # device pool block id, or None while the entry's content lives
         # in the host tier (demoted — the radix node stays alive and a
@@ -137,6 +138,16 @@ class _CacheEntry:
         self.chunk = chunk        # the bs tokens this block's KV encodes
         self.parent = parent      # chain key of the preceding block
         self.freed_at: Optional[int] = None   # LRU clock at refcount 0
+        # radix namespace (0 = base model): the durable store abstains
+        # for adapter namespaces (their chain salts are per-load
+        # per-replica), so the spill hook needs to know
+        self.ns = ns
+        # durable-store residency (ISSUE 17): True while the entry's
+        # bytes live ONLY in the KV store — no device block, no host
+        # payload.  The radix walk treats it as a miss (it cannot be
+        # served locally) but the node survives so a store fetch can
+        # re-fill it through import_host_blocks.
+        self.stored = False
 
 
 def host_block_bytes(cfg: LlamaConfig, block_size: int,
@@ -169,6 +180,12 @@ class HostCacheTier:
         self.capacity = int(capacity)
         self._data: "Dict[Any, Dict[str, Any]]" = {}   # insertion = LRU age
         self.stats = {"demoted": 0, "promoted": 0, "overflow_drops": 0}
+        # durable-store spill hook (ISSUE 17): called with
+        # ``(key, payload)`` BEFORE an overflow drop deletes the
+        # payload — the manager's last chance to persist bytes that
+        # would otherwise be silently discarded.  None = pre-store
+        # behavior, byte-identical.
+        self.on_spill: Optional[Callable[[Any, Dict[str, Any]], None]] = None
 
     def __len__(self) -> int:
         return len(self._data)
@@ -196,6 +213,8 @@ class HostCacheTier:
             old = next((k for k in self._data if k not in pinned), None)
             if old is None:
                 break                   # all pinned: exceed, trim later
+            if self.on_spill is not None:
+                self.on_spill(old, self._data[old])
             del self._data[old]
             dropped.append(old)
             self.stats["overflow_drops"] += 1
@@ -209,6 +228,8 @@ class HostCacheTier:
         dropped: List[Any] = []
         while len(self._data) > self.capacity:
             old = next(iter(self._data))
+            if self.on_spill is not None:
+                self.on_spill(old, self._data[old])
             del self._data[old]
             dropped.append(old)
             self.stats["overflow_drops"] += 1
@@ -294,6 +315,11 @@ class PagedCacheManager:
         self.host = (HostCacheTier(host_cache_blocks)
                      if host_cache_blocks else None)
         self.demote_fetch: Optional[Callable[[int], Dict[str, Any]]] = None
+        # durable prefix store (ISSUE 17): the persistent tier below
+        # the host tier — wired via attach_store().  None (the
+        # default) keeps every path byte-identical to pre-store
+        # behavior, including the silent overflow discard.
+        self.store = None
         # the in-flight admission's host-hit chain keys: shielded from
         # tier overflow drops while the admit that will pop them runs
         # (HostCacheTier.put pinned=)
@@ -317,6 +343,11 @@ class PagedCacheManager:
             # payloads (the hostHitRate numerator)
             "host_demotions": 0, "host_promotions": 0,
             "host_hit_tokens": 0,
+            # durable store (ISSUE 17): payloads offered to the store
+            # writer on host-tier overflow (the previously-silent
+            # discards), and store-fetched blocks re-filled into
+            # store-resident radix nodes
+            "store_spills": 0, "store_refills": 0,
             # fleet-level KV (ISSUE 12): demoted blocks imported from a
             # PEER replica's host tier (they promote through the normal
             # host-hit path on the next admission)
@@ -453,13 +484,58 @@ class PagedCacheManager:
         self.stats["cache_evictions"] += 1
 
     def _drop_host_entry(self, key) -> None:
-        """A host-tier payload aged out (LRU overflow): retire its
-        radix node — the prefix is now truly cold again.  Same unlink
-        as a device drop (``by_block.pop(None)`` is a no-op for host
-        entries, whose keys there are block ints)."""
+        """A host-tier payload aged out (LRU overflow).  Without a
+        durable store: retire its radix node — the prefix is now truly
+        cold again (same unlink as a device drop; ``by_block.pop(None)``
+        is a no-op for host entries, whose keys there are block ints).
+        With the store attached (ISSUE 17) and a base-namespace entry,
+        the payload was just offered to the store writer (the tier's
+        ``on_spill`` hook fires before the delete) — the node SURVIVES
+        at ``block=None, stored=True`` so a later walk can re-probe the
+        store instead of re-prefilling."""
         e = self.entries.get(key)
-        if e is not None:
-            self._drop_entry(e)
+        if e is None:
+            return
+        if self.store is not None and not e.ns:
+            e.stored = True
+            return
+        self._drop_entry(e)
+
+    def attach_store(self, store) -> None:
+        """Wire the durable prefix store (infer/kvstore.KVBlockStore)
+        below the host tier: overflow drops persist instead of
+        discarding, and their radix nodes survive store-resident.
+        Requires the host tier (there is nothing to spill without
+        it)."""
+        if self.host is None:
+            raise ValueError("KV store requires the host cache tier "
+                             "(host_cache_blocks > 0)")
+        self.store = store
+        self.host.on_spill = self._spill_to_store
+
+    def _spill_to_store(self, key, payload: Dict[str, Any]) -> None:
+        """HostCacheTier overflow hook: offer the about-to-be-dropped
+        payload to the store's background writer (bounded drop-oldest
+        queue — never blocks the ring thread).  Adapter namespaces
+        abstain: their chain salts are per-load per-replica, so a
+        persisted entry could never be re-keyed."""
+        e = self.entries.get(key)
+        if e is None or e.ns or self.store is None:
+            return
+        self.store.offer(key, e.chunk, payload, ns=0)
+        self.stats["store_spills"] += 1
+
+    def _servable(self, e: _CacheEntry) -> bool:
+        """Can this radix node serve a hit RIGHT NOW — device-resident,
+        or host-resident with its payload actually in the tier?  A
+        store-resident node (``stored=True``, payload on disk only)
+        cannot: admit would have nothing to promote.  With the store
+        off every ``block=None`` entry is in the tier by the
+        demoted==host-keys invariant, so this is byte-identical to the
+        pre-store walk."""
+        if e.block is not None:
+            return True
+        return self.host is not None and e.key in self.host
 
     def _drop_entry(self, e: _CacheEntry) -> None:
         del self.entries[e.key]
@@ -538,7 +614,7 @@ class PagedCacheManager:
             chunk = tokens[j * bs:(j + 1) * bs]
             k2 = self._chain_key(key, chunk)
             e = self.entries.get(k2)
-            if e is None or e.chunk != chunk:
+            if e is None or e.chunk != chunk or not self._servable(e):
                 break
             hits.append(e)
             key = k2
@@ -549,7 +625,7 @@ class PagedCacheManager:
         if rem and len(rem) < bs:
             for ck in self.children.get(key, ()):
                 e = self.entries[ck]
-                if e.chunk[:len(rem)] == rem:
+                if e.chunk[:len(rem)] == rem and self._servable(e):
                     hits.append(e)
                     hit += len(rem)
                     partial = True
@@ -711,9 +787,21 @@ class PagedCacheManager:
             if e is None:
                 blk = int(self.table[slot, j])
                 if blk != TRASH_BLOCK and blk not in self.by_block:
-                    self.entries[k2] = _CacheEntry(k2, blk, chunk, key)
+                    self.entries[k2] = _CacheEntry(k2, blk, chunk, key,
+                                                   ns=ns)
                     self.by_block[blk] = k2
                     self.children.setdefault(key, set()).add(k2)
+            elif e.block is None and e.stored and e.chunk == chunk:
+                # store-resident node whose prefix this lane just
+                # re-prefilled: re-anchor it device-side (the lane's
+                # block holds exactly this chunk's KV) — otherwise the
+                # walk keeps breaking at the store-only node even
+                # though the bytes were just computed
+                blk = int(self.table[slot, j])
+                if blk != TRASH_BLOCK and blk not in self.by_block:
+                    e.block = blk
+                    e.stored = False
+                    self.by_block[blk] = k2
             key = k2
 
     def ensure(self, slot: int, pos_needed: int) -> None:
@@ -750,8 +838,12 @@ class PagedCacheManager:
         byte blob that can no longer be re-verified against the pool —
         after a NaN quarantine the conservative move is to forget the
         lane's chain from the tier and let the prefix re-prefill.
+        With the durable store attached (ISSUE 17) the same argument
+        applies one tier down: every store copy along the chain is
+        deleted and store-resident nodes are retired, never marked
+        ``stored`` — a quarantined chain must not resurrect from disk.
         Returns the number of payloads dropped."""
-        if self.host is None:
+        if self.host is None and self.store is None:
             return 0
         tokens = tuple(int(t) for t in prompt)
         key = self._root_key(ns)
@@ -759,12 +851,18 @@ class PagedCacheManager:
         for j in range(len(tokens) // self.bs):
             chunk = tokens[j * self.bs:(j + 1) * self.bs]
             key = self._chain_key(key, chunk)
+            if self.store is not None and not ns:
+                # the store may hold a copy of ANY chain block (it
+                # persists overflow drops, device residency since is
+                # irrelevant) — delete unconditionally along the chain
+                self.store.delete(key, ns=0)
             e = self.entries.get(key)
             if e is None:
                 continue    # gap in the chain: deeper entries may remain
             if e.block is None:
-                self.host.drop(key)
-                self._drop_host_entry(key)
+                if self.host is not None:
+                    self.host.drop(key)
+                self._drop_entry(e)
                 dropped += 1
         return dropped
 
@@ -837,7 +935,23 @@ class PagedCacheManager:
             keys.append(key)
         imported = 0
         for j, payload in zip(block_idx, payloads):
-            if not 0 <= j < len(keys) or keys[j] in self.entries:
+            if not 0 <= j < len(keys):
+                continue
+            existing = self.entries.get(keys[j])
+            if existing is not None:
+                # store-resident node (ISSUE 17): its bytes live only
+                # on disk — REFILL the host tier so the next admission
+                # host-hits it; any other resident entry is left alone
+                if (existing.block is None and existing.stored
+                        and existing.chunk == tuple(
+                            int(t) for t in chunks[j])):
+                    for dropped in self.host.put(
+                            keys[j], payload,
+                            pinned=self._pinned_host_keys):
+                        self._drop_host_entry(dropped)
+                    existing.stored = False
+                    self.stats["store_refills"] += 1
+                    imported += 1
                 continue
             if j and keys[j - 1] not in self.entries:
                 # _lookup walks the chain from the root and stops at
@@ -849,7 +963,7 @@ class PagedCacheManager:
                 continue
             parent = keys[j - 1] if j else self._root_key(ns)
             chunk = tuple(int(t) for t in chunks[j])
-            e = _CacheEntry(keys[j], None, chunk, parent)
+            e = _CacheEntry(keys[j], None, chunk, parent, ns=ns)
             self.entries[keys[j]] = e
             self.children.setdefault(parent, set()).add(keys[j])
             for dropped in self.host.put(keys[j], payload,
@@ -902,12 +1016,25 @@ class PagedCacheManager:
         # promoting == len(_pending_promotes) counted inside `mapped`
         # (promoted blocks are lane-refcounted the moment they are
         # reserved)
-        demoted = {e.key for e in self.entries.values() if e.block is None}
+        # store-resident nodes (ISSUE 17) hold NO local payload: their
+        # bytes are on disk only, so they are excluded from the
+        # demoted==host-keys identity and must be disjoint from the
+        # tier.  With the store off no entry can be stored, so the
+        # original identity is checked unchanged.
+        stored_keys = {e.key for e in self.entries.values()
+                       if e.block is None and e.stored}
+        if self.store is None:
+            assert not stored_keys, \
+                "store-resident entry without a KV store"
+        demoted = {e.key for e in self.entries.values()
+                   if e.block is None and not e.stored}
         if self.host is not None:
             host_keys = set(self.host.keys())
             assert demoted == host_keys, (
                 f"host tier desync: {len(demoted)} demoted entries vs "
                 f"{len(host_keys)} host payloads")
+            assert not (stored_keys & host_keys), \
+                "store-resident entry also holds a host payload"
             assert len(self.host) <= self.host.capacity, \
                 "host tier exceeded its bound"
             promoting = {dst for dst, _, _ in self._pending_promotes}

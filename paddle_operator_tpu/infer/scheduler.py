@@ -35,7 +35,7 @@ import queue
 import threading
 import time
 from collections import OrderedDict
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -447,6 +447,13 @@ class ContinuousBatcher:
         # blocks.  Both default None = the pod-local pre-fleet ring.
         self.migrate_out = None
         self.peer_fetch = None
+        # durable prefix store (ISSUE 17): the persistent tier below
+        # host/peer — wired by serve.py via attach_kv_store().  The
+        # submit-thread probe order becomes peer -> store: on a peer
+        # miss (or with no fleet wired at all) the store is consulted
+        # directly and hits land through the same import -> host-hit
+        # -> batched-promote path.  None = pre-store behavior.
+        self.kv_store = None
         # drain-by-migration: SIGTERM/scale-down drain parks residents
         # and migrates them out instead of waiting out completions
         # (completion-wait remains the fallback for lanes no peer takes)
@@ -478,6 +485,11 @@ class ContinuousBatcher:
                       # a peer's host tier
                       "lane_migrations": 0, "adopted_lanes": 0,
                       "peer_prefix_fetches": 0,
+                      # durable prefix store (ISSUE 17): submit-thread
+                      # store consults and the subset that returned
+                      # blocks — kvStoreHitRate's numerator/denominator
+                      # fold the store's own counters at status time
+                      "kv_store_probes": 0, "kv_store_hits": 0,
                       "spec_accepted": 0, "spec_drafted": 0,
                       # prefill accounting: the prefix-cache acceptance
                       # gate — a full prefix hit admits with ZERO
@@ -773,8 +785,11 @@ class ContinuousBatcher:
         # Base-namespace chains only: adapter namespaces are salted
         # per-LOAD per-replica, so their chain keys never agree across
         # pods by design.
-        if (self.peer_fetch is not None and req.ns == 0
-                and self.pool is not None
+        # Probe order peer -> store (ISSUE 17): the durable store is
+        # consulted on a peer miss, or directly when no fleet peer
+        # fetch is wired (single-replica rings still warm-start).
+        if ((self.peer_fetch is not None or self.kv_store is not None)
+                and req.ns == 0 and self.pool is not None
                 and self.pool.host is not None):
             try:
                 self._maybe_peer_fetch(prompt)
@@ -849,6 +864,17 @@ class ContinuousBatcher:
         dp = getattr(self.executor, "draft_params", None)
         return Q.weight_quant_mode(dp) if dp is not None else "none"
 
+    def _kv_store_usage(self) -> Tuple[int, int]:
+        """``(blocks, bytes)`` resident in the durable store — (0, 0)
+        with the store off, and degrades to (0, 0) on a backend listing
+        error (telemetry must never fail a scrape)."""
+        if self.kv_store is None:
+            return 0, 0
+        try:
+            return self.kv_store.usage()
+        except OSError:
+            return 0, 0
+
     def serving_status(self) -> Dict[str, Any]:
         """The ``TPUJob.status.serving`` block (camelCase, like
         GoodputTracker.to_status): cumulative served-token throughput,
@@ -858,6 +884,7 @@ class ContinuousBatcher:
         elapsed = max(1e-9, time.monotonic() - self._t_start)
         drafted = self.stats["spec_drafted"]
         pf_tok = self.stats["prefill_tokens"]
+        kv_store_blocks, kv_store_bytes = self._kv_store_usage()
         # per-lane visibility EXCLUDES retired lanes: _evict zeroes the
         # host pos mirror (and the compiled step zeroes the device pos),
         # so a freed lane can never leak its last request's fill
@@ -945,6 +972,17 @@ class ContinuousBatcher:
             "remotePrefills": self.stats["remote_prefills"],
             "hostCacheEvictions": (self.pool.host_evictions()
                                    if self.pool is not None else 0),
+            # durable prefix store (ISSUE 17): blocks/bytes resident in
+            # the persistent tier, the share of submit-thread store
+            # probes that returned blocks, and janitor removals
+            # (TTL + size budget) — the tpujob_serve_kv_store_* gauges
+            # (all 0 with the store off)
+            "kvStoreBlocks": kv_store_blocks,
+            "kvStoreBytes": kv_store_bytes,
+            "kvStoreHitRate": (self.kv_store.hit_rate()
+                               if self.kv_store is not None else 0.0),
+            "kvStoreEvictions": (self.kv_store.evictions()
+                                 if self.kv_store is not None else 0),
             "activeAdapters": (len(self.adapters)
                                if self.adapters is not None else 0),
             "adapterNames": (self.adapters.names()
@@ -1134,6 +1172,12 @@ class ContinuousBatcher:
             return False
         backoff = self._budget.spend()
         self.executor.reset_state()
+        # the rebuilt pool is fresh (store=None): re-attach the durable
+        # store (ISSUE 17) — surviving restarts is its whole point, and
+        # the rebuilt radix re-fills from it via the normal store probe
+        if (self.kv_store is not None and self.pool is not None
+                and self.pool.host is not None):
+            self.pool.attach_store(self.kv_store)
         self._stop.wait(backoff)
         self._rebuilding = False
         return True
@@ -1966,6 +2010,21 @@ class ContinuousBatcher:
                 "quant": ex.kv_quant,
                 "specK": int(ex.spec_k)}
 
+    def attach_kv_store(self, store) -> None:
+        """Wire the durable prefix store (ISSUE 17,
+        infer/kvstore.KVBlockStore) into both halves: the POOL's spill
+        path (host-tier overflow drops persist instead of discarding,
+        their radix nodes surviving store-resident) and the SUBMIT
+        probe (peer -> store order).  Requires a paged pool with the
+        host tier — there is nothing to spill or promote without
+        them."""
+        if self.pool is None or self.pool.host is None:
+            raise ValueError(
+                "KV store requires paged attention with the host cache "
+                "tier (host_cache_blocks > 0)")
+        self.pool.attach_store(store)
+        self.kv_store = store
+
     def handoff_fingerprint(self) -> Dict[str, Any]:
         """The geometry + sampling rule a remote-prefill HANDOFF
         envelope must match (ISSUE 13) — narrower than the migration
@@ -2112,12 +2171,14 @@ class ContinuousBatcher:
         return req
 
     def _maybe_peer_fetch(self, prompt) -> None:
-        """Submit-thread half of peer prefix fetch: when the prompt's
-        full-block chain is not fully covered locally, ask the fleet
-        (one bounded HTTP round-trip on the CALLER's thread — never
-        the ring's) for demoted payloads and queue them for radix
-        import at the next loop pass, so this request's admission
-        host-hits them."""
+        """Submit-thread half of the fleet prefix probe, order
+        peer -> store (ISSUE 17): when the prompt's full-block chain
+        is not fully covered locally, ask the fleet (one bounded HTTP
+        round-trip on the CALLER's thread — never the ring's) for
+        demoted payloads; on a peer miss consult the durable store
+        directly (a bounded disk read, same thread discipline).
+        Either hit queues payloads for radix import at the next loop
+        pass, so this request's admission host-hits them."""
         from paddle_operator_tpu.utils import fleetkv as FK
         from paddle_operator_tpu.utils.radixkey import chain_key
 
@@ -2132,32 +2193,51 @@ class ContinuousBatcher:
         for j in range(n_full):
             key = chain_key(key, tuple(tokens[j * bs:(j + 1) * bs]))
             keys.append(key)
-        tail = keys[-1]
-        if tail in self._peer_fetch_seen:
-            self._peer_fetch_seen.move_to_end(tail)
-            return
-        self._peer_fetch_seen[tail] = True
-        while len(self._peer_fetch_seen) > 1024:
-            self._peer_fetch_seen.popitem(last=False)
         # local coverage probe — a racy read against the ring thread's
         # radix mutations; any surprise is caught by submit's except
-        # and the fetch simply skipped
+        # and the fetch simply skipped.  An entry counts as covered
+        # only if it is SERVABLE (device- or host-resident): a
+        # store-resident node is exactly what the probe below re-fills.
         covered = 0
         for k in keys:
-            if pool.entries.get(k) is None:
+            e = pool.entries.get(k)
+            if e is None or not pool._servable(e):
                 break
             covered += 1
         if covered >= n_full:
             return
-        buf = self.peer_fetch(tokens, 0)
-        if not buf:
+        # the seen-cache dedupes the PEER round-trip only (one HTTP
+        # ask per distinct chain — a repeat miss must not hammer the
+        # fleet); the store probe below stays outside it: a clean
+        # store miss costs one local file stat, and a store-resident
+        # node's whole purpose is to be RE-probed on a later walk
+        tail = keys[-1]
+        seen = tail in self._peer_fetch_seen
+        if seen:
+            self._peer_fetch_seen.move_to_end(tail)
+        else:
+            self._peer_fetch_seen[tail] = True
+            while len(self._peer_fetch_seen) > 1024:
+                self._peer_fetch_seen.popitem(last=False)
+        if self.peer_fetch is not None and not seen:
+            buf = self.peer_fetch(tokens, 0)
+            if buf:
+                meta, chunks, idx, payloads = FK.decode_prefix(buf)
+                FK.check_fingerprint(meta, self._fingerprint())
+                if idx:
+                    self._host_imports.put((chunks, idx, payloads, 0))
+                    self.stats["peer_prefix_fetches"] += 1
+                    self._wake.set()
+                    return
+        if self.kv_store is None:
             return
-        meta, chunks, idx, payloads = FK.decode_prefix(buf)
-        FK.check_fingerprint(meta, self._fingerprint())
+        self.stats["kv_store_probes"] += 1
+        chunks, idx, payloads, _fp = self.kv_store.fetch(
+            tokens, bs, ns=0, skip=covered)
         if not idx:
             return
+        self.stats["kv_store_hits"] += 1
         self._host_imports.put((chunks, idx, payloads, 0))
-        self.stats["peer_prefix_fetches"] += 1
         self._wake.set()
 
     def _kick_migration(self, pk: _ParkedLane) -> None:

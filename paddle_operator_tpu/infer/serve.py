@@ -753,6 +753,64 @@ def wire_fleet_kv_from_env(batcher, port: int) -> None:
             batcher.peer_fetch = kv_client.fetch_prefix
 
 
+def wire_kv_store_from_env(batcher) -> None:
+    """Durable prefix store wiring (ISSUE 17, docs/serving.md "Durable
+    prefix store"): ``SERVE_KV_STORE=dir:/path`` attaches the
+    persistent tier below host/peer — host-tier overflow drops persist
+    through a background writer instead of silently discarding, and the
+    submit-thread probe order becomes peer -> store.  Lifecycle knobs:
+    ``SERVE_KV_STORE_TTL_S`` (expire idle entries),
+    ``SERVE_KV_STORE_BUDGET_MB`` (LRU size budget),
+    ``SERVE_KV_STORE_JANITOR_S`` (in-process janitor period; 0 leaves
+    lifecycle to the offline ``python -m
+    paddle_operator_tpu.infer.kvstore`` pass — the shared-volume
+    deployment shape), ``SERVE_KV_STORE_QUEUE`` (writer queue bound,
+    drop-oldest).  Requires the paged ring + host tier (spills come
+    from the tier; hits land through it); unset is byte-identical to
+    the store-less ring."""
+    import os
+    import threading
+
+    url = os.environ.get("SERVE_KV_STORE", "").strip()
+    if not url:
+        return
+    if batcher.pool is None or batcher.pool.host is None:
+        print("SERVE_KV_STORE ignored: the durable store spills from "
+              "and promotes through the host tier — set SERVE_PAGED=1 "
+              "and SERVE_HOST_CACHE_BLOCKS/_MB", flush=True)
+        return
+    from paddle_operator_tpu.infer import kvstore as KVS
+
+    try:
+        backend = KVS.parse_store_url(url)
+    except (ValueError, OSError) as e:
+        print(f"SERVE_KV_STORE ignored: {e}", flush=True)
+        return
+    store = KVS.KVBlockStore(
+        backend, fingerprint=batcher._fingerprint(),
+        ttl_s=float(os.environ.get("SERVE_KV_STORE_TTL_S", "0") or 0),
+        budget_mb=int(os.environ.get("SERVE_KV_STORE_BUDGET_MB", "0")
+                      or 0),
+        queue_len=int(os.environ.get("SERVE_KV_STORE_QUEUE", "256")
+                      or 256))
+    batcher.attach_kv_store(store)
+    janitor_s = float(os.environ.get("SERVE_KV_STORE_JANITOR_S", "0")
+                      or 0)
+    if janitor_s > 0:
+        def _janitor_loop():
+            while not batcher._stop.wait(janitor_s):
+                try:
+                    store.janitor()
+                except OSError:
+                    pass
+
+        threading.Thread(target=_janitor_loop, daemon=True,
+                         name="kvstore-janitor").start()
+    print(f"durable KV store attached: {url} "
+          f"(ttl_s={store.ttl_s}, budget_mb={store.budget_mb}, "
+          f"janitor_s={janitor_s})", flush=True)
+
+
 def main() -> int:
     """Serving entrypoint: restore params from TPUJOB_CHECKPOINT_PATH
     (fresh init if none — smoke mode) and serve on TPUJOB_PORT."""
@@ -1081,6 +1139,7 @@ def main() -> int:
         # (smoke-testing a deployment's resilience end-to-end)
         maybe_install_from_env(batcher)
         wire_fleet_kv_from_env(batcher, env.port)
+        wire_kv_store_from_env(batcher)
     watcher = PreemptionWatcher.install()
     drain = ServingDrain(
         srv, srv.state, batcher=batcher,

@@ -250,7 +250,12 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
                 # fleet-level KV (ISSUE 12): migration and peer-fetch
                 # accounting sums across the fleet
                 "laneMigrations", "adoptedLanes", "peerPrefixFetches",
-                "hostCacheEvictions"):
+                "hostCacheEvictions",
+                # durable prefix store (ISSUE 17) — NOTE: replicas
+                # sharing one dir: volume each report the full store,
+                # so the fleet sum over-counts by the sharing factor;
+                # per-replica /metrics stay exact
+                "kvStoreBlocks", "kvStoreBytes", "kvStoreEvictions"):
         vals = [b.get(key) for b in blocks if b.get(key) is not None]
         if vals:
             total = sum(float(v) for v in vals)
@@ -269,7 +274,7 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
     if not sum(weights):
         weights = [1.0] * len(blocks)   # no traffic yet: plain mean
     for key in ("prefixHitRate", "acceptRate", "hostHitRate",
-                "chunkedPrefillTokenShare"):
+                "kvStoreHitRate", "chunkedPrefillTokenShare"):
         vals = [(float(b.get(key, 0.0) or 0.0), w)
                 for b, w in zip(blocks, weights) if key in b]
         if vals:
@@ -427,8 +432,18 @@ class FleetRouter:
                  upstream_timeout: float = 600.0,
                  prefill_endpoints: Optional[List[str]] = None,
                  prefill_endpoints_file: Optional[str] = None,
-                 trace: Optional[bool] = None) -> None:
+                 trace: Optional[bool] = None,
+                 kv_store=None) -> None:
         self.block_size = block_size
+        # durable prefix store (ISSUE 17): with ROUTER_KV_STORE
+        # pointing at the fleet's shared store volume, a /v1/kv/prefix
+        # ask that finds no hashring owner (or whose owner misses) is
+        # served from the store directly — the router re-encodes the
+        # entries as a standard prefix envelope stamped with THEIR
+        # fingerprint, and the asking replica's check_fingerprint stays
+        # the last word.  kvstore is jax-free, so the router stays
+        # jax-free.
+        self.kv_store = kv_store
         self.affinity_blocks = affinity_blocks
         self.hot_queue_depth = hot_queue_depth
         self.low_blocks = low_blocks
@@ -483,6 +498,9 @@ class FleetRouter:
             "dedupe_replays": 0,
             "migrations_brokered": 0, "migration_replays": 0,
             "prefix_forwards": 0,
+            # durable store (ISSUE 17): prefix asks served from the
+            # store after an owner miss / no-owner
+            "store_prefix_serves": 0,
             # prefill pool (ISSUE 13): /v1/prefill forwards placed on
             # a ready prefill pod, and asks that found none ready
             "prefill_jobs_forwarded": 0, "no_ready_prefill": 0,
@@ -1131,30 +1149,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def _kv_prefix(self, body: bytes) -> None:
         """POST /v1/kv/prefix — forward a prefix-fetch ask to the
         prompt's hashring affinity owner (the replica the placement
-        rule sent that prefix's traffic to) and relay its envelope."""
+        rule sent that prefix's traffic to) and relay its envelope.
+        On an owner miss — no owner, unreachable, or a 204 — the
+        durable store (ISSUE 17, ``ROUTER_KV_STORE``) is the fallback
+        tier: probe it and relay a store-built prefix envelope."""
         r = self.router
         try:
             req = json.loads(body)
             tokens = [int(t) for t in req["tokens"]]
+            ns = int(req.get("ns", 0))
         except (ValueError, TypeError, KeyError,
                 json.JSONDecodeError) as e:
             self._send(400, {"error": f"bad tokens: {e}"})
             return
         owner = r.prefix_owner(tokens,
                                self.headers.get("X-Migrate-Origin", ""))
-        if owner is None:
-            self.send_response(204)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-            return
-        try:
-            code, raw = r._http_post(owner, "/v1/kv/prefix", body,
-                                     content_type="application/json")
-        except (OSError, socket.timeout):
-            r.mark_unready(owner)
-            code, raw = 204, b""
-        with r._lock:
-            r.counters["prefix_forwards"] += 1
+        code, raw = 204, b""
+        if owner is not None:
+            try:
+                code, raw = r._http_post(owner, "/v1/kv/prefix", body,
+                                         content_type="application/json")
+            except (OSError, socket.timeout):
+                r.mark_unready(owner)
+                code, raw = 204, b""
+            with r._lock:
+                r.counters["prefix_forwards"] += 1
         if code == 200 and raw:
             self.send_response(200)
             self.send_header("Content-Type",
@@ -1163,10 +1182,27 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self.send_header("X-Router-Replica", owner)
             self.end_headers()
             self.wfile.write(raw)
-        else:
-            self.send_response(204)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
+            return
+        if r.kv_store is not None:
+            try:
+                store_raw = r.kv_store.fetch_prefix_envelope(
+                    tokens, r.block_size, ns=ns)
+            except Exception:
+                store_raw = None    # a store consult never errors an ask
+            if store_raw:
+                with r._lock:
+                    r.counters["store_prefix_serves"] += 1
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(store_raw)))
+                self.send_header("X-Router-Store", "1")
+                self.end_headers()
+                self.wfile.write(store_raw)
+                return
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
     def _prefill_forward(self, body: bytes) -> None:
         """POST /v1/prefill — the prefill-pool half of cross-host
@@ -1508,6 +1544,25 @@ def main() -> int:
     # router
     peps = [e for e in os.environ.get("TPUJOB_PREFILL_REPLICAS",
                                       "").split(",") if e.strip()]
+    # durable prefix store (ISSUE 17): ROUTER_KV_STORE=dir:/path (a
+    # shared volume) lets the router answer prefix asks no live
+    # replica can — the fallback tier below the hashring owner.  The
+    # router never validates fingerprints against a ring (it has
+    # none); it relays entries stamped with their OWN fingerprint and
+    # the asking replica refuses skew.
+    kv_store = None
+    store_url = os.environ.get("ROUTER_KV_STORE", "").strip()
+    if store_url:
+        from paddle_operator_tpu.infer.kvstore import (
+            KVBlockStore,
+            parse_store_url,
+        )
+
+        try:
+            kv_store = KVBlockStore(parse_store_url(store_url),
+                                    fingerprint=None)
+        except (ValueError, OSError) as e:
+            print(f"ROUTER_KV_STORE ignored: {e}", flush=True)
     router = FleetRouter(
         eps,
         block_size=int(os.environ.get("ROUTER_BLOCK_SIZE", "256")),
@@ -1519,7 +1574,8 @@ def main() -> int:
         endpoints_file=os.environ.get("ROUTER_ENDPOINTS_FILE"),
         prefill_endpoints=peps,
         prefill_endpoints_file=os.environ.get(
-            "ROUTER_PREFILL_ENDPOINTS_FILE"))
+            "ROUTER_PREFILL_ENDPOINTS_FILE"),
+        kv_store=kv_store)
     srv = make_router_server("0.0.0.0", port, router)
     print(f"fleet router on :{port} fronting "
           f"{len(router.endpoints())} replica(s) "

@@ -450,6 +450,7 @@ def _replica_main() -> int:
     from paddle_operator_tpu.infer.serve import (
         make_server,
         wire_fleet_kv_from_env,
+        wire_kv_store_from_env,
     )
 
     port = int(os.environ["TPUJOB_REPLICA_PORT"])
@@ -463,6 +464,9 @@ def _replica_main() -> int:
     # fleet-level KV (ISSUE 12): the same SERVE_KV_* env contract the
     # real entrypoint honors, so bench subprocess fleets migrate too
     wire_fleet_kv_from_env(srv.generator.batcher, port)
+    # durable prefix store (ISSUE 17): same env contract as the real
+    # entrypoint, so bench fleets exercise the fleet-restart warm start
+    wire_kv_store_from_env(srv.generator.batcher)
     watcher = PreemptionWatcher.install()
     drain = ServingDrain(
         srv, srv.state, batcher=srv.generator.batcher,

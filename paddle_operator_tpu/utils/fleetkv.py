@@ -13,7 +13,13 @@ the fleet can move KV between replicas:
   out instead of waiting out completions;
 - **peer prefix fetch**: a replica whose radix walk misses asks the
   prefix's hashring owner for DEMOTED blocks and promotes them through
-  the host-hit path (int8 pool blocks halve the wire bytes).
+  the host-hit path (int8 pool blocks halve the wire bytes);
+- **durable prefix store** (ISSUE 17, ``infer/kvstore.py``): the
+  persistent tier below host/peer cache writes each demoted block to
+  disk as one ``kind="kvblock"`` envelope and re-reads it across fleet
+  restarts — the same paranoid decode (CRC + fingerprint refusal via
+  :class:`EnvelopeError`) is what lets a crash-torn or generation-
+  skewed file refuse cleanly instead of warm-hitting a wrong prefix.
 
 The envelope is deliberately paranoid — version, quant mode, a
 dtype/shape manifest, the adapter name + namespace, and a payload

@@ -261,6 +261,18 @@ def _serving_gauges_one(status_serving: dict, job: str,
         # pick adopters
         f"tpujob_serve_host_cache_evictions_total{lbl}":
             float(status_serving.get("hostCacheEvictions", 0.0)),
+        # durable prefix store (ISSUE 17, SERVE_KV_STORE): blocks and
+        # bytes resident in the persistent tier below host/peer cache,
+        # the share of store probes that hit, and cumulative
+        # TTL/budget-janitor evictions — all 0 when no store is wired
+        f"tpujob_serve_kv_store_blocks{lbl}":
+            float(status_serving.get("kvStoreBlocks", 0.0)),
+        f"tpujob_serve_kv_store_bytes{lbl}":
+            float(status_serving.get("kvStoreBytes", 0.0)),
+        f"tpujob_serve_kv_store_hit_rate{lbl}":
+            float(status_serving.get("kvStoreHitRate", 0.0)),
+        f"tpujob_serve_kv_store_evictions_total{lbl}":
+            float(status_serving.get("kvStoreEvictions", 0.0)),
         f"tpujob_serve_lane_migrations_total{lbl}":
             float(status_serving.get("laneMigrations", 0.0)),
         f"tpujob_serve_adopted_lanes_total{lbl}":

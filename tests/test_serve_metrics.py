@@ -34,7 +34,9 @@ SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "activeAdapters": 2, "adapterNames": ["acme", "zen"],
            "megastepN": 4, "dispatchesPerToken": 0.0313,
            "parkedLanes": 1, "laneMigrations": 4, "adoptedLanes": 2,
-           "peerPrefixFetches": 6, "hostCacheEvictions": 7}
+           "peerPrefixFetches": 6, "hostCacheEvictions": 7,
+           "kvStoreBlocks": 11, "kvStoreBytes": 2048,
+           "kvStoreHitRate": 0.44, "kvStoreEvictions": 9}
 
 
 class TestGaugeNaming:
@@ -104,6 +106,17 @@ class TestGaugeNaming:
         assert g['tpujob_serve_peer_prefix_fetches_total'
                  '{job="default/j"}'] == 6.0
         assert g['tpujob_serve_parked_lanes{job="default/j"}'] == 1.0
+        # durable prefix store gauges (ISSUE 17): persistent-tier
+        # residency (blocks + bytes), store-probe hit share, and
+        # cumulative TTL/budget-janitor evictions
+        assert g['tpujob_serve_kv_store_blocks'
+                 '{job="default/j"}'] == 11.0
+        assert g['tpujob_serve_kv_store_bytes'
+                 '{job="default/j"}'] == 2048.0
+        assert g['tpujob_serve_kv_store_hit_rate'
+                 '{job="default/j"}'] == 0.44
+        assert g['tpujob_serve_kv_store_evictions_total'
+                 '{job="default/j"}'] == 9.0
 
     def test_prefill_mode_label_defaults_inline(self):
         g = serving_gauges({}, "ns/x")
@@ -153,6 +166,13 @@ class TestGaugeNaming:
             'tpujob_serve_peer_prefix_fetches_total'
             '{job="default/j"}',
             'tpujob_serve_parked_lanes{job="default/j"}',
+            # durable prefix store shape (ISSUE 17): persistent-tier
+            # residency, probe hit share, janitor evictions
+            'tpujob_serve_kv_store_blocks{job="default/j"}',
+            'tpujob_serve_kv_store_bytes{job="default/j"}',
+            'tpujob_serve_kv_store_hit_rate{job="default/j"}',
+            'tpujob_serve_kv_store_evictions_total'
+            '{job="default/j"}',
             # cross-host disaggregation shape (ISSUE 13): cold prompts
             # prefilled in the prefill pool and handed off over the
             # wire (zero on in-process/inline rings)
@@ -359,6 +379,9 @@ class TestBatcherServingStatus:
                            # fleet-level KV block (ISSUE 12)
                            "laneMigrations", "adoptedLanes",
                            "peerPrefixFetches", "hostCacheEvictions",
+                           # durable prefix store block (ISSUE 17)
+                           "kvStoreBlocks", "kvStoreBytes",
+                           "kvStoreHitRate", "kvStoreEvictions",
                            # cross-host disaggregation block (ISSUE 13)
                            "remotePrefills",
                            # prefill-pool throughput block (ISSUE 14)
@@ -393,6 +416,10 @@ class TestBatcherServingStatus:
         assert st["adoptedLanes"] == 0
         assert st["peerPrefixFetches"] == 0
         assert st["hostCacheEvictions"] == 0
+        assert st["kvStoreBlocks"] == 0        # no store by default
+        assert st["kvStoreBytes"] == 0
+        assert st["kvStoreHitRate"] == 0.0
+        assert st["kvStoreEvictions"] == 0
         assert st["activeAdapters"] == 0       # no registry by default
         assert st["megastepN"] == 1            # single-step default
         assert st["dispatchesPerToken"] > 0
