@@ -27,8 +27,10 @@ test: native
 # detect them BEFORE the timed run and fail loudly with their PIDs so
 # the operator kills them instead of chasing a phantom slowdown.
 # (`router` alternation also matches `router.simfleet` subprocess
-# replicas; `prefill_serve` needs its own alternation — "infer.serve"
-# is not a substring of "infer.prefill_serve".)
+# replicas AND `router.replay` sim/sweep drivers — a wedged `make sim`
+# or serve-sim dryrun leaves exactly those behind; `prefill_serve`
+# needs its own alternation — "infer.serve" is not a substring of
+# "infer.prefill_serve".)
 tier1:
 	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet|paddle_operator_tpu\.infer\.kvstore' || true); \
 	if [ -n "$$pids" ]; then \
@@ -62,6 +64,17 @@ helm: gen-deploy
 bench:
 	$(PY) bench.py
 
+# Virtual-time policy sweep (ISSUE 18, router/replay.py): replay a
+# seeded bursty synthetic workload through the PRODUCTION control law
+# (controller/policy.py PolicyConfig — the sim imports it, never a
+# copy) in virtual time and score up_cooldown_s / scale_down_ratio
+# points on sim p95 TTFT + pod-seconds.  Sub-second wall for ~600
+# virtual fleet-seconds; `--trace <export.jsonl>` replays a recorded
+# /debug/tracez?format=jsonl export instead (docs/serving.md "Fleet
+# simulator").
+sim:
+	env JAX_PLATFORMS=cpu $(PY) -m paddle_operator_tpu.router.replay
+
 # CPU dry-run gate: entry forward + the 8-virtual-device multichip run
 # (all training parallelism axes, plus the serving parity lines:
 # serve-decode, serve-ring, serve-spec, serve-paged, serve-chaos,
@@ -71,7 +84,10 @@ bench:
 # serve-fleet, serve-qos, serve-megastep, serve-fleetkv,
 # serve-xdisagg, serve-prefillpool, serve-trace — tracing-on parity
 # vs the tracing-off oracle + cross-pod span-tree completeness + the
-# chaos flight-recorder dump naming its fault — serve-kvstore —
+# chaos flight-recorder dump naming its fault — serve-sim — traced
+# ring -> jsonl export -> rebuilt schedule -> virtual-time replay
+# through the imported production control law at >= 20x realtime
+# inside the smoke agreement envelope — serve-kvstore —
 # fleet-restart durable-store hits bit-identical to cold prefill
 # through the normal promote path at tp=1+tp=2 x quant off/on, with
 # the store-off default byte-identical to the pre-store ring — and
@@ -94,4 +110,4 @@ clean:
 	$(MAKE) -C native clean
 	rm -rf .pytest_cache
 
-.PHONY: all native test tier1 run gen-deploy install deploy helm bench dryrun chaos docker-build clean
+.PHONY: all native test tier1 run gen-deploy install deploy helm bench sim dryrun chaos docker-build clean

@@ -2179,6 +2179,172 @@ def _fold_autoscaler_summary(rows, summary, emit) -> None:
             / smax["autoscaler_pod_seconds"], 3)
 
 
+def measure_fleet_sim(*, agree_duration_s: float = 72.0,
+                      tuned_duration_s: float = 48.0,
+                      seed: int = 0,
+                      ttft_target_ms: float = 300.0,
+                      max_len: int = 64) -> list:
+    """Trace-driven fleet simulator, real-side validation (ISSUE 18).
+    Two phases, each on a real simfleet — production router,
+    production autoscaler driving real ``add_replica`` /
+    ``drain_replica`` — at the OLD up-cool-down (5s) vs the tuned
+    default (2s):
+
+    **Agreement** (``sim_agreement_*``): subprocess replicas (real
+    multi-second boots, compile isolated from the serving process)
+    under a single sustained burst staircase.  The virtual model is
+    calibrated from run A's folded latency histograms and measured
+    boot-to-ready ONLY (it never sees run B), then replays the same
+    workload under both policies; stated envelope — sim/real within
+    3x on p95 TTFT and 2x on pod-seconds, on BOTH the calibrated
+    setting (``sim_calib_p95_ratio``) and the held-out prediction
+    (``sim_agreement_p95`` / ``sim_agreement_pods``).  Wide on
+    purpose: a queueing model predicts load-vs-capacity dynamics,
+    and this 1-core box injects multi-x contention jitter on top.
+
+    **Tuned constant** (``sim_tuned_*``): in-process replicas under a
+    2-burst trace where a replica's marginal value is ADMISSION
+    CONCURRENCY (slots), the resource this box can actually scale —
+    horizontal compute it cannot, every replica shares one core, so
+    the boot-lag staircase above is meltdown-bound by construction
+    and says nothing about the constant.  Here the cold-compile p95
+    breach triggers the first up-step and the 2s gate admits the
+    follow-up step while the burst backlog still exists: the
+    before/after real rows behind policy.py's shipped
+    ``up_cooldown_s`` 5 -> 2 (observed 5-70x p95 TTFT reduction at
+    <5% pod-seconds cost, either run order).
+
+    ``sim_speedup`` is the virtual replay's trace-duration over
+    wall-clock, bar >= 20x."""
+    from paddle_operator_tpu.controller.policy import DEFAULT_POLICY
+    from paddle_operator_tpu.router import replay as R
+
+    pol_after = DEFAULT_POLICY                      # up_cooldown_s=2.0
+    pol_before = DEFAULT_POLICY.override(up_cooldown_s=5.0)
+    rows = []
+
+    def emit(backend: str, phase: str, tag: str, res: dict) -> dict:
+        row = {"fleet_sim_backend": backend,
+               "fleet_sim_phase": phase,
+               "fleet_sim_policy": tag,
+               "fleet_sim_p95_ttft_ms": res.get("p95TtftMs"),
+               "fleet_sim_mean_ttft_ms": res.get("meanTtftMs"),
+               "fleet_sim_pod_seconds": res.get("podSeconds"),
+               "fleet_sim_completed": res.get("completed"),
+               "fleet_sim_replicas_peak": res.get("replicasPeak"),
+               "fleet_sim_scale_events": res.get("scaleEvents"),
+               "fleet_sim_speedup": res.get("speedup"),
+               "fleet_sim_policy_diff": res.get("policy")}
+        rows.append(row)
+        return row
+
+    # --- agreement phase: subprocess boots, burst staircase ---------
+    # per-process thread caps, same rationale as the fleet bench: keep
+    # the parallelism in replica processes, not XLA fighting itself
+    cap_env = {
+        "XLA_FLAGS": "--xla_cpu_multi_thread_eigen=false "
+                     "intra_op_parallelism_threads=1",
+        "OMP_NUM_THREADS": "1", "OPENBLAS_NUM_THREADS": "1",
+    }
+    wl_a = R.synthetic_workload(
+        seed=seed, duration_s=agree_duration_s, mean_rps=8.0,
+        burst_factor=6.0, n_bursts=1, burst_frac=0.35,
+        prompt_median=12, prompt_sigma=0.5, max_prompt=24,
+        new_median=12, new_sigma=0.4, max_new=16)
+    agree_kw = dict(ttft_target_ms=ttft_target_ms, min_replicas=1,
+                    max_replicas=6, slots=1)
+    fkw = dict(subprocess_replicas=True, host_env=cap_env)
+    real_a = R.replay_on_simfleet(wl_a, policy=pol_before,
+                                  max_len=max_len, fleet_kw=fkw,
+                                  **agree_kw)
+    emit("simfleet", "agree", "before_ucd5", real_a)
+    # calibrate on A only; B is held out for the prediction check
+    fams = (real_a.get("serving") or {}).get("latencyHist") or {}
+    mean_p = (sum(r.prompt_len for r in wl_a.requests)
+              / max(len(wl_a.requests), 1))
+    calib = R.Calibration.from_hists(
+        fams, mean_prompt_len=mean_p,
+        boot_s=real_a.get("bootSecondsMean") or 2.0)
+    virt_a = R.VirtualFleet(wl_a, calib, policy=pol_before,
+                            **agree_kw).run().to_dict()
+    emit("virtual", "agree", "before_ucd5", virt_a)
+    virt_b = R.VirtualFleet(wl_a, calib, policy=pol_after,
+                            **agree_kw).run().to_dict()
+    emit("virtual", "agree", "after_ucd2", virt_b)
+    real_b = R.replay_on_simfleet(wl_a, policy=pol_after,
+                                  max_len=max_len, fleet_kw=fkw,
+                                  **agree_kw)
+    emit("simfleet", "agree", "after_ucd2", real_b)
+    rows[0]["fleet_sim_calibration"] = calib.to_dict()
+
+    # --- tuned-constant phase: in-process, slots are the capacity ---
+    wl_t = R.synthetic_workload(
+        seed=seed, duration_s=tuned_duration_s, mean_rps=5.0,
+        burst_factor=8.0, n_bursts=2,
+        prompt_median=12, prompt_sigma=0.5, max_prompt=24,
+        new_median=12, new_sigma=0.4, max_new=16)
+    tuned_kw = dict(ttft_target_ms=ttft_target_ms, min_replicas=1,
+                    max_replicas=3, slots=2)
+    emit("simfleet", "tuned", "before_ucd5",
+         R.replay_on_simfleet(wl_t, policy=pol_before,
+                              max_len=max_len, **tuned_kw))
+    emit("simfleet", "tuned", "after_ucd2",
+         R.replay_on_simfleet(wl_t, policy=pol_after,
+                              max_len=max_len, **tuned_kw))
+    return rows
+
+
+def _fold_fleet_sim_summary(rows, summary, emit) -> None:
+    for entry in rows if isinstance(rows, list) else [rows]:
+        emit("fleet_sim", entry)
+    if not isinstance(rows, list):
+        return
+    by = {(r["fleet_sim_backend"], r.get("fleet_sim_phase"),
+           r["fleet_sim_policy"]): r for r in rows}
+    real_a = by.get(("simfleet", "agree", "before_ucd5"))
+    real_b = by.get(("simfleet", "agree", "after_ucd2"))
+    virt_a = by.get(("virtual", "agree", "before_ucd5"))
+    virt_b = by.get(("virtual", "agree", "after_ucd2"))
+    tuned_a = by.get(("simfleet", "tuned", "before_ucd5"))
+    tuned_b = by.get(("simfleet", "tuned", "after_ucd2"))
+    if tuned_a and tuned_b:
+        # the tuned-constant headline: real before/after at the old
+        # (5s) and shipped (2s) up-cool-down on the same bursty trace
+        summary["sim_tuned_before_p95_ttft_ms"] = \
+            tuned_a["fleet_sim_p95_ttft_ms"]
+        summary["sim_tuned_after_p95_ttft_ms"] = \
+            tuned_b["fleet_sim_p95_ttft_ms"]
+        summary["sim_tuned_before_pod_seconds"] = \
+            tuned_a["fleet_sim_pod_seconds"]
+        summary["sim_tuned_after_pod_seconds"] = \
+            tuned_b["fleet_sim_pod_seconds"]
+        if tuned_a["fleet_sim_p95_ttft_ms"]:
+            summary["sim_tuned_p95_ratio"] = round(
+                tuned_b["fleet_sim_p95_ttft_ms"]
+                / tuned_a["fleet_sim_p95_ttft_ms"], 3)
+    if virt_a and real_a and real_a["fleet_sim_p95_ttft_ms"]:
+        # calibration fit: the setting the model was fitted on
+        summary["sim_calib_p95_ratio"] = round(
+            virt_a["fleet_sim_p95_ttft_ms"]
+            / real_a["fleet_sim_p95_ttft_ms"], 3)
+        if real_a["fleet_sim_pod_seconds"]:
+            summary["sim_calib_pods_ratio"] = round(
+                virt_a["fleet_sim_pod_seconds"]
+                / real_a["fleet_sim_pod_seconds"], 3)
+    if virt_b and real_b and real_b["fleet_sim_p95_ttft_ms"]:
+        # the held-out prediction: sim/real on the setting the model
+        # never saw — stated envelope 3x on p95, 2x on pod-seconds
+        summary["sim_agreement_p95"] = round(
+            virt_b["fleet_sim_p95_ttft_ms"]
+            / real_b["fleet_sim_p95_ttft_ms"], 3)
+        if real_b["fleet_sim_pod_seconds"]:
+            summary["sim_agreement_pods"] = round(
+                virt_b["fleet_sim_pod_seconds"]
+                / real_b["fleet_sim_pod_seconds"], 3)
+    if virt_b and virt_b.get("fleet_sim_speedup"):
+        summary["sim_speedup"] = round(virt_b["fleet_sim_speedup"], 1)
+
+
 def measure_prefill_pool(*, prompt_lens=(256, 2048), bursts=(16, 6),
                          chunk=256, block_size=64, lanes_hi=4,
                          hol_probes=8, short_len=64, ttft_probes=5,
@@ -3534,6 +3700,17 @@ def main() -> int:
     # host arithmetic; identical on any box.
     _fold_autoscaler_summary(
         guarded("autoscaler", lambda: measure_autoscaler()),
+        summary, emit)
+
+    # trace-driven fleet simulator (ISSUE 18): subprocess-boot burst
+    # staircase at the old (5s) vs shipped (2s) up-cool-down with the
+    # virtual-time model calibrated on the 5s run predicting the
+    # held-out 2s run — sim_calib_p95_ratio + sim_agreement_p95/_pods
+    # within the stated 3x / 2x envelope, sim_speedup >= 20x — plus
+    # the in-process slot-capacity before/after behind the tuned
+    # default (sim_tuned_* rows)
+    _fold_fleet_sim_summary(
+        guarded("fleet_sim", lambda: measure_fleet_sim()),
         summary, emit)
 
     # tracing overhead (ISSUE 15): tok/s with span capture ON over OFF

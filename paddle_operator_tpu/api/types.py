@@ -23,6 +23,13 @@ import re
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+# Shared policy surface (ISSUE 18): AutoscaleSpec's cool-down /
+# hysteresis defaults are the law's defaults, declared once in the
+# jax-free controller/policy.py the replay simulator sweeps.
+from paddle_operator_tpu.controller.policy import (
+    DEFAULT_POLICY as _POLICY,
+)
+
 # ---------------------------------------------------------------------------
 # Constants (reference: api/v1/paddlejob_types.go:27-45, controllers/*.go)
 # ---------------------------------------------------------------------------
@@ -378,15 +385,20 @@ class AutoscaleSpec:
       (0.5 default), so load hovering AT the threshold never flaps.
     """
 
+    # cool-down / hysteresis defaults come from the shared policy
+    # surface (controller/policy.py, ISSUE 18): the replay simulator
+    # sweeps PolicyConfig, and a tuned constant landed there IS the
+    # production default a spec that says nothing gets — the
+    # tests/test_replay.py drift pin keeps the two from diverging.
     ttft_target_ms: float = 0.0
     tok_s_per_replica: float = 0.0
     min_replicas: int = 1
     max_replicas: int = 0
     prefill_min: int = 1
     prefill_max: int = 0
-    cooldown_s: float = 30.0
-    up_cooldown_s: float = 5.0
-    scale_down_ratio: float = 0.5
+    cooldown_s: float = _POLICY.cooldown_s
+    up_cooldown_s: float = _POLICY.up_cooldown_s
+    scale_down_ratio: float = _POLICY.scale_down_ratio
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {}
@@ -402,11 +414,11 @@ class AutoscaleSpec:
             d["prefillMin"] = self.prefill_min
         if self.prefill_max:
             d["prefillMax"] = self.prefill_max
-        if self.cooldown_s != 30.0:
+        if self.cooldown_s != _POLICY.cooldown_s:
             d["cooldownS"] = self.cooldown_s
-        if self.up_cooldown_s != 5.0:
+        if self.up_cooldown_s != _POLICY.up_cooldown_s:
             d["upCooldownS"] = self.up_cooldown_s
-        if self.scale_down_ratio != 0.5:
+        if self.scale_down_ratio != _POLICY.scale_down_ratio:
             d["scaleDownRatio"] = self.scale_down_ratio
         return d
 
@@ -422,9 +434,11 @@ class AutoscaleSpec:
             max_replicas=int(d.get("maxReplicas", 0)),
             prefill_min=int(d.get("prefillMin", 1)),
             prefill_max=int(d.get("prefillMax", 0)),
-            cooldown_s=float(d.get("cooldownS", 30.0)),
-            up_cooldown_s=float(d.get("upCooldownS", 5.0)),
-            scale_down_ratio=float(d.get("scaleDownRatio", 0.5)),
+            cooldown_s=float(d.get("cooldownS", _POLICY.cooldown_s)),
+            up_cooldown_s=float(d.get("upCooldownS",
+                                      _POLICY.up_cooldown_s)),
+            scale_down_ratio=float(d.get("scaleDownRatio",
+                                         _POLICY.scale_down_ratio)),
         )
 
 

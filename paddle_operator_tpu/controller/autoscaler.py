@@ -46,18 +46,19 @@ import math
 from typing import Any, Dict, Optional, Tuple
 
 from paddle_operator_tpu.api.types import AutoscaleSpec
+from paddle_operator_tpu.controller.policy import (
+    DEFAULT_POLICY,
+    PolicyConfig,
+)
 
 # status.serving.fleet key the reconciler persists decisions under
 STATE_KEY = "autoscaler"
 
-# The law targets this fraction of the declared TTFT SLO as its
-# steady-state setpoint.  Controlling AT the limit means every boot
-# transient and burst onset breaches it — p95 lives in the transients;
-# holding the queue at half the budget leaves the headroom that
-# absorbs them (the standard SLO-setpoint discipline; 0.5 holds the
-# bench's bursty reference trace at p95 0.9x the target where 1.0
-# breached it by 40%).
-SLO_HEADROOM = 0.5
+# The SLO setpoint fraction — the law constant moved to the shared
+# policy surface (controller/policy.py, ISSUE 18) so the replay
+# simulator sweeps THE number the fleet runs; re-exported here because
+# this module is where every prior consumer imports it from.
+SLO_HEADROOM = DEFAULT_POLICY.slo_headroom
 
 
 def prefill_load_ratio(queue_depth: float, ready: int,
@@ -65,7 +66,8 @@ def prefill_load_ratio(queue_depth: float, ready: int,
                        ttft_target_ms: float,
                        lanes: int = 1,
                        batch_occupancy: Optional[float] = None,
-                       ttft_p95_ms: Optional[float] = None
+                       ttft_p95_ms: Optional[float] = None,
+                       policy: PolicyConfig = DEFAULT_POLICY
                        ) -> float:
     """Observed prefill load over SLO capacity.  Queued jobs
     serialize per pod in batches of ``lanes`` (the ISSUE 14 N-lane
@@ -110,8 +112,8 @@ def prefill_load_ratio(queue_depth: float, ready: int,
     if prefill_ms_avg > 0:
         allowed_per_pod = max(
             1.0,
-            lanes * (ttft_target_ms * SLO_HEADROOM / prefill_ms_avg
-                     - 1.0))
+            lanes * (ttft_target_ms * policy.slo_headroom
+                     / prefill_ms_avg - 1.0))
     else:
         allowed_per_pod = float(lanes)
     depth = float(queue_depth)
@@ -146,7 +148,8 @@ def decode_load_ratio(tokens_per_sec: float, queue_depth: float,
 def step(spec_min: int, spec_max: int, current: int, ratio: float, *,
          now: float, last_scale_t: float, cooldown_s: float,
          up_cooldown_s: float, scale_down_ratio: float,
-         draining: bool) -> Tuple[int, str]:
+         draining: bool,
+         policy: PolicyConfig = DEFAULT_POLICY) -> Tuple[int, str]:
     """One control-law step for one pool: returns ``(desired,
     reason)`` where reason is "" when nothing changes.  ``current`` is
     the pool's current DESIRED count (the stored decision, not the
@@ -158,15 +161,17 @@ def step(spec_min: int, spec_max: int, current: int, ratio: float, *,
     clamped = min(max(current, lo), hi)
     if clamped != current:
         return clamped, "clamp"             # spec bounds moved
-    if ratio > 1.0 and current < hi:
+    if ratio > policy.up_threshold and current < hi:
         if now - last_scale_t < up_cooldown_s:
             return current, ""              # (short) up cool-down
         # proportional step: a 3x overload asks for ~3x the pods in
         # one window, still clamped; the anticipatory denominator
         # (observe()) keeps consecutive windows from compounding the
         # same backlog into runaway growth
-        want = min(hi, max(current + 1,
-                           int(math.ceil(current * min(ratio, 4.0)))))
+        want = min(hi, max(
+            current + 1,
+            int(math.ceil(current * min(ratio,
+                                        policy.max_up_factor)))))
         return want, "up"
     if ratio < scale_down_ratio and current > lo:
         if draining:
@@ -182,8 +187,15 @@ class FleetAutoscaler:
     pass the stored state dict (``status.serving.fleet.autoscaler``)
     in and persist the returned one."""
 
-    def __init__(self, spec: AutoscaleSpec) -> None:
+    def __init__(self, spec: AutoscaleSpec,
+                 policy: PolicyConfig = DEFAULT_POLICY) -> None:
         self.spec = spec
+        # the law constants NOT on the CRD surface (up_threshold,
+        # max_up_factor, slo_headroom) — production always runs the
+        # defaults; the replay simulator (router/replay.py) passes
+        # sweep points here so a sweep can move THE law's constants,
+        # not a copy of them
+        self.policy = policy
 
     def observe(self, state: Optional[Dict[str, Any]],
                 serving: Dict[str, Any], *, decode_spec: int,
@@ -231,20 +243,21 @@ class FleetAutoscaler:
             a.ttft_target_ms,
             lanes=int(serving.get("prefillLanes", 1) or 1),
             batch_occupancy=(float(occ) if occ is not None else None),
-            ttft_p95_ms=(float(p95) if p95 else None))
+            ttft_p95_ms=(float(p95) if p95 else None),
+            policy=self.policy)
 
         d_new, d_why = step(
             a.min_replicas, a.max_replicas, d_cur, d_ratio, now=now,
             last_scale_t=d_last, cooldown_s=a.cooldown_s,
             up_cooldown_s=a.up_cooldown_s,
             scale_down_ratio=a.scale_down_ratio,
-            draining=decode_draining)
+            draining=decode_draining, policy=self.policy)
         p_new, p_why = step(
             a.prefill_min, a.prefill_max, p_cur, p_ratio, now=now,
             last_scale_t=p_last, cooldown_s=a.cooldown_s,
             up_cooldown_s=a.up_cooldown_s,
             scale_down_ratio=a.scale_down_ratio,
-            draining=prefill_draining)
+            draining=prefill_draining, policy=self.policy)
         return {
             "decodeDesired": d_new,
             "prefillDesired": p_new,

@@ -57,6 +57,11 @@ import queue as _queue
 
 import numpy as np
 
+from paddle_operator_tpu.controller.policy import (
+    DEFAULT_POLICY as _POLICY,
+    PolicyConfig,
+)
+
 MAX_PRIORITIES = 8
 
 # adapter names become Prometheus label values and routing keys — keep
@@ -88,14 +93,19 @@ class QoSConfig:
     - ``preempt_budget`` / ``preempt_window_s``: at most ``budget``
       preemptions per rolling window (anti-thrash: a pathological
       priority mix degrades to FIFO, never to spill churn).
+
+    Defaults come from the shared policy surface
+    (controller/policy.py, ISSUE 18) — the replay simulator sweeps
+    these budgets as ``PolicyConfig`` fields, so the numbers a sweep
+    scores ARE the numbers this config defaults to.
     """
 
-    priorities: int = 2
+    priorities: int = _POLICY.priorities
     default_priority: Optional[int] = None
     preempt: bool = True
-    max_preempts_per_request: int = 2
-    preempt_budget: int = 16
-    preempt_window_s: float = 10.0
+    max_preempts_per_request: int = _POLICY.max_preempts_per_request
+    preempt_budget: int = _POLICY.preempt_budget
+    preempt_window_s: float = _POLICY.preempt_window_s
 
     def __post_init__(self) -> None:
         if not 1 <= self.priorities <= MAX_PRIORITIES:
@@ -109,18 +119,37 @@ class QoSConfig:
                 f"[0, {self.priorities})")
 
     @classmethod
+    def from_policy(cls, policy: PolicyConfig,
+                    **overrides: Any) -> "QoSConfig":
+        """Bind the QoS budgets a :class:`PolicyConfig` names — the
+        constructor the scheduler's default path and the replay
+        simulator share, so a swept sweep point configures the REAL
+        admission machinery, not a parallel copy of its knobs."""
+        kw: Dict[str, Any] = dict(
+            priorities=policy.priorities,
+            max_preempts_per_request=policy.max_preempts_per_request,
+            preempt_budget=policy.preempt_budget,
+            preempt_window_s=policy.preempt_window_s,
+        )
+        kw.update(overrides)
+        return cls(**kw)
+
+    @classmethod
     def from_env(cls) -> "QoSConfig":
         import os
 
         return cls(
-            priorities=int(os.environ.get("SERVE_PRIORITIES", "2")),
+            priorities=int(os.environ.get(
+                "SERVE_PRIORITIES", str(_POLICY.priorities))),
             preempt=os.environ.get("SERVE_PREEMPT", "1") == "1",
-            max_preempts_per_request=int(
-                os.environ.get("SERVE_PREEMPT_MAX_PER_REQ", "2")),
-            preempt_budget=int(
-                os.environ.get("SERVE_PREEMPT_BUDGET", "16")),
-            preempt_window_s=float(
-                os.environ.get("SERVE_PREEMPT_WINDOW_S", "10")),
+            max_preempts_per_request=int(os.environ.get(
+                "SERVE_PREEMPT_MAX_PER_REQ",
+                str(_POLICY.max_preempts_per_request))),
+            preempt_budget=int(os.environ.get(
+                "SERVE_PREEMPT_BUDGET", str(_POLICY.preempt_budget))),
+            preempt_window_s=float(os.environ.get(
+                "SERVE_PREEMPT_WINDOW_S",
+                str(_POLICY.preempt_window_s))),
         )
 
 

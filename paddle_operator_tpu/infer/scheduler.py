@@ -774,6 +774,14 @@ class ContinuousBatcher:
         if self.tracer is not None:
             req.trace = self.tracer.begin(ctx=trace_ctx,
                                           request_id=request_id)
+            # workload-shape stamps (ISSUE 18): with these on the root
+            # span, an exported span tree alone reconstructs the
+            # request the fleet served — router/replay.py rebuilds
+            # open-loop replay schedules from exactly these attrs
+            req.trace.annotate(promptLen=len(prompt),
+                               maxNew=int(max_new_tokens),
+                               prio=prio,
+                               adapter=adapter)
         req.priority = prio
         req.adapter = adapter
         req.adapter_idx = adapter_idx

@@ -1089,14 +1089,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._send(200, r.statusz())
         elif self.path.split("?", 1)[0] == "/debug/tracez":
             # stitched cross-pod timelines (ISSUE 15): newest-last
-            # bounded LRU; ?trace_id= narrows to one
+            # bounded LRU; ?trace_id= narrows to one; ?format=jsonl
+            # (ISSUE 18) streams the machine-readable export — span
+            # trees plus the fleet-folded histogram snapshot — that
+            # router/replay.py consumes as a recorded workload
             query = self.path.partition("?")[2]
             tid = None
+            fmt = None
             for part in query.split("&"):
                 k, _, v = part.partition("=")
                 if k == "trace_id" and v:
                     tid = v
-            if tid is not None:
+                elif k == "format" and v:
+                    fmt = v
+            if fmt == "jsonl":
+                lh = [b for st in r.replicas.values()
+                      if (b := st.latency_hist_block())]
+                folded = TRC.fold_latency_hists(lh) if lh else None
+                tls = r.traces.timelines()
+                if tid is not None:
+                    tls = [t for t in tls if t.get("traceId") == tid]
+                body = TRC.export_jsonl(tls, hists=folded).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/jsonl")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif tid is not None:
                 tl = r.traces.get(tid)
                 self._send(200 if tl else 404,
                            tl or {"error": f"no timeline {tid}"})

@@ -94,12 +94,19 @@ class SimFleet:
                  host_env: Optional[Dict[str, str]] = None,
                  ring_extra: Optional[Dict[str, Any]] = None,
                  fleet_kv: bool = False,
-                 prefill_pool: int = 0) -> None:
+                 prefill_pool: int = 0,
+                 trace: bool = False) -> None:
         self.block_size = block_size
         self.ring_kw: Dict[str, Any] = dict(
             slots=slots, max_len=max_len, chunk_tokens=chunk_tokens,
             prefill_buckets=tuple(prefill_buckets), paged=True,
             block_size=block_size, prefix_cache=True)
+        if trace:
+            # span capture on every replica ring + timeline stitching
+            # in the router (ISSUE 18: the replay harness records
+            # fleets with trace=True and exports
+            # /debug/tracez?format=jsonl as its workload format)
+            self.ring_kw["trace"] = True
         if num_blocks is not None:
             self.ring_kw["num_blocks"] = num_blocks
         # extra ring knobs (ISSUE 12 fleet-KV tests size a host tier
@@ -136,7 +143,8 @@ class SimFleet:
             affinity_blocks=2 if affinity else 0,
             hot_queue_depth=hot_queue_depth,
             scrape_interval=scrape_interval,
-            prefill_endpoints=self.prefill_endpoints())
+            prefill_endpoints=self.prefill_endpoints(),
+            trace=trace or None)
         self.router_srv = make_router_server("127.0.0.1", 0,
                                              self.router)
         # short poll: shutdown() blocks a full poll interval per

@@ -182,6 +182,19 @@ class RequestTrace:
                 name, parent or self.root_id, self._wall_ms(t0_mono),
                 (t1 - t0_mono) * 1e3, pod=self.pod, **attrs))
 
+    def annotate(self, **attrs: Any) -> None:
+        """Merge attrs into the ROOT span (workload-shape stamps:
+        ``promptLen``/``maxNew``/``prio`` at scheduler submit) so an
+        exported span tree alone reconstructs the request the fleet
+        served — the replay harness (router/replay.py) rebuilds
+        open-loop schedules from exactly these attrs.  None values are
+        skipped; telemetry never raises."""
+        clean = {k: v for k, v in attrs.items() if v is not None}
+        if not clean:
+            return
+        with self._lock:
+            self.spans[0].setdefault("attrs", {}).update(clean)
+
     def seed(self, spans: Sequence[Dict[str, Any]]) -> None:
         """Graft a PRIOR pod's completed spans (lane migration: the
         origin's spans travel in the envelope meta so the adopter's
@@ -564,3 +577,83 @@ class TraceStore:
         with self._lock:
             return json.loads(json.dumps(list(
                 self._timelines.values())))
+
+
+# ---------------------------------------------------------------------------
+# Machine-readable export (ISSUE 18): span trees + histogram snapshots
+# as JSONL, the replay harness's recorded-trace input format
+# ---------------------------------------------------------------------------
+
+# one export line per record; "kind" discriminates
+EXPORT_KIND_TIMELINE = "timeline"
+EXPORT_KIND_HIST = "hist"
+
+
+def export_jsonl(timelines: Sequence[Dict[str, Any]],
+                 hists: Optional[Dict[str, Any]] = None,
+                 pod: str = "") -> str:
+    """Serialize stitched timelines (and optionally a
+    :meth:`ServeHistograms.snapshot` / :func:`fold_latency_hists`
+    block) as JSONL — one self-describing JSON object per line, so a
+    replay consumer streams records without loading the whole export,
+    and exports CONCATENATE across pods/scrapes by plain file append
+    (the property JSON arrays lack, and the reason the format is
+    JSONL at all).  Each line carries ``kind``:
+    ``timeline`` (one stitched trace: traceId + spans) or ``hist``
+    (one histogram snapshot block, ``families`` keyed like
+    :data:`HIST_FAMILIES` — the calibration input for the virtual-time
+    fleet model)."""
+    lines: List[str] = []
+    for tl in timelines:
+        rec = {"kind": EXPORT_KIND_TIMELINE}
+        rec.update(tl)
+        lines.append(json.dumps(rec, sort_keys=True))
+    if hists:
+        rec = {"kind": EXPORT_KIND_HIST, "families": hists}
+        if pod:
+            rec["pod"] = pod
+        lines.append(json.dumps(rec, sort_keys=True))
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def parse_jsonl_export(text: str) -> Dict[str, Any]:
+    """Parse an :func:`export_jsonl` stream (possibly several exports
+    concatenated) back into ``{"timelines": [...], "hists": [...]}``.
+    Unknown kinds and malformed lines are SKIPPED, not fatal — a
+    replay must tolerate an export truncated by the pod dying
+    mid-write, which is precisely when its trace matters most."""
+    timelines: List[Dict[str, Any]] = []
+    hists: List[Dict[str, Any]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        kind = rec.get("kind")
+        if kind == EXPORT_KIND_TIMELINE and rec.get("spans"):
+            timelines.append(rec)
+        elif kind == EXPORT_KIND_HIST and rec.get("families"):
+            hists.append(rec)
+    return {"timelines": timelines, "hists": hists}
+
+
+def read_flightrec_dump(path: str) -> Dict[str, Any]:
+    """Read a :meth:`FlightRecorder.dump_file` JSON dump back as a
+    dict (``{"pod", "reason", "t", "events"}``) — the OTHER recorded
+    workload source replay accepts: ``admit`` events carry arrival
+    wall-time, request id and priority, enough to rebuild an open-loop
+    arrival schedule when span capture was off.  Raises OSError /
+    ValueError on an unreadable or non-dump file — a replay fed a
+    wrong path should fail loudly, unlike the in-band telemetry
+    paths."""
+    with open(path) as f:
+        d = json.load(f)
+    if not isinstance(d, dict) or "events" not in d:
+        raise ValueError(f"{path}: not a flight-recorder dump "
+                         "(no 'events' key)")
+    return d

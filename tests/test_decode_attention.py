@@ -127,6 +127,9 @@ class TestShardedKernel:
                                      jnp.asarray([3, 5], jnp.int32), wo,
                                      interpret=True)
 
+    # ~6s; tp-sharded generate token identity is pinned by the dryrun
+    # serve-decode gate, so this twin rides -m slow
+    @pytest.mark.slow
     def test_generate_tp_sharded_token_identical(self):
         """Acceptance bar: sharded-vs-single-device token match for the
         pallas decode kernel through the full generate() path (tp=2

@@ -29,7 +29,12 @@ from tests.ft_worker import launch
 STEPS, SPLIT = 6, 3
 
 
+@pytest.mark.slow
 class TestElasticResumeParity:
+    """Heavyweight (~20s fresh-interpreter fixture); the drain-forced
+    checkpoint -> elastic resume invariant is pinned fast by the dryrun
+    ft-drain gate, so the full parity matrix rides ``-m slow``."""
+
     @pytest.fixture(scope="class")
     def worker(self):
         """One fresh-interpreter run: uninterrupted dp=4 baseline, save at

@@ -230,6 +230,10 @@ class TestInjectedPreemptionEndToEnd:
     block in-process.
     """
 
+    # ~14s (fresh-interpreter drain worker); the injected-drain ->
+    # elastic-resume -> goodput invariant is pinned by the dryrun
+    # ft-drain gate, so this end-to-end twin rides ``-m slow``
+    @pytest.mark.slow
     def test_sigterm_drain_elastic_resume_goodput(self, tmp_path):
         import socket
         import urllib.request

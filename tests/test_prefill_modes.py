@@ -345,6 +345,9 @@ class TestDisaggSpecifics:
         finally:
             b.close()
 
+    # ~6s; exactly-once + pool-invariant under chaos disagg is pinned
+    # by the dryrun serve-chaos gate, so this twin rides -m slow
+    @pytest.mark.slow
     def test_chaos_disagg_exactly_once_and_pool_invariant(self, setup):
         """The PR 5 chaos bars under SERVE_PREFILL=disagg: a seeded
         dispatch failure + NaN lane + client drop + drain in one ring

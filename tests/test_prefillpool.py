@@ -459,6 +459,9 @@ class TestHeadOfLine:
 
 
 class TestEnginePearity:
+    # ~8s; the lanes=4 chunk-interleave bit-parity invariant is pinned
+    # by the dryrun serve-prefillpool gate, so this twin rides -m slow
+    @pytest.mark.slow
     def test_lanes4_stream_interleave_bit_identical(self):
         """The tier-1 parity leg: lanes=4 × chunk-interleave ×
         streamed handoff, greedy-bit-identical to ``decode.generate``

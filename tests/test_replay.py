@@ -1,0 +1,329 @@
+"""Trace-driven fleet simulator (ISSUE 18, router/replay.py +
+controller/policy.py): synthetic workload generation (seeded
+determinism, distribution sanity, arrival monotonicity), the
+policy-drift pins (the sim IMPORTS the production control law and
+PolicyConfig — never a copy — and AutoscaleSpec/QoSConfig defaults
+are policy-sourced), the virtual-time fleet model, JSONL trace-export
+round-trips, and the tpujob_sim_* doc-drift guard.  The sim-vs-real
+agreement envelope rides the dryrun ``serve-sim`` line and the bench's
+``fleet_sim`` rows — everything here is host-only and fast."""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from paddle_operator_tpu.controller import autoscaler as A
+from paddle_operator_tpu.controller.policy import (
+    DEFAULT_POLICY,
+    PolicyConfig,
+)
+from paddle_operator_tpu.infer import qos as QOS
+from paddle_operator_tpu.router import replay as R
+from paddle_operator_tpu.utils import tracing as TR
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+# ---------------------------------------------------------------------------
+# Synthetic workload generator
+# ---------------------------------------------------------------------------
+
+
+class TestSyntheticWorkload:
+    def test_seeded_determinism_byte_identical(self):
+        """Same seed -> byte-identical schedule file: the property
+        that makes a sweep's policy comparison a controlled
+        experiment (every point replays the SAME arrivals)."""
+        a = R.synthetic_workload(seed=7, duration_s=60.0, mean_rps=3.0)
+        b = R.synthetic_workload(seed=7, duration_s=60.0, mean_rps=3.0)
+        assert a.to_jsonl() == b.to_jsonl()
+        c = R.synthetic_workload(seed=8, duration_s=60.0, mean_rps=3.0)
+        assert c.to_jsonl() != a.to_jsonl()
+
+    def test_arrivals_monotone_and_bounded(self):
+        wl = R.synthetic_workload(seed=1, duration_s=45.0,
+                                  mean_rps=4.0)
+        ts = [r.t for r in wl.requests]
+        assert ts == sorted(ts)
+        assert all(0.0 <= t <= 45.0 for t in ts)
+        assert wl.duration_s == pytest.approx(45.0)
+
+    def test_distribution_sanity(self):
+        wl = R.synthetic_workload(seed=3, duration_s=120.0,
+                                  mean_rps=4.0, burst_factor=4.0)
+        n = len(wl.requests)
+        # NHPP around the base rate: thinning keeps it well under the
+        # peak envelope, bursts keep it near-or-above the mean
+        assert 0.5 * 4.0 * 120.0 < n < 4.0 * 4.0 * 120.0
+        assert all(1 <= r.prompt_len <= 48 for r in wl.requests)
+        assert all(1 <= r.max_new <= 24 for r in wl.requests)
+        prios = {r.priority for r in wl.requests}
+        assert prios == {0, 1}          # both classes of the 25/75 mix
+
+    def test_bursts_concentrate_arrivals(self):
+        """Burst windows exist: the max arrivals in any 5s window is
+        well above the base-rate expectation."""
+        wl = R.synthetic_workload(seed=0, duration_s=120.0,
+                                  mean_rps=2.0, burst_factor=6.0,
+                                  n_bursts=2)
+        counts = [0] * 24
+        for r in wl.requests:
+            counts[min(int(r.t / 5.0), 23)] += 1
+        assert max(counts) >= 3 * (2.0 * 5.0) / 2
+
+    def test_workload_jsonl_roundtrip(self):
+        wl = R.synthetic_workload(seed=5, duration_s=30.0,
+                                  mean_rps=2.0)
+        back = R.Workload.from_jsonl(wl.to_jsonl())
+        # arrival t is written at microsecond precision, so the file
+        # form (not the float) is the identity that round-trips
+        assert back.to_jsonl() == wl.to_jsonl()
+        assert [(r.prompt_len, r.max_new, r.priority, r.adapter)
+                for r in back.requests] == \
+            [(r.prompt_len, r.max_new, r.priority, r.adapter)
+             for r in wl.requests]
+        assert back.duration_s == pytest.approx(wl.duration_s)
+
+
+# ---------------------------------------------------------------------------
+# Policy drift pins: one source of truth for control-law constants
+# ---------------------------------------------------------------------------
+
+
+class TestPolicyDrift:
+    def test_sim_imports_the_production_law(self):
+        """The sim must IMPORT the production control law, never copy
+        it — identity (is), not equality, so a fork can't sneak in."""
+        assert R.FleetAutoscaler is A.FleetAutoscaler
+        assert R.DEFAULT_POLICY is DEFAULT_POLICY
+        wl = R.Workload([R.SimRequest(t=0.0, prompt_len=4, max_new=2)],
+                        1.0, source="pin")
+        vf = R.VirtualFleet(wl, R.Calibration())
+        assert type(vf.autoscaler) is A.FleetAutoscaler
+        assert vf.autoscaler.policy is DEFAULT_POLICY
+
+    def test_autoscale_spec_defaults_are_policy_sourced(self):
+        from paddle_operator_tpu.api.types import AutoscaleSpec
+
+        spec = AutoscaleSpec()
+        assert spec.cooldown_s == DEFAULT_POLICY.cooldown_s
+        assert spec.up_cooldown_s == DEFAULT_POLICY.up_cooldown_s
+        assert spec.scale_down_ratio == DEFAULT_POLICY.scale_down_ratio
+        assert A.SLO_HEADROOM == DEFAULT_POLICY.slo_headroom
+
+    def test_qos_defaults_are_policy_sourced(self):
+        q = QOS.QoSConfig()
+        assert q.priorities == DEFAULT_POLICY.priorities
+        assert q.preempt_budget == DEFAULT_POLICY.preempt_budget
+        assert q.preempt_window_s == DEFAULT_POLICY.preempt_window_s
+        assert (q.max_preempts_per_request
+                == DEFAULT_POLICY.max_preempts_per_request)
+        q3 = QOS.QoSConfig.from_policy(
+            DEFAULT_POLICY.override(priorities=3))
+        assert q3.priorities == 3
+
+    def test_tuned_constant_landed(self):
+        """ISSUE 18's sweep result shipped: up-cool-down 5s -> 2s."""
+        assert DEFAULT_POLICY.up_cooldown_s == 2.0
+
+    def test_override_and_diff(self):
+        p = DEFAULT_POLICY.override(up_cooldown_s=5.0)
+        assert isinstance(p, PolicyConfig)
+        assert p.up_cooldown_s == 5.0
+        assert DEFAULT_POLICY.up_cooldown_s == 2.0    # frozen source
+        assert DEFAULT_POLICY.diff(p) == {"up_cooldown_s": 5.0}
+        assert DEFAULT_POLICY.diff(DEFAULT_POLICY) == {}
+        with pytest.raises(Exception):
+            p.up_cooldown_s = 1.0                     # frozen
+
+
+# ---------------------------------------------------------------------------
+# Virtual-time fleet model
+# ---------------------------------------------------------------------------
+
+# the validated sweep regime: small-real-model service times, a target
+# with deployment headroom (~5x bare service), bursty open-loop load
+CALIB = R.Calibration(prefill_ms_token=8.0, itl_ms=30.0, boot_s=4.0)
+
+
+def _bursty(seed=0, duration_s=120.0):
+    return R.synthetic_workload(seed=seed, duration_s=duration_s,
+                                mean_rps=2.0, burst_factor=6.0,
+                                n_bursts=2)
+
+
+class TestVirtualFleet:
+    def test_completes_all_and_scales_up(self):
+        wl = _bursty()
+        res = R.VirtualFleet(wl, CALIB, ttft_target_ms=1000.0,
+                             max_replicas=4).run()
+        assert res.completed == len(wl.requests)
+        assert res.replicas_peak > 1        # the bursts forced an up
+        assert res.scale_events > 0
+        assert res.pod_seconds > 0.0
+
+    def test_deterministic_scores(self):
+        wl = _bursty(seed=2)
+        kw = dict(ttft_target_ms=1000.0, max_replicas=4)
+        d1 = R.VirtualFleet(wl, CALIB, **kw).run().to_dict()
+        d2 = R.VirtualFleet(wl, CALIB, **kw).run().to_dict()
+        for k in ("p95TtftMs", "meanTtftMs", "podSeconds",
+                  "completed", "replicasPeak", "scaleEvents"):
+            assert d1[k] == d2[k], k
+
+    def test_virtual_speedup_bar(self):
+        """The acceptance bar is 20x faster than trace wall-clock;
+        the event loop actually clears it by orders of magnitude."""
+        res = R.VirtualFleet(_bursty(), CALIB,
+                             ttft_target_ms=1000.0).run()
+        assert res.speedup >= 20.0
+
+    def test_tuned_up_cooldown_beats_old_default(self):
+        """The sweep finding behind policy.py's 5.0 -> 2.0: in the
+        calibrated bursty regime the 2s up-cool-down admits the
+        follow-up scale steps while the burst backlog still exists,
+        cutting p95 TTFT at ~equal pod-seconds."""
+        wl = R.synthetic_workload(seed=0, duration_s=300.0,
+                                  mean_rps=2.0, burst_factor=6.0,
+                                  n_bursts=3)
+        kw = dict(ttft_target_ms=1000.0, max_replicas=6, slots=4)
+        new = R.VirtualFleet(wl, CALIB, policy=DEFAULT_POLICY,
+                             **kw).run()
+        old = R.VirtualFleet(
+            wl, CALIB,
+            policy=DEFAULT_POLICY.override(up_cooldown_s=5.0),
+            **kw).run()
+        assert new.p95_ttft_ms < old.p95_ttft_ms
+        assert new.pod_seconds < old.pod_seconds * 1.05
+
+    def test_sweep_and_winner(self):
+        wl = _bursty(duration_s=60.0)
+        pts = [DEFAULT_POLICY,
+               DEFAULT_POLICY.override(up_cooldown_s=5.0)]
+        rows = R.sweep(wl, CALIB, pts, ttft_target_ms=1000.0,
+                       max_replicas=4)
+        assert len(rows) == 2
+        assert rows[0]["policy"] == {"baseline": True}
+        win = R.pick_winner(rows)
+        assert win in rows
+
+
+# ---------------------------------------------------------------------------
+# Recorded-trace round trip: record -> export -> schedule
+# ---------------------------------------------------------------------------
+
+
+class TestScheduleRoundTrip:
+    def _export(self):
+        """Record through the REAL trace kit (Tracer + annotate),
+        exactly the path scheduler.submit stamps."""
+        tracer = TR.Tracer(pod="p0")
+        shapes = [(0.0, 5, 3, 0), (250.0, 9, 4, 1), (1000.0, 7, 2, 0)]
+        tls = []
+        for i, (off_ms, plen, mnew, prio) in enumerate(shapes):
+            t = tracer.begin(request_id=f"r{i}")
+            t.spans[0]["t0"] = 1_000_000.0 + off_ms   # pin arrivals
+            t.annotate(promptLen=plen, maxNew=mnew, prio=prio)
+            t.finish()
+            tls.append(t.to_wire())
+        return TR.export_jsonl(tls), shapes
+
+    def test_schedule_from_export_roundtrip(self):
+        text, shapes = self._export()
+        wl = R.schedule_from_export(text)
+        assert len(wl.requests) == len(shapes)
+        assert [r.t for r in wl.requests] == \
+            pytest.approx([0.0, 0.25, 1.0])
+        assert [r.prompt_len for r in wl.requests] == [5, 9, 7]
+        assert [r.max_new for r in wl.requests] == [3, 4, 2]
+        assert [r.priority for r in wl.requests] == [0, 1, 0]
+        # the rebuilt schedule itself round-trips as a workload file
+        back = R.Workload.from_jsonl(wl.to_jsonl())
+        assert back.requests == wl.requests
+
+    def test_parse_skips_malformed_lines(self):
+        """An export truncated by a dying pod still parses — the
+        replay consumes what landed."""
+        text, _ = self._export()
+        noisy = (text + "not json at all\n"
+                 + json.dumps({"kind": "mystery"}) + "\n"
+                 + text.splitlines()[0][:40] + "\n")
+        parsed = TR.parse_jsonl_export(noisy)
+        assert len(parsed["timelines"]) == 3
+        assert parsed["hists"] == []
+
+    def test_exports_concatenate(self):
+        """Plain file append across pods/scrapes — the reason the
+        format is JSONL."""
+        a, _ = self._export()
+        b, _ = self._export()
+        parsed = TR.parse_jsonl_export(a + b)
+        assert len(parsed["timelines"]) == 6
+
+    def test_hist_record_drives_calibration(self):
+        n = len(TR.BUCKETS_MS)
+        fams = {
+            "ttft": {"buckets": list(TR.BUCKETS_MS),
+                     "counts": [0] * n, "count": 10, "sum": 1000.0},
+            "queueWait": {"buckets": list(TR.BUCKETS_MS),
+                          "counts": [0] * n, "count": 10,
+                          "sum": 200.0},
+            "itl": {"buckets": list(TR.BUCKETS_MS),
+                    "counts": [0] * n, "count": 100, "sum": 700.0},
+        }
+        text = TR.export_jsonl([], hists=fams, pod="fleet")
+        parsed = TR.parse_jsonl_export(text)
+        c = R.Calibration.from_hists(parsed["hists"][0]["families"],
+                                     mean_prompt_len=10.0)
+        # mean ttft 100 - mean queue wait 20 = 80ms of service;
+        # minus base+wire (2ms) over 10 tokens -> 7.8 ms/token
+        assert c.prefill_ms_token == pytest.approx(7.8)
+        assert c.itl_ms == pytest.approx(7.0)
+
+    def test_flightrec_schedule_and_reader_errors(self, tmp_path):
+        dump = {"pod": "p0", "reason": "test", "t": 0.0,
+                "events": [
+                    {"kind": "admit", "t": 100.0, "prio": 1},
+                    {"kind": "admit", "t": 100.5},
+                    {"kind": "evict", "t": 101.0},
+                ]}
+        wl = R.schedule_from_flightrec(dump)
+        assert [r.t for r in wl.requests] == pytest.approx([0.0, 0.5])
+        assert wl.requests[0].priority == 1
+        bad = tmp_path / "x.json"
+        bad.write_text(json.dumps({"nope": 1}))
+        with pytest.raises(ValueError):
+            TR.read_flightrec_dump(str(bad))
+        with pytest.raises(OSError):
+            TR.read_flightrec_dump(str(tmp_path / "missing.json"))
+
+
+# ---------------------------------------------------------------------------
+# Sim metrics: exposition + doc drift (both directions)
+# ---------------------------------------------------------------------------
+
+
+class TestSimMetrics:
+    def test_metrics_text_renders_every_name(self):
+        res = R.VirtualFleet(
+            R.Workload([R.SimRequest(t=0.0, prompt_len=4, max_new=2)],
+                       1.0, source="m"),
+            R.Calibration()).run().to_dict()
+        text = R.sim_metrics_text(res)
+        for name in R.SIM_METRICS:
+            assert f"# HELP {name} " in text
+            assert f"# TYPE {name} gauge" in text
+
+    def test_sim_metrics_documented_and_vice_versa(self):
+        """docs/observability.md stays the catalog of record for the
+        sim's exposition too — same both-direction guard the
+        tpujob_serve_* family carries."""
+        doc = (ROOT / "docs" / "observability.md").read_text()
+        doc_names = set(re.findall(r"tpujob_sim_[a-z0-9_]+", doc))
+        rendered = set(R.SIM_METRICS)
+        assert rendered - doc_names == set(), \
+            f"rendered but undocumented: {sorted(rendered - doc_names)}"
+        assert doc_names - rendered == set(), \
+            f"documented but never rendered: {sorted(doc_names - rendered)}"

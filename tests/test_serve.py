@@ -115,6 +115,9 @@ class TestContinuousServe:
         srv.shutdown()
         srv.generator.close()
 
+    # ~6s; staggered clients sharing one continuous-batching ring is
+    # pinned by the dryrun serve-ring gate, so this twin rides -m slow
+    @pytest.mark.slow
     def test_staggered_clients_share_the_ring(self, cserver):
         import time
 
