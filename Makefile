@@ -32,7 +32,7 @@ test: native
 # needs its own alternation — "infer.serve" is not a substring of
 # "infer.prefill_serve".)
 tier1:
-	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet|paddle_operator_tpu\.infer\.kvstore' || true); \
+	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet|paddle_operator_tpu\.infer\.kvstore|paddle_operator_tpu\.infer\.swapctl' || true); \
 	if [ -n "$$pids" ]; then \
 		echo "tier1 preflight FAILED: orphaned serve/router process(es) from a previous session:"; \
 		ps -o pid,etime,rss,args -p $$pids || true; \
@@ -90,8 +90,13 @@ sim:
 # inside the smoke agreement envelope — serve-kvstore —
 # fleet-restart durable-store hits bit-identical to cold prefill
 # through the normal promote path at tp=1+tp=2 x quant off/on, with
-# the store-off default byte-identical to the pre-store ring — and
-# ft-drain)
+# the store-off default byte-identical to the pre-store ring —
+# serve-swap — live weight swap: quiesce-flip-restore bit-identical
+# at tp=1, elastic TP resize 1->2 restoring the parked lane, LoRA
+# re-gather on the new base, and the real swapctl CLI rolling a
+# router-fronted replica under load with zero 5xx; witnesses the
+# demoted -m slow legs (TP-resize x weight-quant x spec swap matrix,
+# tests/test_serve_swap.py::TestResizeAndQuantMatrix) — and ft-drain)
 dryrun:
 	$(PY) __graft_entry__.py
 

@@ -1981,6 +1981,206 @@ def _fold_fleet_kv_summary(rows, summary, emit) -> None:
             fetch[False]["fleetkv_spill_hit_rate"]
 
 
+def measure_weight_swap(*, n_requests: int = 6, new_tokens: int = 4,
+                        n_groups: int = 4, prefix_blocks: int = 2,
+                        block_size: int = 8,
+                        suffix_len: int = 4) -> list:
+    """Live weight swap sweep (ISSUE 19): what a zero-restart deploy
+    buys over the restart it replaces.
+
+    **Deploy cells** (swap vs restart, one ring): a warm paged ring
+    deploys checkpoint B both ways and the measured number is the
+    post-deploy TTFT of the next `n_requests` requests.  The in-place
+    swap keeps the process and every compiled program for unchanged
+    shapes; the restart control rebuilds the ring in-process — a
+    *generous* restart (a real one also pays process boot + device
+    init), so the reported ratio is a floor.  The deploy wall itself
+    (`swap_deploy_s`) is also recorded: flip-at-a-boundary vs full
+    ring construction + recompile.
+
+    **Fleet cell** (the rollout shape): two replicas behind the real
+    router with peer prefix fetch on, tenant prefixes warmed on the
+    survivor, and the REAL `swapctl` CLI (a subprocess — exactly the
+    rollout tooling) swaps replica 0 under concurrent client load.
+    Reported: `swap_zero_5xx` — every routed request resolved 200
+    exactly-once through the production retry loop (readyz mark-down
+    + bounded 503 during the quiesce window); and the swapped
+    replica's warm-tenant prefix hit rate over the first post-swap
+    group requests — the swap drops its own radix cache (generation
+    purity: old-weight KV must never serve new weights) and peer
+    fetch re-warms it from the survivor instead of re-prefilling."""
+    import subprocess as _sp
+    import sys as _sys
+    import threading as _threading
+    import time as _time
+
+    import numpy as _np
+
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from paddle_operator_tpu.infer.batcher import ContinuousBatcher
+    from paddle_operator_tpu.models.llama import make_model
+
+    model, cfg = make_model("tiny", dtype=_jnp.float32)
+    pa = model.init(_jax.random.PRNGKey(0),
+                    _jnp.zeros((1, 8), _jnp.int32))["params"]
+    pb = model.init(_jax.random.PRNGKey(1),
+                    _jnp.zeros((1, 8), _jnp.int32))["params"]
+    ring_kw = dict(slots=2, max_len=48, chunk_tokens=4,
+                   prefill_buckets=(16, 48), paged=True,
+                   block_size=8, num_blocks=64, prefix_cache=True)
+    prompt = list(range(1, 13))
+    rows = []
+
+    def post_deploy_ttfts(b):
+        ttfts = []
+        for _ in range(n_requests):
+            t0 = _time.perf_counter()
+            b.submit(list(prompt), max_new_tokens=1).result(
+                timeout=600)
+            ttfts.append((_time.perf_counter() - t0) * 1e3)
+        return ttfts
+
+    def row(path, deploy_s, ttfts):
+        rows.append({
+            "swap_cell": "deploy", "swap_path": path,
+            "swap_deploy_s": round(deploy_s, 3),
+            "swap_post_ttft_p95_ms": round(
+                float(_np.percentile(ttfts, 95)), 2),
+            "swap_post_ttft_ms_mean": round(
+                float(_np.mean(ttfts)), 2),
+            "swap_requests": n_requests,
+        })
+
+    # -- deploy cell: in-place swap
+    b = ContinuousBatcher(pa, cfg, **ring_kw)
+    try:
+        b.submit(list(prompt), max_new_tokens=new_tokens).result(
+            timeout=600)                    # warm: compile amortized
+        t0 = _time.perf_counter()
+        b.swap_weights(_jax.device_get(pb))
+        deploy_s = _time.perf_counter() - t0
+        row("swap", deploy_s, post_deploy_ttfts(b))
+    finally:
+        b.close()
+
+    # -- deploy cell: restart control (in-process rebuild — generous)
+    b = ContinuousBatcher(pa, cfg, **ring_kw)
+    b.submit(list(prompt), max_new_tokens=new_tokens).result(
+        timeout=600)
+    t0 = _time.perf_counter()
+    b.close()
+    b = ContinuousBatcher(pb, cfg, **ring_kw)
+    try:
+        deploy_s = _time.perf_counter() - t0
+        row("restart", deploy_s, post_deploy_ttfts(b))
+    finally:
+        b.close()
+
+    # -- fleet cell: swapctl rolls replica 0 under load, peer fetch
+    #    re-warms the dropped radix cache from the survivor
+    from paddle_operator_tpu.router.simfleet import SimFleet
+
+    bs = block_size
+    fleet = SimFleet(2, fleet_kv=False, slots=2, num_blocks=8,
+                     block_size=bs, prefill_buckets=(16, 64),
+                     ring_extra={"host_cache_blocks": 64})
+    try:
+        fleet.enable_fleet_kv(migrate=False, peer_fetch=True)
+        fleet.replicas[0].srv.swap_base = {
+            "params": _jax.device_get(fleet._params),
+            "weight_quant": "none"}
+        A = fleet.replicas[1].batcher      # survivor holds the warmth
+        B = fleet.replicas[0].batcher      # the swap victim
+        rng = _np.random.default_rng(11)
+        groups = []
+        for g in range(n_groups):
+            prefix = [int(t) for t in rng.integers(
+                1, 250, (prefix_blocks * bs,))]
+            groups.append(prefix)
+            A.submit(prefix + [int(t) for t in rng.integers(
+                1, 250, (suffix_len,))],
+                max_new_tokens=2).result(timeout=600)
+        filler = [int(t) for t in rng.integers(1, 250, (56,))]
+        A.submit(filler, max_new_tokens=2).result(timeout=600)
+
+        results, errors = [], []
+
+        def client(i):
+            try:
+                code, _ = fleet.post(
+                    {"tokens": [groups[i % len(groups)]
+                                + [251 + i]],
+                     "max_new_tokens": 2, "request_id": f"ws{i}"})
+                results.append(code)
+            except Exception as e:          # pragma: no cover
+                errors.append(str(e))
+
+        threads = [_threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads[:4]:
+            t.start()
+        proc = _sp.run(
+            [_sys.executable, "-m",
+             "paddle_operator_tpu.infer.swapctl",
+             "--url", f"http://{fleet.replicas[0].endpoint}",
+             "--generation", "1", "--timeout-s", "300"],
+            capture_output=True, text=True, timeout=600)
+        for t in threads[4:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        lk0 = B.pool.stats["prefix_lookup_tokens"]
+        ht0 = B.pool.stats["prefix_hit_tokens"]
+        for g, prefix in enumerate(groups):
+            # the post-swap warm-tenant shape, landed on the victim
+            B.submit(prefix + [int(t) for t in rng.integers(
+                1, 250, (suffix_len,))],
+                max_new_tokens=2,
+                request_id=f"warm-{g}/row0").result(timeout=600)
+        lk = B.pool.stats["prefix_lookup_tokens"] - lk0
+        ht = B.pool.stats["prefix_hit_tokens"] - ht0
+        rows.append({
+            "swap_cell": "fleet",
+            "swap_ctl_rc": proc.returncode,
+            "swap_zero_5xx": (proc.returncode == 0 and not errors
+                              and len(results) == 8
+                              and all(c == 200 for c in results)),
+            "swap_codes": sorted(set(results)),
+            "swap_errors": errors[:3],
+            "swap_warm_hit_rate": round(ht / max(lk, 1), 4),
+            "swap_peer_fetches": B.stats["peer_prefix_fetches"],
+            "swap_generation": fleet.replica_status(0).get(
+                "weightGeneration"),
+        })
+    finally:
+        fleet.close()
+    return rows
+
+
+def _fold_weight_swap_summary(rows, summary, emit) -> None:
+    for entry in rows if isinstance(rows, list) else [rows]:
+        emit("weight_swap_sweep", entry)
+    if not isinstance(rows, list):
+        return
+    deploy = {r["swap_path"]: r for r in rows
+              if r.get("swap_cell") == "deploy"}
+    sw, rs = deploy.get("swap"), deploy.get("restart")
+    if sw and rs and sw.get("swap_post_ttft_p95_ms"):
+        # the headline: post-deploy TTFT p95, restart over swap
+        summary["swap_ttft_p95_ratio"] = round(
+            rs["swap_post_ttft_p95_ms"]
+            / sw["swap_post_ttft_p95_ms"], 2)
+        summary["swap_deploy_s"] = sw["swap_deploy_s"]
+        summary["swap_restart_deploy_s"] = rs["swap_deploy_s"]
+    flt = next((r for r in rows if r.get("swap_cell") == "fleet"),
+               None)
+    if flt:
+        summary["swap_warm_hit_rate"] = flt["swap_warm_hit_rate"]
+        summary["swap_zero_5xx"] = flt["swap_zero_5xx"]
+
+
 def measure_autoscaler(*, sim_s: float = 600.0, dt: float = 0.25,
                        prefill_ms: float = 150.0,
                        ttft_target_ms: float = 2000.0,
@@ -3683,6 +3883,15 @@ def main() -> int:
     _fold_fleet_kv_summary(guarded("fleetkv",
                                    lambda: measure_fleet_kv()),
                            summary, emit)
+
+    # live-swap sweep (ISSUE 19): post-deploy TTFT p95 of the in-place
+    # swap vs the (generous, in-process) restart control
+    # (swap_ttft_p95_ratio), the swapped replica's peer-fetch-re-warmed
+    # prefix hit rate (swap_warm_hit_rate), and the zero-5xx invariant
+    # under the real swapctl rollout (swap_zero_5xx)
+    _fold_weight_swap_summary(
+        guarded("weight_swap", lambda: measure_weight_swap()),
+        summary, emit)
 
     # prefill-pool throughput sweep (ISSUE 14): cold-arrival burst
     # tok/s lanes 1 vs 4 (prefillpool_tok_s_ratio_l4), short-prompt

@@ -280,6 +280,14 @@ def _spec_schema() -> Dict[str, Any]:
                                 "pattern": "^dir:/.+"},
                     "kvStoreTtlS": {"type": "number", "minimum": 0},
                     "kvStoreBudgetMb": _int(0),
+                    # live weight swap / elastic TP resize (ISSUE 19):
+                    # the weight generation the fleet should serve
+                    # (SERVE_GENERATION — bumping it drives the
+                    # one-replica-at-a-time rolling swap) and the
+                    # per-replica tensor-parallel degree (SERVE_TP;
+                    # 0/unset keeps the server default of 1)
+                    "generation": _int(0),
+                    "tp": _int(0),
                     # cross-host disaggregation (ISSUE 13): prefill
                     # executors in their OWN pods (standalone prefill
                     # servers decode replicas hand cold prompts to
@@ -426,7 +434,10 @@ def _status_schema() -> Dict[str, Any]:
             # (replicasDesired/replicasReady/routerReady/
             # drainedReplicas/replicaRestarts) — and the fleet-level
             # KV keys (ISSUE 12): laneMigrations, adoptedLanes,
-            # peerPrefixFetches, hostCacheEvictions — schemaless on
+            # peerPrefixFetches, hostCacheEvictions — and the live-
+            # swap keys (ISSUE 19): weightGeneration, servingTp,
+            # weightSwaps, plus the fleet block's generationMin/Max +
+            # mixedGenerations mid-roll spread — schemaless on
             # purpose (preserve-unknown-fields) so the workload can
             # grow telemetry without a CRD rev.
             "serving": {

@@ -546,6 +546,21 @@ class ServingSpec:
       reconciler scales each pool off the scraped gauges with
       hysteresis and a cool-down, every downscale through the PR 9
       drain-aware victim path.
+
+    Live weight swap / elastic TP resize (ISSUE 19, docs/serving.md
+    "Live model lifecycle"):
+
+    - ``generation``       the weight generation the fleet should
+      serve -> SERVE_GENERATION.  Bumping it (usually together with a
+      new checkpoint in the template env) drives the reconciler's
+      ROLLING swap: one replica at a time is drained by migration
+      (lanes move to peers through the broker), replaced at the new
+      generation, re-warmed via peer prefix fetch, and re-admitted on
+      /readyz — the fleet never loses its cache or its traffic;
+    - ``tp``               tensor-parallel degree per replica ->
+      SERVE_TP (0/unset keeps the server default of 1).  Changing it
+      rolls the same way; fleet KV keeps flowing across the resize
+      because the migration fingerprint deliberately omits tp.
     """
 
     replicas: int = 1
@@ -569,6 +584,8 @@ class ServingSpec:
     kv_store_ttl_s: float = 0.0
     kv_store_budget_mb: int = 0
     migrate_parked_s: float = 0.0
+    generation: int = 0
+    tp: int = 0
     prefill_pool: Optional[PrefillPoolSpec] = None
     autoscale: Optional[AutoscaleSpec] = None
 
@@ -614,6 +631,10 @@ class ServingSpec:
             d["kvStoreBudgetMb"] = self.kv_store_budget_mb
         if self.migrate_parked_s:
             d["migrateParkedS"] = self.migrate_parked_s
+        if self.generation:
+            d["generation"] = self.generation
+        if self.tp:
+            d["tp"] = self.tp
         if self.prefill_pool is not None:
             d["prefillPool"] = self.prefill_pool.to_dict()
         if self.autoscale is not None:
@@ -651,6 +672,8 @@ class ServingSpec:
             kv_store_ttl_s=float(d.get("kvStoreTtlS", 0.0)),
             kv_store_budget_mb=int(d.get("kvStoreBudgetMb", 0)),
             migrate_parked_s=float(d.get("migrateParkedS", 0.0)),
+            generation=int(d.get("generation", 0)),
+            tp=int(d.get("tp", 0)),
             prefill_pool=PrefillPoolSpec.from_dict(
                 d.get("prefillPool")),
             autoscale=AutoscaleSpec.from_dict(d.get("autoscale")),

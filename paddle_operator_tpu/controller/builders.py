@@ -690,6 +690,16 @@ def construct_serve_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
     if sv.migrate_parked_s:
         _env_setdefault(env, "SERVE_MIGRATE_PARKED_S",
                         str(sv.migrate_parked_s))
+    # live weight swap / elastic TP resize (ISSUE 19): the generation
+    # this replica boots serving and its TP degree.  SERVE_GENERATION
+    # is injected UNCONDITIONALLY (not setdefault) — it is the
+    # reconciler's roll-convergence signal, and a stale template value
+    # shadowing it would wedge the roll re-rolling the same pod
+    # forever.
+    env.append({"name": "SERVE_GENERATION", "value":
+                str(sv.generation)})
+    if sv.tp:
+        _env_setdefault(env, "SERVE_TP", str(sv.tp))
     # cross-host disaggregation (ISSUE 13): with a prefill pool, every
     # decode replica hands cold prompts to it — disagg prefill mode,
     # remote flavor, jobs brokered through the fleet service (the
@@ -782,6 +792,14 @@ def construct_prefill_pod(job: TPUJob, idx: int) -> Dict[str, Any]:
     env.append({"name": "TPUJOB_RES_TYPE", "value": RESOURCE_PREFILL})
     env.append({"name": "TPUJOB_NAME", "value": job.name})
     env.append({"name": "TPUJOB_PORT", "value": str(pp.port)})
+    # live weight swap (ISSUE 19): the handoff fingerprint includes
+    # the weight generation, so a prefill pod left at checkpoint r
+    # would 409 every handoff once the decode fleet rolls to r+1.
+    # Injected unconditionally (last-one-wins over any inherited
+    # template value) — the same roll-convergence contract as the
+    # serve pod.
+    env.append({"name": "SERVE_GENERATION", "value":
+                str(sv.generation)})
     _env_setdefault(env, "SERVE_BLOCK_SIZE", str(sv.block_size))
     # prefill-pool throughput (ISSUE 14): the N-lane batched engine
     # (1 keeps the monolithic oracle) and its own radix prefix cache

@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import datetime
 from dataclasses import dataclass, field as dc_field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from paddle_operator_tpu.api.types import (
     DRAIN_ANNOTATION,
@@ -813,6 +813,34 @@ class TPUJobReconciler:
             return self._drain_serve_victim(job, raw, pod,
                                             counter="prefillDrained")
 
+        # -- rolling weight swap / TP resize (ISSUE 19): a bumped
+        #    spec.serving.generation rolls the fleet ONE replica at a
+        #    time through the SAME drain-first victim path a
+        #    scale-down uses — migrate-out (PR 12) moves the victim's
+        #    resident lanes to peers and its prefixes stay reachable
+        #    through the fleet KV store, the replacement boots at the
+        #    new generation (builders inject SERVE_GENERATION
+        #    unconditionally) and re-warms its radix cache by peer
+        #    prefix fetch before the router admits it back.  A TP
+        #    resize rides the same signal: set spec.serving.tp AND
+        #    bump the generation.  The prefill pool rolls only after
+        #    the decode pool converges (the 409 fingerprint walk-on
+        #    keeps handoffs flowing through the mixed window).  Runs
+        #    BEFORE the replace pass so a drained victim's exit 83 is
+        #    accounted as a SWAP (swappedReplicas), not a bare
+        #    preemption.
+        converged, res = self._roll_stale_generation(
+            job, raw, sv, serve_pods, eff_serve,
+            counter="swappedReplicas")
+        if res is not None:
+            return res
+        if converged and sv.prefill_pool is not None:
+            _, res = self._roll_stale_generation(
+                job, raw, sv, prefill_pods, eff_prefill,
+                counter="prefillSwapped")
+            if res is not None:
+                return res
+
         # -- replace failed in-range replicas (one per pass): a
         #    preempted exit (83 — node preemption, or a drain we did
         #    not ask for) is absorbed without burning anything;
@@ -883,8 +911,15 @@ class TPUJobReconciler:
 
     def _drain_serve_victim(self, job: TPUJob, raw: Dict[str, Any],
                             pod: Dict[str, Any],
-                            counter: str = "drainedReplicas") -> Result:
+                            counter: str = "drainedReplicas",
+                            reason: str = "scale-down") -> Result:
         """One step of the scale-down drain for a single victim pod.
+        ``reason`` rides the drain annotation and the events — the
+        rolling weight swap (ISSUE 19) drains through this exact path
+        with reason ``swap-gen-N``, so the pod-side protocol
+        (migrate-out, exit 83) and the preempted accounting are
+        IDENTICAL to a scale-down; only the replacement differs (the
+        scale-up pass recreates the index at the new generation).
 
         The pod-side protocol is MIGRATION-FIRST when
         ``spec.serving.kvMigration`` is on (ISSUE 12): the victim's
@@ -913,7 +948,7 @@ class TPUJobReconciler:
                     self._bump_fleet_counter(j, counter)
                 self.api.record_event(
                     raw, "Normal", "ReplicaDrained",
-                    f"scale-down: {meta['name']} drained cleanly "
+                    f"{reason}: {meta['name']} drained cleanly "
                     f"(exit 83, counted preempted — not failed)")
                 # account BEFORE deleting, exactly once per pod uid
                 if not self._account_replica_exit(job, pod, bump):
@@ -921,7 +956,7 @@ class TPUJobReconciler:
             else:
                 self.api.record_event(
                     raw, "Warning", "ReplicaFailed",
-                    f"scale-down victim {meta['name']} exited "
+                    f"{reason} victim {meta['name']} exited "
                     f"uncleanly")
             self._delete_serve_pod(job, pod)
             return Result(requeue_after=1.0)
@@ -930,14 +965,14 @@ class TPUJobReconciler:
             # the preemption-notice file; the replica may finish its
             # drain before we ever deliver SIGTERM)
             meta.setdefault("annotations", {})[DRAIN_ANNOTATION] = \
-                "scale-down"
+                reason
             try:
                 self.api.update(KIND_POD, pod)
             except (Conflict, NotFound):
                 pass
             self.api.record_event(
                 raw, "Normal", "DrainRequested",
-                f"scale-down: asked {meta['name']} to drain "
+                f"{reason}: asked {meta['name']} to drain "
                 f"(stop admissions, finish residents, exit 83)")
             return Result(requeue_after=1.0)
         # pass 2+: deliver the SIGTERM by deleting the pod — kubelet's
@@ -949,13 +984,79 @@ class TPUJobReconciler:
             self._bump_fleet_counter(j, counter)
         self.api.record_event(
             raw, "Normal", "ReplicaDrained",
-            f"scale-down: deleting {meta['name']} (SIGTERM drain; "
+            f"{reason}: deleting {meta['name']} (SIGTERM drain; "
             f"counted preempted — not failed)")
         # account BEFORE deleting, exactly once per pod uid
         if not self._account_replica_exit(job, pod, bump):
             return Result(requeue_after=1.0)
         self._delete_serve_pod(job, pod)
         return Result(requeue_after=1.0)
+
+    @staticmethod
+    def _pod_serve_generation(pod: Dict[str, Any]) -> int:
+        """The SERVE_GENERATION this pod was built with.  Builders
+        inject it unconditionally (appended AFTER any template env),
+        so the LAST occurrence wins — matching kubelet's resolution
+        of duplicated env names."""
+        val = "0"
+        for c in (pod.get("spec") or {}).get("containers") or []:
+            for e in c.get("env") or []:
+                if e.get("name") == "SERVE_GENERATION":
+                    val = e.get("value") or "0"
+        try:
+            return int(val)
+        except (TypeError, ValueError):
+            return 0
+
+    def _roll_stale_generation(self, job: TPUJob, raw: Dict[str, Any],
+                               sv, pods: Dict[int, Dict[str, Any]],
+                               eff: int, counter: str
+                               ) -> Tuple[bool, Optional[Result]]:
+        """One step of the rolling weight swap (ISSUE 19) for one
+        pool: pick the lowest-index in-range pod whose injected
+        SERVE_GENERATION differs from ``spec.serving.generation`` and
+        push it through the drain-first victim path.  Gate: the pool
+        must be FULLY Running first — the previous victim's
+        replacement has to be back (and the router's readyz scrape
+        admitting it) before the next replica goes out, so the roll
+        never takes two replicas of capacity at once.
+
+        Returns ``(converged, result)``: ``(True, None)`` when no pod
+        is stale; ``(False, None)`` when stale pods exist but a
+        replacement is still coming up (the caller falls through to
+        the scale-up pass that creates it); ``(False, Result)`` while
+        actively draining a victim.  The full-running gate applies
+        only when STARTING a new victim — one already in flight
+        (annotated, terminating, or exited) is carried through the
+        drain path unconditionally so its exit-83 lands in the swap
+        accounting, not the generic replace pass."""
+        want = int(sv.generation or 0)
+        stale = [i for i in sorted(pods)
+                 if i < eff
+                 and self._pod_serve_generation(pods[i]) != want]
+        if not stale:
+            return True, None
+        pod = pods[stale[0]]
+        meta = pod["metadata"]
+        in_flight = (
+            DRAIN_ANNOTATION in (meta.get("annotations") or {})
+            or meta.get("deletionTimestamp")
+            or pod.get("status", {}).get("phase") in ("Failed",
+                                                      "Succeeded"))
+        if not in_flight:
+            for i in range(eff):
+                p = pods.get(i)
+                if (p is None
+                        or p["metadata"].get("deletionTimestamp")
+                        or not builders.is_pod_real_running(p)):
+                    return False, None
+            self.api.record_event(
+                raw, "Normal", "WeightSwapRoll",
+                f"rolling {meta['name']} to weight "
+                f"generation {want} (one replica at a time)")
+        return False, self._drain_serve_victim(
+            job, raw, pod, counter=counter,
+            reason=f"swap-gen-{want}")
 
     def _delete_serve_pod(self, job: TPUJob,
                           pod: Dict[str, Any]) -> None:
@@ -1113,6 +1214,23 @@ class TPUJobReconciler:
             builders.is_pod_real_running(p) for p in router_pods)
         fleet.setdefault("drainedReplicas", 0)
         fleet.setdefault("replicaRestarts", 0)
+        # rolling weight swap (ISSUE 19): the convergence signal —
+        # how far the fleet has rolled toward spec.serving.generation
+        want_gen = int(sv.generation or 0)
+        fleet["generationDesired"] = want_gen
+        fleet["replicasAtGeneration"] = sum(
+            1 for i, p in serve_pods.items()
+            if i < want_serve
+            and self._pod_serve_generation(p) == want_gen)
+        fleet.setdefault("swappedReplicas", 0)
+        # the telemetry-observed generation spread (aggregated above
+        # from the replicas' published blocks) mirrors into the fleet
+        # block — that is where the manager's gauge export reads the
+        # tpujob_serve_fleet_generation_* family from
+        for k in ("generationMin", "generationMax",
+                  "mixedGenerations"):
+            if k in serving:
+                fleet[k] = serving[k]
         if sv.prefill_pool is not None:
             want_prefill = (sv.prefill_pool.replicas
                             if eff_prefill is None else eff_prefill)
@@ -1123,6 +1241,7 @@ class TPUJobReconciler:
                 and builders.is_pod_real_running(p))
             fleet.setdefault("prefillDrained", 0)
             fleet.setdefault("prefillRestarts", 0)
+            fleet.setdefault("prefillSwapped", 0)
         if serving != before:
             self._persist_status(job)
             return True

@@ -1926,6 +1926,44 @@ class RingExecutor:
         # at admission and the step reads it as a tiny operand.
         self.aid = np.zeros((self.slots,), np.int32)
 
+    def swap_weights(self, params: Any, draft_params: Any = None) -> tuple:
+        """Replace the served param trees in place — the device half of
+        the live weight swap (ISSUE 19), for a flip that keeps this
+        executor (same cfg / mesh / ring geometry).  The compiled
+        programs take params as a traced OPERAND, so a new checkpoint —
+        even one whose weight-quant mode differs: the leaf types are
+        the dispatch (infer/quant.py) — re-traces lazily on its first
+        dispatch instead of needing any rebuild here.  Returns the old
+        ``(params, draft_params)`` so the caller can roll back an
+        aborted swap; dropping the returned references frees the HBM.
+
+        The caller (ContinuousBatcher swap path) has QUIESCED the
+        ring — nothing in flight, every lane parked — and runs
+        reset_state() right after the flip, so cached KV computed
+        under the old generation can never serve the new one."""
+        if self.spec_k and draft_params is None:
+            raise ValueError(
+                "speculative ring: a weight swap must ship the draft "
+                "with the target (drafts are verified against the NEW "
+                "params only; a stale draft would silently collapse "
+                "acceptance)")
+        if self.mesh is not None and D.mesh_tp(self.mesh) > 1:
+            params = D.shard_params_for_serving(params, self.cfg,
+                                                self.mesh)
+            if draft_params is not None:
+                draft_params = D.shard_params_for_serving(
+                    draft_params, self.draft_cfg, self.mesh)
+        old, old_draft = self.params, self.draft_params
+        self.params = params
+        if self.spec_k:
+            self.draft_params = draft_params
+        if self.prefill_exec is not None and not self.prefill_remote:
+            # the in-process prefill engine dispatches the same tree
+            # (already sharded above); the scheduler quiesced its
+            # queues before the flip, so no job reads a torn reference
+            self.prefill_exec.params = self.params
+        return old, old_draft
+
     # -- adapter (LoRA) dispatch tails (ISSUE 10) --------------------------
 
     def lora_step_tail(self) -> tuple:

@@ -61,7 +61,8 @@ PREFILL_TIMEOUT_S = 120.0
 def handoff_fingerprint(cfg, *, block_size: int, kv_quant: str,
                         top_k: Optional[int],
                         top_p: Optional[float],
-                        wquant: str = "none") -> Dict[str, Any]:
+                        wquant: str = "none",
+                        generation: int = 0) -> Dict[str, Any]:
     """The geometry + sampling rule a handoff envelope must match.
     Narrower than the lane-migration fingerprint on purpose: spec
     depth is absent (the DRAFT lane prefills decode-side at attach —
@@ -72,13 +73,19 @@ def handoff_fingerprint(cfg, *, block_size: int, kv_quant: str,
     ``wquant`` (ISSUE 16) is the WEIGHT quant mode: handed-off KV is a
     function of the weights that produced it, so a bf16 prefill pod
     feeding an int8 decode ring would silently break token-identity
-    with the in-process cold path — refuse the mixed fleet instead."""
+    with the in-process cold path — refuse the mixed fleet instead.
+    ``generation`` (ISSUE 19) is the WEIGHT generation for the same
+    reason: during a fleet rolling swap a prefill pod still on
+    checkpoint r must not feed KV into a decode ring already on r+1 —
+    the mismatch 409s and the decode side falls back/retries until
+    the pool rolls."""
     return {"layers": int(cfg.n_layers),
             "kvHeads": int(cfg.n_kv_heads),
             "headDim": int(cfg.head_dim),
             "blockSize": int(block_size),
             "quant": kv_quant,
             "wquant": wquant,
+            "gen": int(generation),
             "topK": top_k, "topP": top_p}
 
 
@@ -133,7 +140,8 @@ class PrefillFrontend:
                  top_p: Optional[float] = None, mesh=None,
                  kv_quant: str = "none", lanes: int = 1,
                  prefill_chunk: int = 64,
-                 prefix_blocks: int = 0) -> None:
+                 prefix_blocks: int = 0,
+                 generation: int = 0) -> None:
         from paddle_operator_tpu.infer import decode as D
         from paddle_operator_tpu.infer import executor as X
 
@@ -147,6 +155,9 @@ class PrefillFrontend:
         # detected, not configured: the leaf types of the tree actually
         # dispatched decide the fingerprint (matches the decode side)
         self.wquant = Q.weight_quant_mode(params)
+        # weight generation (ISSUE 19): rides the handoff fingerprint
+        # so a rolling fleet swap 409s cross-generation handoffs
+        self.generation = int(generation)
         self.quant = kv_quant == "int8"
         self.top_k, self.top_p = top_k, top_p
         self.lanes = max(1, int(lanes))
@@ -185,7 +196,7 @@ class PrefillFrontend:
         return handoff_fingerprint(
             self.cfg, block_size=self.block_size,
             kv_quant=self.kv_quant, top_k=self.top_k, top_p=self.top_p,
-            wquant=self.wquant)
+            wquant=self.wquant, generation=self.generation)
 
     def depth(self) -> int:
         with self._lock:
@@ -637,7 +648,8 @@ def make_prefill_server(host: str, port: int, params: Any, cfg, *,
                         kv_quant: str = "none", job: str = "local",
                         replica: str = "", lanes: int = 1,
                         prefill_chunk: int = 64,
-                        prefix_blocks: int = 0) -> ThreadingHTTPServer:
+                        prefix_blocks: int = 0,
+                        generation: int = 0) -> ThreadingHTTPServer:
     """HTTP shell around a PrefillFrontend.  The returned server
     carries ``.frontend`` — close it when tearing down."""
     fe = PrefillFrontend(params, cfg, block_size=block_size,
@@ -645,7 +657,8 @@ def make_prefill_server(host: str, port: int, params: Any, cfg, *,
                          buckets=buckets, top_k=top_k, top_p=top_p,
                          mesh=mesh, kv_quant=kv_quant, lanes=lanes,
                          prefill_chunk=prefill_chunk,
-                         prefix_blocks=prefix_blocks)
+                         prefix_blocks=prefix_blocks,
+                         generation=generation)
     handler = type("PrefillHandler", (_PrefillHandler,),
                    {"frontend": fe, "job_key": job,
                     "replica_id": replica})
@@ -766,6 +779,13 @@ class RemotePrefillClient:
                     continue
                 if code == 503:
                     continue        # draining / no ready pod yet
+                if code == 409:
+                    # fingerprint mismatch — during a fleet rolling
+                    # swap (ISSUE 19) pods still on the old weight
+                    # generation refuse; walk on, an already-rolled
+                    # peer may match.  All-mismatch exhausts the
+                    # attempts into the retriable-error path below.
+                    continue
                 if code != 200:
                     try:
                         msg = json.loads(raw).get("error", raw[:120])
@@ -840,9 +860,12 @@ class RemotePrefillClient:
                          headers={"Content-Type": "application/json",
                                   "Connection": "close"})
             resp = conn.getresponse()
-            if resp.status == 503:
+            if resp.status in (503, 409):
+                # 503: draining / backlogged pod.  409: weight-
+                # generation fingerprint mismatch mid rolling swap
+                # (ISSUE 19) — an already-rolled peer may match.
                 resp.read()
-                return "next"       # draining / backlogged pod
+                return "next"
             if resp.status != 200:
                 raw = resp.read()
                 try:
@@ -995,7 +1018,8 @@ def main() -> int:
         prefill_chunk=int(os.environ.get("SERVE_PREFILL_CHUNK",
                                          "64") or 64),
         prefix_blocks=int(os.environ.get(
-            "SERVE_PREFILL_PREFIX_BLOCKS", "256") or 0))
+            "SERVE_PREFILL_PREFIX_BLOCKS", "256") or 0),
+        generation=int(os.environ.get("SERVE_GENERATION", "0") or 0))
     print(f"prefill pool {os.environ.get('MODEL_PRESET', '7b')} "
           f"(resumed={resumed}, tp={tp}, kv_quant={kv_quant}, "
           f"weight_quant={wq}, "

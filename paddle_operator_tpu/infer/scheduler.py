@@ -229,6 +229,35 @@ class _ParkedLane:
         self.t_parked = time.monotonic()
 
 
+# sentinel: swap_weights(mesh=...) distinguishes "keep the current
+# mesh" (the common checkpoint bump) from "resize to mesh=None" (an
+# explicit tp=1 downsize) — None is a legal target, so a default of
+# None cannot carry "unchanged"
+_KEEP_MESH = object()
+
+
+class _SwapRequest:
+    """One posted live weight swap (ISSUE 19), handed from the caller's
+    thread to the ring loop: the NEW param trees (already loaded,
+    quantized, host- or device-resident — the expensive I/O happened
+    off the ring thread), the target mesh for a TP resize, and the
+    completion event the caller blocks on.  ``error`` is set instead
+    of ``result`` when the swap aborted — the ring then still serves
+    the OLD generation (all-or-nothing)."""
+
+    __slots__ = ("params", "draft_params", "mesh", "generation",
+                 "done", "error", "result")
+
+    def __init__(self, params, draft_params, mesh, generation):
+        self.params = params
+        self.draft_params = draft_params
+        self.mesh = mesh                # _KEEP_MESH = no resize
+        self.generation = generation    # None = bump by one
+        self.done = threading.Event()
+        self.error: Optional[Exception] = None
+        self.result: Optional[Dict[str, Any]] = None
+
+
 class ContinuousBatcher:
     """Slot scheduler over the resident chunk step.
 
@@ -286,7 +315,8 @@ class ContinuousBatcher:
                  prefill_lanes: int = 1,
                  prefill_stream: bool = False,
                  prefill_prefix_blocks: int = 0,
-                 trace: Optional[bool] = None) -> None:
+                 trace: Optional[bool] = None,
+                 generation: int = 0) -> None:
         if prefill_mode not in PREFILL_MODES:
             raise ValueError(f"prefill_mode {prefill_mode!r} not in "
                              f"{PREFILL_MODES}")
@@ -372,12 +402,15 @@ class ContinuousBatcher:
         self.hist = TR.ServeHistograms()
         self.flightrec = TR.FlightRecorder(pod=pod)
 
-        # the device half: compiled programs + cache/pool/lane state
-        self.executor = X.RingExecutor(
-            params, cfg, slots=slots, max_len=self.max_len,
+        # the device half: compiled programs + cache/pool/lane state.
+        # The kwargs are kept (ISSUE 19): a live TP resize rebuilds the
+        # executor around a NEW mesh with the geometry otherwise
+        # byte-identical — one construction site, one swap site, no
+        # drift between them.
+        self._exec_kw = dict(
+            slots=slots, max_len=self.max_len,
             chunk_tokens=chunk_tokens, prefill_buckets=prefill_buckets,
-            top_k=top_k, top_p=top_p, mesh=mesh,
-            draft_params=draft_params, draft_cfg=draft_cfg,
+            top_k=top_k, top_p=top_p, draft_cfg=draft_cfg,
             spec_k=spec_k, paged=paged, block_size=block_size,
             num_blocks=num_blocks, prefix_cache=prefix_cache,
             prefill_mode=prefill_mode, prefill_chunk=prefill_chunk,
@@ -386,7 +419,17 @@ class ContinuousBatcher:
             megastep=self.megastep, prefill_client=prefill_client,
             prefill_lanes=prefill_lanes, prefill_stream=prefill_stream,
             prefill_prefix_blocks=prefill_prefix_blocks)
+        self.executor = X.RingExecutor(
+            params, cfg, mesh=mesh, draft_params=draft_params,
+            **self._exec_kw)
         self.mesh = mesh
+        # live weight swap (ISSUE 19): the generation of the params
+        # currently dispatched (SERVE_GENERATION seeds it; each swap
+        # bumps or sets it), and the single-slot pending-swap request
+        # the ring loop consumes at a quiesced boundary
+        self.generation = int(generation)
+        self._swap_req: Optional[_SwapRequest] = None
+        self._swap_lock = threading.Lock()
         self.paged = self.executor.paged
         self.kv_quant = self.executor.kv_quant
         self.spec_k = self.executor.spec_k
@@ -520,7 +563,12 @@ class ContinuousBatcher:
                       # rebuilds, and NaN-quarantined lanes — surfaced
                       # through serving_status -> tpujob_serve_* gauges
                       "deadline_exceeded": 0, "watchdog_restarts": 0,
-                      "quarantined_lanes": 0}
+                      "quarantined_lanes": 0,
+                      # live weight swap (ISSUE 19): completed in-place
+                      # flips (checkpoint bumps and TP resizes; aborted
+                      # swaps do not count — the ring kept serving the
+                      # old generation)
+                      "weight_swaps": 0}
         # served-token telemetry for serving_status(): cumulative emitted
         # tokens since construction (the /metrics tokens-per-sec gauge)
         self._tokens_emitted = 0
@@ -1016,15 +1064,196 @@ class ContinuousBatcher:
             "deadlineExceeded": self.stats["deadline_exceeded"],
             "watchdogRestarts": self.stats["watchdog_restarts"],
             "quarantinedLanes": self.stats["quarantined_lanes"],
+            # live weight swap / elastic TP resize (ISSUE 19): the
+            # generation this replica serves and its current TP degree
+            # — the tpujob_serve_generation gauge, the reconciler's
+            # roll trigger, and the router's /statusz mid-roll view
+            "weightGeneration": int(self.generation),
+            "servingTp": self.serving_tp(),
+            "weightSwaps": self.stats["weight_swaps"],
         }
 
     @property
     def accepting(self) -> bool:
         """Readiness (/readyz): the ring takes new admissions — not
-        draining, not mid-rebuild, loop alive, budget unspent."""
+        draining, not mid-rebuild, not mid-swap, loop alive, budget
+        unspent.  Mid-swap is a READINESS event, not an availability
+        one: the router marks the replica down and routes new traffic
+        elsewhere while requests already here queue through the flip
+        (bounded TTFT inflation, zero 5xx)."""
         return (self.healthy and not self._draining
-                and not self._rebuilding and not self._stop.is_set()
+                and not self._rebuilding and self._swap_req is None
+                and not self._stop.is_set()
                 and self._thread.is_alive())
+
+    # -- live weight swap / elastic TP resize (ISSUE 19) -------------------
+
+    @property
+    def swapping(self) -> bool:
+        """True while a posted swap awaits (or is executing) its
+        quiesced boundary — the /readyz mark-down window."""
+        return self._swap_req is not None
+
+    def serving_tp(self) -> int:
+        """Tensor-parallel degree of the CURRENT executor's mesh — the
+        ``servingTp`` status key; tracks a live TP resize."""
+        mesh = self.executor.mesh
+        return int(X.D.mesh_tp(mesh)) if mesh is not None else 1
+
+    def swap_weights(self, params: Any, *, draft_params: Any = None,
+                     mesh: Any = _KEEP_MESH,
+                     generation: Optional[int] = None,
+                     timeout: Optional[float] = 120.0
+                     ) -> Dict[str, Any]:
+        """Live weight swap / elastic TP resize (ISSUE 19): flip the
+        served param trees — and, with ``mesh=``, the TP mesh — without
+        restarting the process or dropping a single request.
+
+        Call from any thread (serve.py's ``/v1/swap`` handler).  The
+        expensive work (checkpoint load, quantize) happened on the
+        CALLER's thread before this call; here the request posts to
+        the ring loop, which at the next megastep/chunk boundary:
+        quiesces the dispatch pipeline, parks every resident lane via
+        the PR 10 spill (full unsharded host bytes), flips params —
+        rebuilding the executor when the mesh changes — drops the old
+        generation's radix/host cache (its KV must never serve the new
+        weights), and restores the parked lanes through the promote
+        scatter, which re-shards, so a tp=1 lane legally resumes on a
+        tp=2 ring.  LoRA adapters re-gather automatically: the
+        registry's delta stacks ride every dispatch as operands
+        against whatever base is current.  All-or-nothing: any flip
+        failure (and a watchdog rebuild racing the swap) restores the
+        old params and generation, and this raises.
+
+        ``generation=None`` bumps the generation by one; an explicit
+        value sets it (the fleet roll passes spec.serving.generation).
+        Returns the post-swap status summary."""
+        if self.pool is None:
+            raise ValueError(
+                "live weight swap requires the paged ring "
+                "(SERVE_PAGED=1): resident lanes park through the "
+                "block-granular spill")
+        if not self.accepting and self._swap_req is None:
+            raise ShuttingDown(
+                "ring is draining/rebuilding/stopped; not swapping")
+        sw = _SwapRequest(params, draft_params, mesh, generation)
+        with self._swap_lock:
+            if self._swap_req is not None:
+                raise ValueError("a weight swap is already in flight")
+            self._swap_req = sw
+        self._wake.set()
+        if not sw.done.wait(timeout):
+            # the ring never reached a boundary (wedged dispatch): the
+            # watchdog/heal path will fail the request; un-post so the
+            # replica does not stay unready forever
+            with self._swap_lock:
+                if self._swap_req is sw:
+                    self._swap_req = None
+            raise RetriableError(
+                f"weight swap timed out after {timeout}s awaiting a "
+                "quiesced boundary; the ring still serves generation "
+                f"{self.generation} — retry")
+        if sw.error is not None:
+            raise sw.error
+        return sw.result or {}
+
+    def _park_residents_for_swap(self) -> int:
+        """Park every resident decode lane at THE boundary (the caller
+        consumed all in-flight dispatches, so device state and host
+        mirrors agree).  Same spill the QoS preemption and the
+        drain-by-migration path use — the restore after the flip is
+        the existing promote-scatter re-admission."""
+        parked = 0
+        for i, r in enumerate(self.lane):
+            if r is None or r.done.is_set() or r._cancel:
+                continue
+            self._preempt(i)
+            parked += 1
+        return parked
+
+    def _do_swap(self) -> None:
+        """Execute the posted swap at the quiesced boundary (ring loop
+        only; ``pending`` already drained by the caller).  The flip is
+        all-or-nothing: the OLD executor/params stay authoritative
+        until the new state is fully built, and any failure rolls back
+        to them — parked lanes then restore onto the old ring and the
+        generation never moves."""
+        with self._swap_lock:
+            sw, self._swap_req = self._swap_req, None
+        if sw is None:
+            return
+        t0 = time.monotonic()
+        ex = self.executor
+        resize = sw.mesh is not _KEEP_MESH and sw.mesh is not ex.mesh
+        self.flightrec.record(
+            "swap_begin", generation=sw.generation,
+            resize=bool(resize),
+            residents=sum(r is not None for r in self.lane))
+        try:
+            parked = self._park_residents_for_swap()
+            if resize:
+                # TP resize: build the NEW executor first (fresh
+                # programs compiled against the new mesh, fresh
+                # pool/cache) while the old one stays intact — a
+                # construction failure leaves the ring exactly as it
+                # was.  Peak HBM transiently holds both param sets and
+                # both pools (docs/serving.md sizes the headroom).
+                new_ex = X.RingExecutor(
+                    sw.params, self.cfg, mesh=sw.mesh,
+                    draft_params=sw.draft_params, **self._exec_kw)
+                old_ex, self.executor = self.executor, new_ex
+                self.mesh = sw.mesh
+                if (old_ex.prefill_exec is not None
+                        and not old_ex.prefill_remote):
+                    old_ex.prefill_exec.close()
+            else:
+                old_params, old_draft = ex.swap_weights(
+                    sw.params, sw.draft_params)
+                try:
+                    # fresh pool + radix: KV computed under the old
+                    # generation must never serve the new one
+                    ex.reset_state()
+                except Exception:
+                    ex.swap_weights(old_params, old_draft)
+                    ex.reset_state()
+                    raise
+                del old_params, old_draft    # last refs free the HBM
+        except Exception as e:
+            self.flightrec.record("swap_failed", error=str(e)[:200])
+            sw.error = e
+            sw.done.set()
+            return
+        self.generation = (int(sw.generation)
+                           if sw.generation is not None
+                           else self.generation + 1)
+        # the rebuilt pool is fresh: re-attach the durable store and
+        # re-stamp its fingerprint (generation rides the fingerprint,
+        # so old-generation store entries refuse wholesale instead of
+        # warming the new weights with stale KV)
+        if (self.kv_store is not None and self.pool is not None
+                and self.pool.host is not None):
+            self.pool.attach_store(self.kv_store)
+            if getattr(self.kv_store, "fingerprint", None) is not None:
+                self.kv_store.fingerprint = self._fingerprint()
+        # cross-host disaggregation: the prefill pods must serve the
+        # same generation/quant mode — re-stamp the client fingerprint
+        # so a mismatched pool 409s instead of handing off stale KV
+        if self.executor.prefill_remote:
+            self.executor.prefill_exec.fingerprint = \
+                self.handoff_fingerprint()
+        self._peer_fetch_seen.clear()   # re-ask the fleet post-swap
+        self.stats["weight_swaps"] += 1
+        self.flightrec.record(
+            "swap_done", generation=self.generation,
+            tp=self.serving_tp(), parked=parked,
+            ms=round((time.monotonic() - t0) * 1e3, 1))
+        sw.result = {"generation": self.generation,
+                     "servingTp": self.serving_tp(),
+                     "parkedLanes": parked,
+                     "weightQuantMode": self.weight_quant_mode(),
+                     "swapMs": round((time.monotonic() - t0) * 1e3, 1)}
+        sw.done.set()
+        self._wake.set()    # restores run on the next pass
 
     def drain(self, budget_s: float = 30.0) -> None:
         """SIGTERM drain (the serving half of docs/fault-tolerance.md):
@@ -1176,6 +1405,17 @@ class ContinuousBatcher:
         self._prefilling.clear()
         self._disagg_waiting.clear()
         self._handoff_frame_t.clear()
+        # a watchdog rebuild ABORTS any pending live swap (ISSUE 19):
+        # the rebuild restores the OLD generation's params (reset_state
+        # keeps self.executor.params), so the swap caller must retry —
+        # all-or-nothing, never a half-flipped ring
+        with self._swap_lock:
+            sw, self._swap_req = self._swap_req, None
+        if sw is not None:
+            sw.error = RetriableError(
+                "ring rebuilt mid-swap; the old generation was "
+                "restored — retry the swap")
+            sw.done.set()
         if not healing:
             return False
         backoff = self._budget.spend()
@@ -2016,7 +2256,15 @@ class ContinuousBatcher:
                 "headDim": int(self.cfg.head_dim),
                 "blockSize": int(ex.block_size),
                 "quant": ex.kv_quant,
-                "specK": int(ex.spec_k)}
+                "specK": int(ex.spec_k),
+                # live swap (ISSUE 19): generation IS part of the
+                # envelope — KV computed under generation r must never
+                # serve generation r+1's weights (migration, peer
+                # fetch, and the durable store all refuse across a
+                # bump).  A TP resize without a generation bump keeps
+                # fleet KV flowing, exactly as the tp-absent rule
+                # intends.
+                "generation": int(self.generation)}
 
     def attach_kv_store(self, store) -> None:
         """Wire the durable prefix store (ISSUE 17,
@@ -2047,7 +2295,8 @@ class ContinuousBatcher:
         return handoff_fingerprint(
             self.cfg, block_size=self.executor.block_size,
             kv_quant=self.kv_quant, top_k=self._top_k,
-            top_p=self._top_p, wquant=self.weight_quant_mode())
+            top_p=self._top_p, wquant=self.weight_quant_mode(),
+            generation=self.generation)
 
     def _migration_meta(self, pk: _ParkedLane) -> Dict[str, Any]:
         """The JSON half of a lane envelope: request identity + stream
@@ -2574,9 +2823,11 @@ class ContinuousBatcher:
         # with compute; depth 1 was still RTT-bound on relayed chips
         # whose round-trip exceeds a chunk's device time (measured by
         # bench.py measure_ring_throughput), hence depth 2 by default.
-        ex = self.executor
         pending: List[tuple] = []   # [(chunk_reqs, toks, counts, ok)]
         while not self._stop.is_set():
+            # re-bound every pass: a live swap (ISSUE 19) may have
+            # replaced the executor object at the previous boundary
+            ex = self.executor
             # ring-level fault (dispatch raised, or the watchdog
             # declared a stall): drop the in-flight chunks and self-heal
             # — rebuild everything device-side, re-admit queued work —
@@ -2631,6 +2882,25 @@ class ContinuousBatcher:
                 except Exception as e:
                     self._fault = e
                     continue
+            # live weight swap (ISSUE 19): a posted swap fires at THE
+            # quiesced boundary — every in-flight dispatch consumed,
+            # no lane mid-prefill (admissions pause below while the
+            # swap is pending, so prefills drain within a few passes).
+            # The flip parks residents, swaps params/mesh, and the
+            # parked lanes restore through the normal path right after.
+            if self._swap_req is not None:
+                if not self._prefilling and not self._disagg_waiting:
+                    try:
+                        while pending:
+                            self._consume_oldest(pending)
+                    except Exception as e:
+                        self._fault = e
+                        continue
+                    if self._fault is None:
+                        self._do_swap()
+                    continue
+                # lanes still prefilling: fall through (slices advance,
+                # handoffs land); the swap fires once they finish
             # admit into free lanes: parked (preempted) lanes resume
             # ahead of queued work of the same class — they were
             # admitted first and already hold tokens — and queued work
@@ -2638,6 +2908,12 @@ class ContinuousBatcher:
             # run even while DRAINING: a parked lane is admitted work
             # the drain budget promises to finish.
             while any(r is None for r in self.lane):
+                if self._swap_req is not None:
+                    # swap pending: admissions/restores pause so the
+                    # quiesce converges (restores would re-fill lanes
+                    # the flip is about to park); both resume on the
+                    # pass after _do_swap
+                    break
                 pk = self._best_parked()
                 cq = (None if self._draining
                       else self._pending.peek_class())

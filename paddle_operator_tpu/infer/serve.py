@@ -220,6 +220,36 @@ class ContinuousGenerator:
         self.batcher.close()
 
 
+def _load_swap_checkpoint(path: str, cfg) -> Any:
+    """Restore a TRAINING checkpoint's params for serving — the same
+    restore + dtype-convert the entrypoint runs at boot — from the
+    ``/v1/swap`` handler thread (ISSUE 19): the expensive half of a
+    live swap happens HERE, off the ring loop, while the old
+    generation keeps serving.  Raises when nothing restores (a swap
+    must never silently flip to fresh-init weights)."""
+    from paddle_operator_tpu.infer.quant import serving_params
+    from paddle_operator_tpu.models.llama import Llama
+    from paddle_operator_tpu.train import trainer as T
+    from paddle_operator_tpu.train.checkpoint import (
+        CheckpointManager,
+        resume_or_init,
+    )
+
+    model = Llama(cfg)
+    opt = T.make_optimizer()
+
+    def init():
+        p = model.init(jax.random.PRNGKey(0),
+                       jnp.zeros((1, 8), jnp.int32))["params"]
+        return T.TrainState(step=jnp.zeros((), jnp.int32), params=p,
+                            opt_state=opt.init(p))
+
+    state, resumed = resume_or_init(CheckpointManager(path), init)
+    if not resumed:
+        raise ValueError(f"no checkpoint restorable at {path}")
+    return serving_params(state.params, cfg.dtype)
+
+
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator  # injected
     state = None          # injected resilience.ServerState
@@ -541,6 +571,109 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(buf)
 
+    def _swap(self, body: bytes) -> None:
+        """POST /v1/swap — live weight swap / elastic TP resize
+        (ISSUE 19, docs/serving.md "Live model lifecycle").  Body keys
+        (all optional): ``checkpoint`` (path; omitted = rebuild from
+        the retained boot base — the TP-resize / quant-flip shape),
+        ``draft_checkpoint`` (spec rings), ``tp`` (target degree;
+        omitted = keep the mesh), ``generation`` (explicit; omitted =
+        bump by one), ``weight_quant`` / ``draft_quant``
+        (none|int8|int4; omitted = keep the serving mode),
+        ``timeout_s``.  The checkpoint load + quantize runs on THIS
+        handler thread while the old generation keeps serving; only
+        the quiesce-flip-restore runs on the ring loop.  Responses:
+        200 + post-swap summary, 409 a swap is already in flight,
+        503 + Retry-After the ring cannot swap right now (draining /
+        rebuilding / never reached a boundary — retry)."""
+        from paddle_operator_tpu.infer.resilience import (
+            RetriableError,
+            ShuttingDown,
+        )
+
+        b = self._batcher()
+        if b is None:
+            self._send(400, {"error": "live swap requires the "
+                             "continuous ring (SERVE_CONTINUOUS=1)"})
+            return
+        retry_hdr = {"Retry-After":
+                     self.state.retry_after_s if self.state else 5}
+        try:
+            req = json.loads(body) if body else {}
+            base = getattr(self.server, "swap_base", None)
+            cfg = getattr(self.generator, "cfg", None)
+            ckpt = req.get("checkpoint")
+            if ckpt:
+                params = _load_swap_checkpoint(ckpt, cfg)
+            elif base is not None:
+                params = base["params"]
+            else:
+                raise ValueError(
+                    "no 'checkpoint' given and no retained base "
+                    "(SERVE_SWAP_RETAIN=0) — nothing to swap to")
+            wq = req.get("weight_quant")
+            if wq is None:
+                wq = (base or {}).get("weight_quant", "none")
+            wq = wq or "none"
+            if wq != "none":
+                from paddle_operator_tpu.infer.quant import (
+                    SERVING_SKIP,
+                    quantize_params,
+                )
+
+                params = quantize_params(params, cfg, mode=wq,
+                                         skip=SERVING_SKIP)
+            dparams = None
+            if getattr(b, "spec_k", 0) > 0:
+                dck = req.get("draft_checkpoint")
+                if dck:
+                    dparams = _load_swap_checkpoint(dck, b.draft_cfg)
+                elif base is not None \
+                        and base.get("draft_params") is not None:
+                    dparams = base["draft_params"]
+                else:
+                    raise ValueError(
+                        "speculative ring: a swap needs "
+                        "'draft_checkpoint' or a retained draft base")
+                dwq = req.get("draft_quant")
+                if dwq is None:
+                    dwq = (base or {}).get("draft_quant", "none")
+                if (dwq or "none") != "none":
+                    from paddle_operator_tpu.infer.quant import (
+                        SERVING_SKIP,
+                        quantize_params,
+                    )
+
+                    dparams = quantize_params(dparams, b.draft_cfg,
+                                              mode=dwq,
+                                              skip=SERVING_SKIP)
+            kw = {}
+            tp = req.get("tp")
+            if tp is not None and int(tp) != b.serving_tp():
+                if int(tp) > 1:
+                    from paddle_operator_tpu.parallel.mesh import (
+                        make_serving_mesh,
+                    )
+
+                    kw["mesh"] = make_serving_mesh(int(tp))
+                else:
+                    kw["mesh"] = None
+            if req.get("generation") is not None:
+                kw["generation"] = int(req["generation"])
+            res = b.swap_weights(
+                params, draft_params=dparams,
+                timeout=float(req.get("timeout_s", 120.0)), **kw)
+            self._send(200, res)
+        except (ShuttingDown, RetriableError) as e:
+            self._send(503, {"error": str(e)}, headers=retry_hdr)
+        except ValueError as e:
+            already = "already in flight" in str(e)
+            self._send(409 if already else 400, {"error": str(e)})
+        except (KeyError, TypeError, json.JSONDecodeError) as e:
+            self._send(400, {"error": str(e)})
+        except Exception as e:     # noqa: BLE001 — refuse, never crash
+            self._send(503, {"error": str(e)}, headers=retry_hdr)
+
     def do_POST(self):
         from paddle_operator_tpu.infer.resilience import (
             RetriableError,
@@ -557,6 +690,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._kv_prefix(body)
         if self.path == "/v1/adapters":
             return self._adapters_admin(body)
+        if self.path == "/v1/swap":
+            return self._swap(body)
         if self.path != "/v1/generate":
             self._send(404, {})
             return
@@ -856,6 +991,15 @@ def main() -> int:
     # byte-identical programs.  SERVE_DRAFT_QUANT (below, spec rings
     # only) is the safe proving ground: quantize the draft first.
     wq = os.environ.get("SERVE_WEIGHT_QUANT", "none") or "none"
+    # live swap (ISSUE 19): retain a HOST copy of the pre-quant serving
+    # base so a checkpoint-less /v1/swap — a TP resize or a quant-mode
+    # flip — can rebuild from it without a checkpoint round-trip.
+    # Host RAM, not HBM; SERVE_SWAP_RETAIN=0 opts out (swaps then
+    # require a 'checkpoint' in the body).
+    swap_base = None
+    if os.environ.get("SERVE_SWAP_RETAIN", "1") == "1":
+        swap_base = {"params": jax.device_get(params),
+                     "weight_quant": wq}
     if wq != "none":
         from paddle_operator_tpu.infer.quant import (
             SERVING_SKIP,
@@ -881,6 +1025,12 @@ def main() -> int:
                    "resilience": RingResilience.from_env()}
         if os.environ.get("SERVE_MAX_LEN"):
             ring_kw["max_len"] = int(os.environ["SERVE_MAX_LEN"])
+        # SERVE_GENERATION (ISSUE 19): the weight generation this
+        # replica boots serving (operator-injected from
+        # spec.serving.generation) — the fleet roll's convergence
+        # signal; /v1/swap bumps it live
+        ring_kw["generation"] = int(
+            os.environ.get("SERVE_GENERATION", "0") or 0)
         # SERVE_PAGED=1: block-pool KV cache + radix prefix reuse
         # (infer/paged.py; docs/serving.md has the layout/eviction/CoW
         # story).  SERVE_BLOCK_SIZE sets pool-block granularity (keep
@@ -1079,6 +1229,11 @@ def main() -> int:
             else:
                 dstate = dinit()
             dparams = serving_params(dstate.params, dcfg.dtype)
+            if swap_base is not None:
+                swap_base["draft_params"] = jax.device_get(dparams)
+                swap_base["draft_quant"] = (
+                    os.environ.get("SERVE_DRAFT_QUANT", "none")
+                    or "none")
             # SERVE_DRAFT_QUANT=int8|int4: quantize the DRAFT only.
             # Spec verify tolerates draft drift by construction — a
             # coarser draft can only lower accept rate, never change
@@ -1123,6 +1278,8 @@ def main() -> int:
                       job=os.environ.get("TPUJOB_NAME", "local"),
                       replica=os.environ.get("TPUJOB_REPLICA_ID", ""),
                       **ring_kw)
+    # the /v1/swap handler reaches the retained base via self.server
+    srv.swap_base = swap_base if continuous else None
     # SIGTERM drain (docs/fault-tolerance.md, serving pods): the SAME
     # PreemptionWatcher contract the trainer uses — stop admissions
     # (503 + Retry-After), finish in-flight lanes within the drain

@@ -1,0 +1,138 @@
+"""Live-swap driver CLI (ISSUE 19) — the deploy tool's view of one
+replica's ``/v1/swap``.
+
+    python -m paddle_operator_tpu.infer.swapctl \
+        --url http://127.0.0.1:9000 [--checkpoint /path] [--tp 2] \
+        [--generation 3] [--weight-quant int8] [--timeout-s 120]
+
+POSTs the swap request, prints the post-swap summary JSON on stdout,
+and exits 0 on success.  A 503 (the ring is draining/rebuilding or
+never reached a quiesced boundary) retries with backoff up to
+``--retries``; a 4xx is terminal — the request itself is wrong.
+``--wait-generation N`` instead polls ``/statusz`` until the replica
+reports ``weightGeneration >= N`` (the fleet roll's convergence probe,
+usable standalone after an out-of-band swap).
+
+Runs as a SUBPROCESS of the serve-swap dryrun gate and of
+``bench.measure_weight_swap`` — the tier-1 preflight pgrep names this
+module so a wedged driver from a previous session fails the timed run
+loudly instead of skewing it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+def post_swap(url: str, body: Dict[str, Any], *,
+              timeout_s: float = 180.0) -> Dict[str, Any]:
+    """One ``/v1/swap`` POST; returns the parsed summary.  Raises
+    ``urllib.error.HTTPError`` on non-200 (the caller decides whether
+    the status is retriable)."""
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/swap",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as r:
+        return json.loads(r.read())
+
+
+def poll_generation(url: str, generation: int, *,
+                    timeout_s: float = 120.0,
+                    interval_s: float = 0.2) -> Optional[Dict[str, Any]]:
+    """Poll ``/statusz`` until ``weightGeneration >= generation``;
+    returns the converged status block, or None on timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                    url.rstrip("/") + "/statusz", timeout=10) as r:
+                st = json.loads(r.read())
+            if int(st.get("weightGeneration", -1)) >= int(generation):
+                return st
+        except (urllib.error.URLError, OSError, ValueError):
+            pass                    # replica mid-flip: keep polling
+        time.sleep(interval_s)
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="drive one replica's live weight swap")
+    ap.add_argument("--url", required=True,
+                    help="replica base URL (http://host:port)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="checkpoint path to swap to (omitted: the "
+                    "replica rebuilds from its retained boot base)")
+    ap.add_argument("--draft-checkpoint", default=None)
+    ap.add_argument("--tp", type=int, default=None,
+                    help="target tensor-parallel degree (elastic "
+                    "resize); omitted keeps the mesh")
+    ap.add_argument("--generation", type=int, default=None,
+                    help="explicit target generation (omitted: +1)")
+    ap.add_argument("--weight-quant", default=None,
+                    choices=["none", "int8", "int4"])
+    ap.add_argument("--draft-quant", default=None,
+                    choices=["none", "int8", "int4"])
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--retries", type=int, default=5,
+                    help="503 retries (draining/boundary-timeout)")
+    ap.add_argument("--wait-generation", type=int, default=None,
+                    help="poll /statusz for this generation instead "
+                    "of posting a swap")
+    args = ap.parse_args(argv)
+
+    if args.wait_generation is not None:
+        st = poll_generation(args.url, args.wait_generation,
+                             timeout_s=args.timeout_s)
+        if st is None:
+            print(json.dumps({"error": "generation wait timed out"}),
+                  file=sys.stderr)
+            return 1
+        print(json.dumps({"weightGeneration": st["weightGeneration"],
+                          "servingTp": st.get("servingTp")}))
+        return 0
+
+    body: Dict[str, Any] = {"timeout_s": args.timeout_s}
+    for k, v in (("checkpoint", args.checkpoint),
+                 ("draft_checkpoint", args.draft_checkpoint),
+                 ("tp", args.tp), ("generation", args.generation),
+                 ("weight_quant", args.weight_quant),
+                 ("draft_quant", args.draft_quant)):
+        if v is not None:
+            body[k] = v
+    backoff = 0.5
+    for attempt in range(args.retries + 1):
+        try:
+            res = post_swap(args.url, body,
+                            timeout_s=args.timeout_s + 60)
+            print(json.dumps(res))
+            return 0
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:300]
+            if e.code == 503 and attempt < args.retries:
+                # replica draining / boundary timeout: retriable
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 8.0)
+                continue
+            print(json.dumps({"error": f"HTTP {e.code}: {detail}"}),
+                  file=sys.stderr)
+            return 1
+        except (urllib.error.URLError, OSError) as e:
+            if attempt < args.retries:
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 8.0)
+                continue
+            print(json.dumps({"error": str(e)}), file=sys.stderr)
+            return 1
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

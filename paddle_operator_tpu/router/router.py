@@ -100,6 +100,12 @@ _GAUGE_KEYS = {
     "tpujob_serve_prefill_lanes": "prefillLanes",
     "tpujob_serve_prefill_batch_occupancy": "prefillBatchOccupancy",
     "tpujob_serve_prefill_hol_wait_ms": "prefillHolWaitMs",
+    # live weight swap (ISSUE 19): the generation each replica serves
+    # — /statusz shows the mid-roll spread, and the fleet fold splits
+    # its token-weighted rates per generation instead of blending
+    # old- and new-weights readings into one unlabeled number
+    "tpujob_serve_generation": "weightGeneration",
+    "tpujob_serve_tp": "servingTp",
 }
 
 _GAUGE_RE = re.compile(
@@ -280,6 +286,52 @@ def aggregate_fleet_serving(replicas: Dict[str, Dict[str, Any]]
         if vals:
             agg[key] = round(sum(v * w for v, w in vals)
                              / (sum(w for _, w in vals) or 1.0), 4)
+    # live weight swap (ISSUE 19): a mid-roll fleet serves two weight
+    # generations at once — blending their hit/accept rates into ONE
+    # unlabeled token-weighted number would attribute the old
+    # generation's warmed-cache readings to the new deploy (and the
+    # swapped replica's cold restart to the old).  The fold therefore
+    # labels the blend: the generation spread + a ``mixedGenerations``
+    # flag always ride the block, and mid-roll the same token-weighted
+    # rates are ALSO split per generation (``byGeneration``), so
+    # dashboards and the bench read honest numbers while the roll is
+    # in flight.
+    gens = sorted({int(b["weightGeneration"]) for b in blocks
+                   if b.get("weightGeneration") is not None})
+    if gens:
+        agg["generationMin"] = gens[0]
+        agg["generationMax"] = gens[-1]
+        agg["mixedGenerations"] = len(gens) > 1
+        if len(gens) > 1:
+            by: Dict[str, Any] = {}
+            for g in gens:
+                sub = [b for b in blocks
+                       if int(b.get("weightGeneration", -1)) == g]
+                ws = [max(float(b.get("tokensTotal", 0) or 0), 0.0)
+                      for b in sub]
+                if not sum(ws):
+                    ws = [1.0] * len(sub)
+                ent: Dict[str, Any] = {"replicas": len(sub)}
+                tps = [float(b.get("tokensPerSec", 0.0) or 0.0)
+                       for b in sub if "tokensPerSec" in b]
+                if tps:
+                    ent["tokensPerSec"] = round(sum(tps), 2)
+                for key in ("prefixHitRate", "acceptRate",
+                            "hostHitRate", "kvStoreHitRate"):
+                    vals = [(float(b.get(key, 0.0) or 0.0), w)
+                            for b, w in zip(sub, ws) if key in b]
+                    if vals:
+                        ent[key] = round(
+                            sum(v * w for v, w in vals)
+                            / (sum(w for _, w in vals) or 1.0), 4)
+                by[str(g)] = ent
+            agg["byGeneration"] = by
+    tp_vals = [int(b["servingTp"]) for b in blocks
+               if b.get("servingTp") is not None]
+    if tp_vals:
+        # mid-resize the wider degree is the capacity truth, same rule
+        # as the prefill-lane fold
+        agg["servingTp"] = max(tp_vals)
     # latency histograms (ISSUE 15): fixed-bucket counts FOLD by
     # addition — decode replicas only (prefill pods never emit a TTFT)
     # — and the folded rolling window yields the one number a p95 can
@@ -1473,6 +1525,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
             payload = resp.read()
             stitch(resp.status,
                    payload if resp.status in (200, 504) else None)
+            if resp.status == 503:
+                # the replica shed us (drain or a live swap raced the
+                # scrape tick): mark it down NOW — the client's
+                # idempotent retry must re-route to a ready peer, not
+                # bounce off the same quiescing replica until the next
+                # poll — and bound the retry signal even when the
+                # upstream forgot the header
+                r.mark_unready(endpoint)
+                passthrough.setdefault("Retry-After", r.retry_after_s)
             # the UPSTREAM result is in hand: from here on a failure is
             # the downstream client's socket, not the replica's — it
             # must neither mark the replica unready nor lose the

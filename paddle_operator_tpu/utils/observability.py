@@ -150,6 +150,17 @@ def serving_gauges(status_serving: dict, job: str,
                 float(fleet.get("prefillReplicasReady", 0))
             out[f"tpujob_serve_fleet_prefill_drained{lbl}"] = \
                 float(fleet.get("prefillDrained", 0))
+        # rolling weight swap (ISSUE 19): the fleet's generation
+        # SPREAD — min == max means the roll converged; rendered only
+        # when the aggregation saw generation-labeled replicas, so
+        # pre-swap fleets keep their exact gauge set
+        if "generationMin" in fleet:
+            out[f"tpujob_serve_fleet_generation_min{lbl}"] = \
+                float(fleet.get("generationMin", 0))
+            out[f"tpujob_serve_fleet_generation_max{lbl}"] = \
+                float(fleet.get("generationMax", 0))
+            out[f"tpujob_serve_fleet_mixed_generations{lbl}"] = \
+                1.0 if fleet.get("mixedGenerations") else 0.0
     return out
 
 
@@ -307,6 +318,16 @@ def _serving_gauges_one(status_serving: dict, job: str,
             float(status_serving.get("quarantinedLanes", 0.0)),
         f"tpujob_serve_draining{lbl}":
             1.0 if status_serving.get("draining") else 0.0,
+        # live weight swap / elastic TP resize (ISSUE 19): the weight
+        # generation this replica serves, its current tensor-parallel
+        # degree, and cumulative in-place swaps — a mid-roll fleet
+        # shows a generation spread (the fleet block's min/max below)
+        f"tpujob_serve_generation{lbl}":
+            float(status_serving.get("weightGeneration", 0.0)),
+        f"tpujob_serve_tp{lbl}":
+            float(status_serving.get("servingTp", 0.0)),
+        f"tpujob_serve_weight_swaps_total{lbl}":
+            float(status_serving.get("weightSwaps", 0.0)),
     }
 
 

@@ -36,7 +36,8 @@ SERVING = {"tokensPerSec": 123.4, "acceptRate": 0.72, "queueDepth": 3,
            "parkedLanes": 1, "laneMigrations": 4, "adoptedLanes": 2,
            "peerPrefixFetches": 6, "hostCacheEvictions": 7,
            "kvStoreBlocks": 11, "kvStoreBytes": 2048,
-           "kvStoreHitRate": 0.44, "kvStoreEvictions": 9}
+           "kvStoreHitRate": 0.44, "kvStoreEvictions": 9,
+           "weightGeneration": 3, "servingTp": 2, "weightSwaps": 1}
 
 
 class TestGaugeNaming:
@@ -117,6 +118,12 @@ class TestGaugeNaming:
                  '{job="default/j"}'] == 0.44
         assert g['tpujob_serve_kv_store_evictions_total'
                  '{job="default/j"}'] == 9.0
+        # live-swap gauges (ISSUE 19): the weight generation this
+        # replica serves, its TP degree, cumulative in-place swaps
+        assert g['tpujob_serve_generation{job="default/j"}'] == 3.0
+        assert g['tpujob_serve_tp{job="default/j"}'] == 2.0
+        assert g['tpujob_serve_weight_swaps_total'
+                 '{job="default/j"}'] == 1.0
 
     def test_prefill_mode_label_defaults_inline(self):
         g = serving_gauges({}, "ns/x")
@@ -204,6 +211,12 @@ class TestGaugeNaming:
             'tpujob_serve_watchdog_restarts{job="default/j"}',
             'tpujob_serve_quarantined_lanes{job="default/j"}',
             'tpujob_serve_draining{job="default/j"}',
+            # live weight swap / elastic TP shape (ISSUE 19): the
+            # weight generation this replica serves, its TP degree,
+            # and cumulative in-place swaps
+            'tpujob_serve_generation{job="default/j"}',
+            'tpujob_serve_tp{job="default/j"}',
+            'tpujob_serve_weight_swaps_total{job="default/j"}',
         }
 
     def test_fleet_block_adds_replica_labeled_gauges(self):
@@ -394,7 +407,10 @@ class TestBatcherServingStatus:
                            "latencyHist", "ttftP95Ms",
                            # fault-tolerance block (infer/resilience.py)
                            "draining", "healthy", "deadlineExceeded",
-                           "watchdogRestarts", "quarantinedLanes"}
+                           "watchdogRestarts", "quarantinedLanes",
+                           # live weight swap block (ISSUE 19)
+                           "weightGeneration", "servingTp",
+                           "weightSwaps"}
         assert st["prefillMode"] == "inline"
         assert st["prefillQueueDepth"] == 0
         assert st["kvQuantMode"] == "none"     # bf16 default

@@ -1,0 +1,439 @@
+"""Live weight swap & elastic TP resize (ISSUE 19): checkpoint r+1
+(or the same checkpoint at a new TP degree) flips into a serving ring
+without restarting the process or dropping a request — residents park
+at a quiesced boundary through the PR 10 spill, the flip is
+all-or-nothing, and parked lanes restore through the promote scatter.
+The fleet layer rolls replicas one at a time off a
+``spec.serving.generation`` bump through the same drain-first victim
+path a scale-down uses.
+
+Fast legs run bf16/tp1; the TP-resize x quant x spec matrix rides
+``-m slow`` (each leg compiles a second ring)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_operator_tpu.infer.scheduler import ContinuousBatcher
+from paddle_operator_tpu.models.llama import make_model
+
+RING_KW = dict(slots=2, max_len=48, chunk_tokens=4,
+               prefill_buckets=(16, 48), paged=True, block_size=8,
+               num_blocks=64, prefix_cache=True)
+PROMPT = [1, 2, 3, 4, 5, 6]
+
+
+def _params(seed=0):
+    model, cfg = make_model("tiny", dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(seed),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return params, cfg
+
+
+def _oracle(params, cfg, prompt=PROMPT, max_new=8, **kw):
+    """A fresh single-model ring: the bit-identity reference."""
+    merged = dict(RING_KW)
+    merged.update(kw)
+    b = ContinuousBatcher(params, cfg, **merged)
+    try:
+        return b.submit(list(prompt),
+                        max_new_tokens=max_new).result(timeout=300)
+    finally:
+        b.close()
+
+
+def _wait_active(b, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if any(r is not None for r in b.lane):
+            return
+        time.sleep(0.005)
+    raise TimeoutError("request never became resident")
+
+
+class TestInPlaceSwap:
+    def test_swap_to_new_weights_post_oracle(self):
+        """After the flip the ring serves checkpoint B bit-identically
+        to a fresh single-model ring — the old generation's cache can
+        never leak into the new one."""
+        pa, cfg = _params(0)
+        pb, _ = _params(1)
+        b = ContinuousBatcher(pa, cfg, **RING_KW)
+        try:
+            pre = b.submit(list(PROMPT),
+                           max_new_tokens=8).result(timeout=300)
+            np.testing.assert_array_equal(pre, _oracle(pa, cfg))
+            res = b.swap_weights(pb, generation=7)
+            assert res["generation"] == 7
+            assert res["servingTp"] == 1
+            assert res["weightQuantMode"] == "none"
+            post = b.submit(list(PROMPT),
+                            max_new_tokens=8).result(timeout=300)
+            np.testing.assert_array_equal(post, _oracle(pb, cfg))
+            st = b.serving_status()
+            assert st["weightGeneration"] == 7
+            assert st["servingTp"] == 1
+            assert st["weightSwaps"] == 1
+        finally:
+            b.close()
+
+    def test_mid_flight_swap_parks_and_restores_bit_identical(self):
+        """A swap posted while a stream is resident parks the lane at
+        the quiesced boundary and restores it after the flip — with
+        identical weights the stream is bit-identical to a ring that
+        never swapped."""
+        pa, cfg = _params(0)
+        want = _oracle(pa, cfg, max_new=24)
+        b = ContinuousBatcher(pa, cfg, **RING_KW)
+        try:
+            h = b.submit(list(PROMPT), max_new_tokens=24)
+            _wait_active(b)
+            res = b.swap_weights(jax.device_get(pa))
+            assert res["generation"] == 1          # default: bump by 1
+            np.testing.assert_array_equal(h.result(timeout=300), want)
+            assert b.serving_status()["weightSwaps"] == 1
+        finally:
+            b.close()
+
+    def test_spec_ring_missing_draft_rolls_back(self):
+        """All-or-nothing: a speculative ring refuses a swap that
+        ships no draft (stale drafts silently collapse acceptance),
+        and the ring keeps serving the OLD generation bit-identically
+        afterwards."""
+        pa, cfg = _params(0)
+        b = ContinuousBatcher(pa, cfg, draft_params=jax.device_get(pa),
+                              draft_cfg=cfg, spec_k=3, **RING_KW)
+        try:
+            with pytest.raises(ValueError, match="draft"):
+                b.swap_weights(_params(1)[0])
+            st = b.serving_status()
+            assert st["weightGeneration"] == 0     # never moved
+            assert st["weightSwaps"] == 0
+            out = b.submit(list(PROMPT),
+                           max_new_tokens=8).result(timeout=300)
+            np.testing.assert_array_equal(
+                out, _oracle(pa, cfg, draft_params=jax.device_get(pa),
+                             draft_cfg=cfg, spec_k=3))
+        finally:
+            b.close()
+
+    def test_unpaged_ring_refuses_swap(self):
+        pa, cfg = _params(0)
+        b = ContinuousBatcher(pa, cfg, slots=2, max_len=48,
+                              chunk_tokens=4, prefill_buckets=(16, 48))
+        try:
+            with pytest.raises(ValueError, match="paged"):
+                b.swap_weights(_params(1)[0])
+        finally:
+            b.close()
+
+    def test_fingerprints_carry_generation(self):
+        """Generation purity: migration/store/peer envelopes and the
+        remote-prefill handoff both refuse across generations — but
+        the migration fingerprint deliberately omits tp, so a resize
+        WITHOUT a generation bump keeps fleet KV flowing."""
+        pa, cfg = _params(0)
+        b = ContinuousBatcher(pa, cfg, generation=4, **RING_KW)
+        try:
+            assert b._fingerprint()["generation"] == 4
+            assert b.handoff_fingerprint()["gen"] == 4
+            assert "tp" not in b._fingerprint()
+        finally:
+            b.close()
+
+
+class TestSwapHTTP:
+    """The /v1/swap surface on a live continuous server, plus the
+    swapctl CLI helpers against it."""
+
+    @pytest.fixture(scope="class")
+    def sserver(self):
+        from paddle_operator_tpu.infer.serve import make_server
+
+        pa, cfg = _params(0)
+        srv = make_server("127.0.0.1", 0, pa, cfg, continuous=True,
+                          **RING_KW)
+        # what serve.py main() retains under SERVE_SWAP_RETAIN=1
+        srv.swap_base = {"params": jax.device_get(pa),
+                         "weight_quant": "none"}
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        yield f"http://127.0.0.1:{srv.server_address[1]}", pa, cfg, srv
+        srv.shutdown()
+        srv.generator.close()
+
+    def _post(self, base, path, payload):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_swap_bumps_generation_and_keeps_serving(self, sserver):
+        base, pa, cfg, srv = sserver
+        code, out = self._post(base, "/v1/generate",
+                               {"tokens": [PROMPT],
+                                "max_new_tokens": 4})
+        assert code == 200
+        # checkpoint-less swap: rebuild from the retained boot base
+        code, res = self._post(base, "/v1/swap", {"generation": 3})
+        assert code == 200
+        assert res["generation"] == 3
+        with urllib.request.urlopen(f"{base}/statusz",
+                                    timeout=10) as r:
+            st = json.loads(r.read())
+        assert st["weightGeneration"] == 3
+        assert st["servingTp"] == 1
+        # same weights, fresh cache: generate still serves, and the
+        # stream matches the pre-swap answer bit-for-bit
+        code2, out2 = self._post(base, "/v1/generate",
+                                 {"tokens": [PROMPT],
+                                  "max_new_tokens": 4})
+        assert code2 == 200
+        assert out2["tokens"] == out["tokens"]
+
+    def test_no_base_no_checkpoint_is_400(self, sserver):
+        base, _, _, srv = sserver
+        saved, srv.swap_base = srv.swap_base, None
+        try:
+            self._post(base, "/v1/swap", {})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "nothing to swap" in json.loads(e.read())["error"]
+        finally:
+            srv.swap_base = saved
+
+    def test_missing_checkpoint_path_is_503_retriable(self, sserver):
+        """A checkpoint that cannot be resumed is an infrastructure
+        fault (bad mount, wrong path): 503 + Retry-After, never a
+        flip."""
+        base, _, _, _ = sserver
+        try:
+            self._post(base, "/v1/swap",
+                       {"checkpoint": "/nonexistent/ckpt"})
+            assert False, "expected an error"
+        except urllib.error.HTTPError as e:
+            assert e.code in (400, 503)
+
+    def test_swapctl_drives_the_server(self, sserver):
+        from paddle_operator_tpu.infer import swapctl
+
+        base, _, _, _ = sserver
+        rc = swapctl.main(["--url", base, "--generation", "9",
+                           "--timeout-s", "120"])
+        assert rc == 0
+        assert swapctl.poll_generation(base, 9, timeout_s=10,
+                                       interval_s=0.1)
+
+
+class TestRollingSwapReconciler:
+    """Fleet layer: a spec.serving.generation bump rolls replicas one
+    at a time through the drain-first victim path; replacements boot
+    at the new generation and the roll converges."""
+
+    NS = "default"
+    TMPL = {"spec": {"containers": [{"name": "m",
+                                     "image": "jax:latest"}]}}
+
+    def _setup(self, replicas=2):
+        from paddle_operator_tpu.api import (
+            ServingSpec,
+            TPUJob,
+            TPUJobSpec,
+        )
+        from paddle_operator_tpu.controller.fake_api import (
+            FakeAPI,
+            FakeFleet,
+        )
+        from paddle_operator_tpu.controller.reconciler import (
+            KIND_JOB,
+            TPUJobReconciler,
+            run_to_settled,
+        )
+
+        api = FakeAPI()
+        rec = TPUJobReconciler(api)
+        fleet = FakeFleet(api, self.NS)
+        job = TPUJob(name="fj", namespace=self.NS, spec=TPUJobSpec(
+            serving=ServingSpec(replicas=replicas, template=self.TMPL,
+                                block_size=8)))
+        api.create(KIND_JOB, job.to_dict())
+        run_to_settled(rec, self.NS, "fj")
+        fleet.run_all()
+        run_to_settled(rec, self.NS, "fj")
+        return api, rec, fleet
+
+    def _gen_env(self, api, name):
+        pod = api.get("Pod", self.NS, name)
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0]["env"]}
+        return env.get("SERVE_GENERATION")
+
+    def _bump_generation(self, api, gen):
+        from paddle_operator_tpu.controller.reconciler import KIND_JOB
+
+        raw = api.get(KIND_JOB, self.NS, "fj")
+        raw["spec"]["serving"]["generation"] = gen
+        api.update(KIND_JOB, raw)
+
+    def test_roll_one_replica_at_a_time(self):
+        from paddle_operator_tpu.api import TPUJob
+        from paddle_operator_tpu.controller.reconciler import (
+            KIND_JOB,
+            run_to_settled,
+        )
+
+        api, rec, fleet = self._setup(replicas=2)
+        assert self._gen_env(api, "fj-serve-0") == "0"
+        self._bump_generation(api, 1)
+        rec.reconcile(self.NS, "fj")
+        # pass 1: ONLY the lowest-index stale replica gets the drain
+        # annotation, stamped with the swap reason
+        a0 = (api.get("Pod", self.NS, "fj-serve-0")["metadata"]
+              .get("annotations") or {})
+        a1 = (api.get("Pod", self.NS, "fj-serve-1")["metadata"]
+              .get("annotations") or {})
+        assert a0.get("tpujob-drain") == "swap-gen-1"
+        assert "tpujob-drain" not in a1
+        # replica 0 drains (migrate-out, exit 83) and is replaced at
+        # the new generation...
+        fleet.preempt("fj-serve-0")
+        run_to_settled(rec, self.NS, "fj")
+        assert self._gen_env(api, "fj-serve-0") == "1"
+        # ...but replica 1 is NOT touched until the replacement is
+        # Running again — never two replicas of capacity out at once
+        a1 = (api.get("Pod", self.NS, "fj-serve-1")["metadata"]
+              .get("annotations") or {})
+        assert "tpujob-drain" not in a1
+        fleet.run_all()
+        rec.reconcile(self.NS, "fj")
+        a1 = (api.get("Pod", self.NS, "fj-serve-1")["metadata"]
+              .get("annotations") or {})
+        assert a1.get("tpujob-drain") == "swap-gen-1"
+        fleet.preempt("fj-serve-1")
+        run_to_settled(rec, self.NS, "fj")
+        fleet.run_all()
+        run_to_settled(rec, self.NS, "fj")
+        assert self._gen_env(api, "fj-serve-1") == "1"
+        got = TPUJob.from_dict(api.get(KIND_JOB, self.NS, "fj"))
+        flt = got.status.serving["fleet"]
+        # swap accounting: counted swapped + preempted, NEVER failed —
+        # the roll must not burn restart budgets or read as faults
+        assert flt["swappedReplicas"] == 2
+        assert flt["replicaRestarts"] == 0
+        assert flt["generationDesired"] == 1
+        assert flt["replicasAtGeneration"] == 2
+        assert got.status.preempted_count == 2
+        assert got.status.restart_count == 0
+        assert got.status.phase == "Running"
+        assert any(e["reason"] == "WeightSwapRoll"
+                   for e in api.events)
+
+    def test_converged_fleet_never_rolls(self):
+        from paddle_operator_tpu.controller.reconciler import (
+            run_to_settled,
+        )
+
+        api, rec, fleet = self._setup(replicas=2)
+        run_to_settled(rec, self.NS, "fj")
+        for n in ("fj-serve-0", "fj-serve-1"):
+            ann = (api.get("Pod", self.NS, n)["metadata"]
+                   .get("annotations") or {})
+            assert "tpujob-drain" not in ann
+
+
+@pytest.mark.slow
+class TestResizeAndQuantMatrix:
+    """TP resize x weight-quant x speculative legs — each compiles a
+    second ring (and sharded programs), so the matrix rides -m slow;
+    the bf16/tp1 swap path above stays tier-1."""
+
+    def test_tp_resize_1_to_2_mid_flight_bit_identical(self):
+        from paddle_operator_tpu.parallel.mesh import make_serving_mesh
+
+        pa, cfg = _params(0)
+        want = _oracle(pa, cfg, max_new=24)
+        b = ContinuousBatcher(pa, cfg, **RING_KW)
+        try:
+            h = b.submit(list(PROMPT), max_new_tokens=24)
+            _wait_active(b)
+            res = b.swap_weights(jax.device_get(pa),
+                                 mesh=make_serving_mesh(2))
+            assert res["servingTp"] == 2
+            # the tp=1 lane parked as full host bytes and restored
+            # through the promote scatter, which re-shards: the stream
+            # is bit-identical to the never-resized tp=1 oracle
+            np.testing.assert_array_equal(h.result(timeout=300), want)
+            # a fresh request on the resized ring matches too (tp is
+            # bit-neutral by the PR 4 contract)
+            post = b.submit(list(PROMPT),
+                            max_new_tokens=8).result(timeout=300)
+            np.testing.assert_array_equal(post, _oracle(pa, cfg))
+            assert b.serving_status()["servingTp"] == 2
+        finally:
+            b.close()
+
+    def test_resize_back_down_to_tp1(self):
+        from paddle_operator_tpu.parallel.mesh import make_serving_mesh
+
+        pa, cfg = _params(0)
+        b = ContinuousBatcher(pa, cfg, mesh=make_serving_mesh(2),
+                              **RING_KW)
+        try:
+            assert b.serving_tp() == 2
+            res = b.swap_weights(jax.device_get(pa), mesh=None)
+            assert res["servingTp"] == 1
+            out = b.submit(list(PROMPT),
+                           max_new_tokens=8).result(timeout=300)
+            np.testing.assert_array_equal(out, _oracle(pa, cfg))
+        finally:
+            b.close()
+
+    def test_swap_flips_weight_quant_mode(self):
+        """A swap may change the storage mode: bf16 -> int8 re-traces
+        on the first dispatch (leaf types are the dispatch), and the
+        post-swap stream matches a fresh int8 ring."""
+        from paddle_operator_tpu.infer.quant import (
+            SERVING_SKIP,
+            quantize_params,
+        )
+
+        pa, cfg = _params(0)
+        qa = quantize_params(jax.device_get(pa), cfg, mode="int8",
+                             skip=SERVING_SKIP)
+        b = ContinuousBatcher(pa, cfg, **RING_KW)
+        try:
+            res = b.swap_weights(qa)
+            assert res["weightQuantMode"] == "int8"
+            post = b.submit(list(PROMPT),
+                            max_new_tokens=8).result(timeout=300)
+            np.testing.assert_array_equal(post, _oracle(qa, cfg))
+        finally:
+            b.close()
+
+    def test_spec_ring_swaps_target_and_draft_together(self):
+        pa, cfg = _params(0)
+        pb, _ = _params(1)
+        spec_kw = dict(draft_params=jax.device_get(pa), draft_cfg=cfg,
+                       spec_k=3)
+        b = ContinuousBatcher(pa, cfg, **spec_kw, **RING_KW)
+        try:
+            res = b.swap_weights(pb,
+                                 draft_params=jax.device_get(pb))
+            assert res["generation"] == 1
+            post = b.submit(list(PROMPT),
+                            max_new_tokens=8).result(timeout=300)
+            np.testing.assert_array_equal(
+                post, _oracle(pb, cfg, draft_params=jax.device_get(pb),
+                              draft_cfg=cfg, spec_k=3))
+        finally:
+            b.close()

@@ -343,7 +343,8 @@ def _rendered_metric_names():
     sample = {
         "prefillMode": "chunked", "kvQuantMode": "int8",
         "priorityQueueDepth": [1], "adapterNames": ["a"],
-        "fleet": {"replicasDesired": 1, "prefillReplicasDesired": 1},
+        "fleet": {"replicasDesired": 1, "prefillReplicasDesired": 1,
+                  "generationMin": 0},
     }
     names = {k.split("{", 1)[0] for k in serving_gauges(sample, "j")}
     # prefill pods export two gauges of their own (metrics_text) — the
