@@ -30,9 +30,10 @@ test: native
 # replicas AND `router.replay` sim/sweep drivers — a wedged `make sim`
 # or serve-sim dryrun leaves exactly those behind; `prefill_serve`
 # needs its own alternation — "infer.serve" is not a substring of
-# "infer.prefill_serve".)
+# "infer.prefill_serve"; `utils.wirechaos` catches standalone fault
+# proxies (ISSUE 20 CLI) no other alternation matches.)
 tier1:
-	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet|paddle_operator_tpu\.infer\.kvstore|paddle_operator_tpu\.infer\.swapctl' || true); \
+	@pids=$$(pgrep -f 'paddle_operator_tpu\.infer\.serve|paddle_operator_tpu\.infer\.prefill_serve|paddle_operator_tpu\.router|paddle_operator_tpu\.router\.simfleet|paddle_operator_tpu\.infer\.kvstore|paddle_operator_tpu\.infer\.swapctl|paddle_operator_tpu\.utils\.wirechaos' || true); \
 	if [ -n "$$pids" ]; then \
 		echo "tier1 preflight FAILED: orphaned serve/router process(es) from a previous session:"; \
 		ps -o pid,etime,rss,args -p $$pids || true; \
@@ -96,17 +97,24 @@ sim:
 # re-gather on the new base, and the real swapctl CLI rolling a
 # router-fronted replica under load with zero 5xx; witnesses the
 # demoted -m slow legs (TP-resize x weight-quant x spec swap matrix,
-# tests/test_serve_swap.py::TestResizeAndQuantMatrix) — and ft-drain)
+# tests/test_serve_swap.py::TestResizeAndQuantMatrix) — and ft-drain;
+# serve-wirechaos — seeded wire-fault storm (drop/dup/burst503/
+# trickle/blackhole, utils/wirechaos.py) on 4 fleet edges around a
+# kill -9'd journal-backed router: every request exactly-once, the
+# pre-crash dedupe window replayed byte-identical after restart)
 dryrun:
 	$(PY) __graft_entry__.py
 
-# Seeded chaos suite (infer/chaos.py schedules through the resilience
-# machinery): the deterministic fault tests plus the serve-chaos dryrun
-# gate standalone — the fast way to re-verify serving fault tolerance
-# without the full dryrun/tier1.
+# Seeded chaos suite, both planes (infer/chaos.py RING faults through
+# the resilience machinery; utils/wirechaos.py WIRE faults through the
+# journal-backed router + retrying clients): the deterministic fault
+# tests plus the serve-chaos and serve-wirechaos dryrun gates
+# standalone — the fast way to re-verify fleet fault tolerance without
+# the full dryrun/tier1.
 chaos:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q -p no:cacheprovider
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py tests/test_wirechaos.py -q -m 'not slow' -p no:cacheprovider
 	env JAX_PLATFORMS=cpu $(PY) -c "import __graft_entry__ as g; g.chaos_gate()"
+	env JAX_PLATFORMS=cpu $(PY) -c "import __graft_entry__ as g; g.wirechaos_gate()"
 
 docker-build:
 	docker build -t $(IMG) .

@@ -3268,6 +3268,257 @@ def measure_resilience(fault_rates=(0, 1, 5), *, slots: int = 2,
     return out
 
 
+def measure_wire_chaos(storm_requests: int = 24,
+                       blackhole_requests: int = 40) -> dict:
+    """Fleet goodput under injected WIRE faults (utils/wirechaos.py,
+    ISSUE 20) — the wire-plane sibling of measure_resilience's
+    dispatch-fault sweep, all stdlib + echo-stub replicas (jax-free).
+
+    **Storm cell**: a seeded client-router fault storm (drop, dup,
+    burst503, trickle) in front of the real FleetRouter over two
+    replicas; clients retry through client.post_generate's 503
+    discipline with idempotent request_ids.
+    ``wirechaos_goodput_ratio`` is the share of requests that resolved
+    200 with the right echoed id AND executed exactly once across the
+    fleet — drops must retry, dups must dedupe — floor 0.9
+    (docs/fault-tolerance.md).
+
+    **Blackhole cell**: one replica's wire eats every POST (3s
+    blackhole vs the router's 0.5s upstream timeout; /readyz scrapes
+    still pass, so mark-down alone cannot save the fleet).  Control:
+    breaker disabled — every request affine to the injured replica
+    pays the full timeout before spilling.  Treatment: the per-replica
+    circuit breaker (threshold 2) — two requests pay, the breaker
+    opens, the rest route around for the cooldown.
+    ``router_blackhole_p95_ratio`` = control p95 / breaker p95,
+    floor 5x."""
+    import os
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from paddle_operator_tpu.router.router import (
+        FleetRouter, make_router_server,
+    )
+    from paddle_operator_tpu.utils import wirechaos as WC
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "client"))
+    import client as client_cli
+
+    def stub_replica():
+        # scrape-compatible echo replica (tests/test_fleet.py stub
+        # pattern): /readyz + /metrics keep the router's scrape loop
+        # honest, /v1/generate echoes the request_id so exactly-once
+        # is checkable end to end
+        executed, lock = [], threading.Lock()
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/readyz":
+                    body = b"ok"
+                elif self.path == "/metrics":
+                    body = (b"tpujob_serve_queue_depth 0\n"
+                            b"tpujob_serve_kv_blocks_free 64\n"
+                            b"tpujob_serve_tokens_per_sec 100\n")
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                raw = self.rfile.read(
+                    int(self.headers.get("Content-Length", "0") or 0))
+                req = json.loads(raw or b"{}")
+                with lock:
+                    executed.append(req.get("request_id"))
+                body = json.dumps(
+                    {"request_id": req.get("request_id"),
+                     "tokens": req.get("tokens", [])}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        srv.executed = executed
+        return srv
+
+    def router_front(eps, **kw):
+        r = FleetRouter(list(eps), scrape_interval=0.05,
+                        affinity_blocks=1, block_size=4, **kw)
+        srv = make_router_server("127.0.0.1", 0, r)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        for _ in range(400):
+            if r.ready():
+                break
+            time.sleep(0.02)
+        return srv, f"127.0.0.1:{srv.server_address[1]}"
+
+    def close_front(srv):
+        try:
+            srv.router.close()
+        except Exception:
+            pass
+        srv.shutdown()
+        srv.server_close()
+
+    def close_stub(s):
+        s.shutdown()
+        s.server_close()
+
+    # -- storm cell: seeded client-router faults, goodput ------------------
+    stubs = [stub_replica() for _ in range(2)]
+    rsrv, rep = router_front(
+        [f"127.0.0.1:{s.server_address[1]}" for s in stubs])
+    storm = [WC.WireEvent("drop", 1), WC.WireEvent("dup", 3),
+             WC.WireEvent("burst503", 5, 2),
+             WC.WireEvent("trickle", 9, 0.2),
+             WC.WireEvent("drop", 12),
+             WC.WireEvent("burst503", 16, 2),
+             WC.WireEvent("dup", 20)]
+    cr = WC.WireChaosProxy(rep, storm, edge="client-router",
+                           seed=2020).start()
+    resolved: dict = {}
+    lock = threading.Lock()
+
+    def storm_client(t):
+        for i in range(storm_requests // 4):
+            rid = f"wc-bench-{t}-{i}"
+            payload = {"request_id": rid,
+                       "tokens": [t * 17 + i + 1] * 6,
+                       "max_new_tokens": 4}
+            try:
+                status, body = client_cli.post_generate(
+                    cr.url, payload, max_retries=10,
+                    backoff_base_s=0.05, backoff_max_s=0.3)
+            except Exception:
+                continue                 # lost request: counted below
+            with lock:
+                resolved[rid] = (status, body.get("request_id"))
+
+    threads = [threading.Thread(target=storm_client, args=(t,))
+               for t in range(4)]
+    t0 = time.perf_counter()
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    span = time.perf_counter() - t0
+    executed = [rid for s in stubs for rid in s.executed]
+    ok = sum(1 for rid, (st, echo) in resolved.items()
+             if st == 200 and echo == rid and executed.count(rid) == 1)
+    faults = dict(cr.counters["faults"])
+    cr.close()
+    close_front(rsrv)
+    [close_stub(s) for s in stubs]
+
+    # -- blackhole cell: breaker OFF (control) vs ON (treatment) -----------
+    from paddle_operator_tpu.utils.radixkey import prefix_chain_key
+
+    def affine_prompts(router, eps, target, n, start):
+        # the hashring layout depends on the (random) stub ports, so a
+        # fixed prompt set splits differently every run — pin each
+        # prompt's affinity HOME deterministically by asking the same
+        # ring the router routes with
+        prompts, v = [], start
+        while len(prompts) < n:
+            p = [v % 251 + 1, (v // 251) % 251 + 1, 3, 4, 5, 6]
+            key, _ = prefix_chain_key(p, router.block_size,
+                                      router.affinity_blocks)
+            if router.ring.pick(key, eps) == target:
+                prompts.append(p)
+            v += 1
+        return prompts
+
+    def blackhole_leg(threshold, cooldown):
+        a, b = stub_replica(), stub_replica()
+        bh = WC.WireChaosProxy(
+            f"127.0.0.1:{a.server_address[1]}",
+            [WC.WireEvent("blackhole", i, 3.0) for i in range(512)],
+            edge="router-replica", seed=7).start()
+        b_ep = f"127.0.0.1:{b.server_address[1]}"
+        srv, ep = router_front(
+            [bh.endpoint, b_ep], upstream_timeout=0.5,
+            breaker_threshold=threshold, breaker_cooldown_s=cooldown)
+        # 1 in 5 requests is affine to the injured replica, the rest to
+        # the healthy one — enough injured samples that the control p95
+        # always lands on a blackholed request, few enough that the
+        # breaker leg's pre-trip cost (2 requests) stays under the p95
+        # cut
+        injured = affine_prompts(srv.router, [bh.endpoint, b_ep],
+                                 bh.endpoint, blackhole_requests // 5, 1)
+        healthy = affine_prompts(srv.router, [bh.endpoint, b_ep],
+                                 b_ep, blackhole_requests - len(injured),
+                                 10_000)
+        prompts, ii, hh = [], 0, 0
+        for i in range(blackhole_requests):
+            if i % 5 == 0 and ii < len(injured):
+                prompts.append(injured[ii])
+                ii += 1
+            else:
+                prompts.append(healthy[hh])
+                hh += 1
+        lat, failed = [], 0
+        try:
+            for i, p in enumerate(prompts):
+                # pace arrivals slower than the scrape tick: back-to-
+                # back requests would all land inside the mark-down
+                # window after the first timeout and route around the
+                # injured replica for free — steady-state traffic
+                # arrives AFTER the scrape has re-readied it (readyz
+                # still passes; only the breaker remembers)
+                time.sleep(0.06)
+                payload = {"request_id": f"wc-bh-{threshold}-{i}",
+                           "tokens": p, "max_new_tokens": 4}
+                t0 = time.perf_counter()
+                try:
+                    client_cli.post_generate(
+                        f"http://{ep}", payload, max_retries=3,
+                        backoff_base_s=0.05, backoff_max_s=0.2)
+                except Exception:
+                    # retry budget exhausted: without a breaker the
+                    # 0.05s scrape re-readies the blackholed replica
+                    # faster than the client backs off, so an affine
+                    # request can starve — the burned budget IS the
+                    # latency sample the control leg exists to show
+                    failed += 1
+                lat.append((time.perf_counter() - t0) * 1e3)
+            trips = int(srv.router.counters.get("breaker_trips", 0))
+        finally:
+            close_front(srv)
+            bh.close()
+            close_stub(a)
+            close_stub(b)
+        return lat, failed, trips
+
+    ctl, ctl_failed, _ = blackhole_leg(0, 2.0)   # 0 disables the breaker
+    trt, trt_failed, trips = blackhole_leg(2, 30.0)  # no half-open mid-leg
+    p_ctl = _pctl(ctl, 0.95) or 0.0
+    p_trt = _pctl(trt, 0.95) or 0.0
+
+    return {
+        "wirechaos_requests": storm_requests,
+        "wirechaos_resolved_exactly_once": ok,
+        "wirechaos_goodput_ratio": round(ok / storm_requests, 3),
+        "wirechaos_faults_injected": int(sum(faults.values())),
+        "wirechaos_fault_kinds": ",".join(
+            sorted(k for k, v in faults.items() if v)),
+        "wirechaos_storm_span_s": round(span, 2),
+        "router_blackhole_p95_control_ms": round(p_ctl, 1),
+        "router_blackhole_p95_breaker_ms": round(p_trt, 1),
+        "router_blackhole_p95_ratio": round(p_ctl / max(p_trt, 1e-9), 1),
+        "router_blackhole_control_failed": ctl_failed,
+        "router_blackhole_breaker_failed": trt_failed,
+        "router_blackhole_breaker_trips": trips,
+    }
+
+
 def measure_submit_latency() -> dict:
     """submit→rendezvous-ConfigMap over real HTTP (BASELINE.md metric
     'kubectl apply → first training step'; the training-side share is the
@@ -3960,6 +4211,18 @@ def main() -> int:
             summary["chaos_goodput_ratio"] = round(worst / base_tps, 3)
     else:
         emit("resilience_sweep", resil)
+
+    # wire-plane chaos (ISSUE 20): seeded client-router fault storm
+    # goodput + the circuit breaker's p95 win against a blackholed
+    # replica — the wire sibling of the dispatch-fault sweep above
+    # (jax-free: real router + wirechaos proxies over echo stubs)
+    wc = guarded("wire_chaos", lambda: measure_wire_chaos())
+    emit("wire_chaos", wc)
+    if isinstance(wc, dict) and "wirechaos_goodput_ratio" in wc:
+        summary["wirechaos_goodput_ratio"] = \
+            wc["wirechaos_goodput_ratio"]
+        summary["router_blackhole_p95_ratio"] = \
+            wc["router_blackhole_p95_ratio"]
 
     # recovery sweep: time-to-restore + goodput under injected
     # preemption drains (docs/fault-tolerance.md), alongside the serving

@@ -38,6 +38,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from paddle_operator_tpu import GROUP, PLURAL, VERSION  # noqa: E402
 from paddle_operator_tpu.api import TPUJob  # noqa: E402
 from paddle_operator_tpu.controller.kube_api import KubeAPI  # noqa: E402
+from paddle_operator_tpu.utils.fleetkv import backoff_delay  # noqa: E402
 
 
 def make_api() -> KubeAPI:
@@ -112,16 +113,13 @@ def post_generate(base_url, payload, *, deadline_s=None, max_retries=4,
         except (urllib.error.URLError, ConnectionError, TimeoutError):
             if attempt >= max_retries:
                 raise
-        delay = min(backoff_max_s, backoff_base_s * (2 ** attempt))
-        if retry_after is not None:
-            try:
-                delay = float(retry_after)
-            except ValueError:
-                # RFC 7231 also allows an HTTP-date Retry-After (some
-                # ingress proxies send one); keep the computed backoff
-                # rather than crashing the helper whose job is 503s
-                pass
-        delay *= 0.5 + rng.random()            # jitter in [0.5, 1.5)
+        # the shared fleet backoff law (utils/fleetkv.backoff_delay,
+        # ISSUE 20 satellite): exponential + capped, a numeric
+        # Retry-After replacing the computed delay (HTTP-date forms
+        # keep it), multiplicative jitter in [0.5, 1.5)
+        delay = backoff_delay(attempt, base_s=backoff_base_s,
+                              max_s=backoff_max_s,
+                              retry_after=retry_after, rng=rng)
         if deadline is not None \
                 and time.monotonic() + delay >= deadline:
             raise TimeoutError(

@@ -29,6 +29,13 @@ every resident decode dispatch passes through, 1-step or fused
 megastep), so admission, consume bookkeeping, and the self-healing
 machinery all run their REAL code — only the device dispatch lies.
 One replay == one dispatch index, whatever SERVE_MEGASTEP is.
+
+This plane covers RING faults only.  The fleet's WIRE faults —
+connection drops, truncation, corruption, duplicate delivery,
+blackholes on the client/router/broker/prefill edges — are the
+sibling plane, ``utils/wirechaos.py``, driven by the same grammar
+under ``TPUJOB_WIRE_CHAOS`` (faults fire at per-edge REQUEST indices,
+the wire's analogue of the dispatch counter).
 """
 
 from __future__ import annotations

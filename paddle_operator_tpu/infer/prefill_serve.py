@@ -755,13 +755,17 @@ class RemotePrefillClient:
             }).encode()
             outcome = None
             t_wire0 = time.monotonic()
-            for i, ep in enumerate(self._targets()):
-                if req.done.is_set() or req._cancel:
-                    break           # late resolution: stop POSTing
-                if i:
-                    self.stats["retries"] += 1
-                    time.sleep(min(self.backoff_s * i, 1.0))
-                if self.stream:
+            if self.stream:
+                for i, ep in enumerate(self._targets()):
+                    if req.done.is_set() or req._cancel:
+                        break       # late resolution: stop POSTing
+                    if i:
+                        self.stats["retries"] += 1
+                        # shared fleet backoff law (ISSUE 20
+                        # satellite) — jittered exponential, same as
+                        # the non-stream path below
+                        time.sleep(FK.backoff_delay(
+                            i - 1, base_s=self.backoff_s, max_s=1.0))
                     res = self._stream_attempt(ep, body, req, slot)
                     if res == "next":
                         continue
@@ -770,42 +774,54 @@ class RemotePrefillClient:
                                         stream=True)
                     outcome = res
                     break
-                try:
-                    code, raw = FK.http_post(
-                        ep, "/v1/prefill", body,
-                        content_type="application/json",
-                        timeout=self.timeout)
-                except Exception:   # conn refused/reset/timeout: next
-                    continue
-                if code == 503:
-                    continue        # draining / no ready pod yet
-                if code == 409:
-                    # fingerprint mismatch — during a fleet rolling
-                    # swap (ISSUE 19) pods still on the old weight
-                    # generation refuse; walk on, an already-rolled
-                    # peer may match.  All-mismatch exhausts the
-                    # attempts into the retriable-error path below.
-                    continue
-                if code != 200:
-                    try:
-                        msg = json.loads(raw).get("error", raw[:120])
-                    except Exception:
-                        msg = raw[:120]
-                    outcome = (req, slot, RuntimeError(
-                        f"remote prefill rejected ({code}): {msg}"))
-                    break
-                try:
-                    meta, arrays = FK.decode_handoff(raw)
-                    if self.fingerprint is not None:
-                        FK.check_fingerprint(meta, self.fingerprint)
-                except FK.EnvelopeError as e:
-                    outcome = (req, slot, e)
-                    break
-                self.stats["posted"] += 1
-                self._wire_span(req, t_wire0, ep, i, stream=False)
-                outcome = (req, slot, arrays, int(meta["nBlocks"]),
-                           int(meta["first"]))
-                break
+            else:
+                # the whole walk — conn errors, 503 (draining pod) and
+                # 409 (fingerprint mismatch mid rolling swap, an
+                # already-rolled peer may match) retry to the next
+                # candidate with jittered backoff, Retry-After honored
+                # — is the shared bounded-retry helper (ISSUE 20
+                # satellite); prefill is side-effect-free so retrying
+                # freely is always safe
+                attempts = [0]
+
+                def _on_retry(ep, i):
+                    attempts[0] = i + 1
+                    self.stats["retries"] += 1
+
+                code, raw, used = FK.http_post_retry(
+                    [self.broker] if self.broker else self.peers,
+                    "/v1/prefill", body,
+                    content_type="application/json",
+                    timeout=self.timeout,
+                    max_attempts=self.max_attempts,
+                    backoff_base_s=self.backoff_s, backoff_max_s=1.0,
+                    retry_statuses=(503, 409),
+                    on_retry=_on_retry,
+                    abort=lambda: req.done.is_set() or req._cancel)
+                if used is not None and code not in (0, 503, 409):
+                    if code != 200:
+                        try:
+                            msg = json.loads(raw).get("error",
+                                                      raw[:120])
+                        except Exception:
+                            msg = raw[:120]
+                        outcome = (req, slot, RuntimeError(
+                            f"remote prefill rejected ({code}): "
+                            f"{msg}"))
+                    else:
+                        try:
+                            meta, arrays = FK.decode_handoff(raw)
+                            if self.fingerprint is not None:
+                                FK.check_fingerprint(meta,
+                                                     self.fingerprint)
+                            self.stats["posted"] += 1
+                            self._wire_span(req, t_wire0, used,
+                                            attempts[0], stream=False)
+                            outcome = (req, slot, arrays,
+                                       int(meta["nBlocks"]),
+                                       int(meta["first"]))
+                        except FK.EnvelopeError as e:
+                            outcome = (req, slot, e)
             if outcome == "done":
                 continue    # streamed final already posted
             if outcome is None:
@@ -936,6 +952,15 @@ def remote_prefill_client_from_env() -> Optional[RemotePrefillClient]:
               "SERVE_PREFILL_BROKER or SERVE_PREFILL_PEERS",
               flush=True)
         return None
+    # wire chaos (ISSUE 20): with TPUJOB_WIRE_CHAOS scheduling faults
+    # on the decode->prefill edge, the broker/peer endpoints are
+    # replaced by an injured in-process proxy — the env contract that
+    # lets a chaos run injure THIS edge without touching either pod
+    from paddle_operator_tpu.utils import wirechaos as WC
+
+    broker = WC.wire_endpoint_from_env("decode-prefill", broker)
+    peers = [WC.wire_endpoint_from_env("decode-prefill", p)
+             for p in peers]
     # SERVE_PREFILL_STREAM=1 (ISSUE 14): consume the pool's chunked
     # handoff frames, uploading each block group while the pod still
     # prefills the rest — long-prompt TTFT ≈ last chunk + attach

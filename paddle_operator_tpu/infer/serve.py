@@ -866,9 +866,16 @@ def wire_fleet_kv_from_env(batcher, port: int) -> None:
         return
     from paddle_operator_tpu.utils import fleetkv as FK
 
+    # wire chaos (ISSUE 20): TPUJOB_WIRE_CHAOS scheduling faults on
+    # the replica->broker edge swaps the broker endpoint for an
+    # injured in-process proxy — migrations/prefix fetches then cross
+    # a deterministically faulty wire without touching the router
+    from paddle_operator_tpu.utils import wirechaos as WC
+
     origin = f"{os.environ.get('POD_IP', '127.0.0.1')}:{port}"
     kv_client = FK.FleetKVClient(
-        broker=os.environ.get("SERVE_KV_BROKER", ""),
+        broker=WC.wire_endpoint_from_env(
+            "replica-broker", os.environ.get("SERVE_KV_BROKER", "")),
         peers=os.environ.get("SERVE_KV_PEERS", "").split(","),
         origin=origin)
     if kv_migrate:

@@ -1,0 +1,151 @@
+"""Append-only router state journal (ISSUE 20).
+
+The router's exactly-once guarantee lives in two in-memory windows —
+the dedupe LRU (``request_id -> (status, body)``) and the migration
+table (``request_id -> adopting endpoint``).  Before this journal they
+died with the process: a ``kill -9``'d router restarted empty, and a
+client retry of an already-served request re-executed it (double
+execution), while a retried migration record re-admitted a lane that
+already moved.  The journal persists both windows so a restarted
+router boots back into the *same* exactly-once window.
+
+Shape: one JSONL file under ``ROUTER_STATE_DIR``.  Appends are a
+single ``write()`` of one ``\\n``-terminated line followed by
+``fsync`` — a crash can tear at most the final line, and replay
+skips any undecodable tail instead of refusing to boot.  Result
+bodies are latin-1-escaped JSON strings (bodies are bytes; latin-1
+round-trips every byte value).
+
+Compaction: the file grows one line per served request forever while
+the in-memory windows are capped LRUs, so once the journal exceeds
+``compact_slack`` x the combined caps the router rewrites it from the
+live windows (tmp file + ``os.replace`` — atomic, crash at any point
+leaves either the old or the new journal, never a torn one).
+
+Counters surface as ``tpujob_router_journal_*`` (docs/observability.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+JOURNAL_NAME = "router_journal.jsonl"
+
+
+class RouterJournal:
+    """Crash-safe persistence for the router's dedupe + migration
+    windows.  Not thread-safe on its own — the router calls it under
+    its state lock."""
+
+    def __init__(self, state_dir: str, *,
+                 compact_slack: int = 4) -> None:
+        self.state_dir = state_dir
+        os.makedirs(state_dir, exist_ok=True)
+        self.path = os.path.join(state_dir, JOURNAL_NAME)
+        self.compact_slack = max(2, int(compact_slack))
+        self.records = 0            # lines in the current file
+        self.appends = 0            # appends this process
+        self.replayed = 0           # records restored at boot
+        self.compactions = 0
+        self._fh = None
+
+    # -- appends ------------------------------------------------------
+    def _open(self):
+        if self._fh is None:
+            self._fh = open(self.path, "ab")
+        return self._fh
+
+    def _append(self, rec: Dict) -> None:
+        fh = self._open()
+        fh.write(json.dumps(rec, separators=(",", ":")).encode()
+                 + b"\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+        self.records += 1
+        self.appends += 1
+
+    def append_result(self, request_id: str, status: int, body: bytes,
+                      replica: str = "") -> None:
+        self._append({"k": "res", "id": request_id, "st": int(status),
+                      "b": body.decode("latin-1"), "rep": replica})
+
+    def append_migration(self, request_id: str, endpoint: str) -> None:
+        self._append({"k": "mig", "id": request_id, "ep": endpoint})
+
+    # -- boot replay --------------------------------------------------
+    def replay(self) -> Tuple["OrderedDict[str, Tuple[int, bytes]]",
+                              Dict[str, str],
+                              "OrderedDict[str, str]"]:
+        """Read the journal back into (results, result_replica,
+        migrations) in append order — last write wins, undecodable
+        lines (a torn tail from kill -9 mid-append) are skipped."""
+        results: "OrderedDict[str, Tuple[int, bytes]]" = OrderedDict()
+        result_replica: Dict[str, str] = {}
+        migrations: "OrderedDict[str, str]" = OrderedDict()
+        if not os.path.exists(self.path):
+            return results, result_replica, migrations
+        self.records = 0
+        with open(self.path, "rb") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                    kind = rec["k"]
+                    if kind == "res":
+                        rid = rec["id"]
+                        results.pop(rid, None)
+                        results[rid] = (int(rec["st"]),
+                                        rec["b"].encode("latin-1"))
+                        if rec.get("rep"):
+                            result_replica[rid] = rec["rep"]
+                    elif kind == "mig":
+                        rid = rec["id"]
+                        migrations.pop(rid, None)
+                        migrations[rid] = rec["ep"]
+                    else:
+                        continue
+                except (ValueError, KeyError, AttributeError):
+                    continue        # torn / foreign line
+                self.records += 1
+        self.replayed = self.records
+        return results, result_replica, migrations
+
+    # -- compaction ---------------------------------------------------
+    def should_compact(self, live: int) -> bool:
+        return self.records > self.compact_slack * max(1, live)
+
+    def compact(self, results: "OrderedDict[str, Tuple[int, bytes]]",
+                result_replica: Dict[str, str],
+                migrations: "OrderedDict[str, str]") -> None:
+        """Rewrite the journal from the live (already capped) windows.
+        tmp + ``os.replace`` so a crash mid-compaction leaves a whole
+        journal either way."""
+        tmp = self.path + ".tmp"
+        n = 0
+        with open(tmp, "wb") as fh:
+            for rid, ep in migrations.items():
+                fh.write(json.dumps(
+                    {"k": "mig", "id": rid, "ep": ep},
+                    separators=(",", ":")).encode() + b"\n")
+                n += 1
+            for rid, (st, body) in results.items():
+                fh.write(json.dumps(
+                    {"k": "res", "id": rid, "st": int(st),
+                     "b": body.decode("latin-1"),
+                     "rep": result_replica.get(rid, "")},
+                    separators=(",", ":")).encode() + b"\n")
+                n += 1
+            fh.flush()
+            os.fsync(fh.fileno())
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+        os.replace(tmp, self.path)
+        self.records = n
+        self.compactions += 1
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

@@ -406,6 +406,20 @@ class ReplicaState:
     adapters: set = field(default_factory=set)   # loaded LoRA adapters
     last_ok: float = 0.0                # monotonic time of last scrape
     consecutive_failures: int = 0
+    # circuit breaker (ISSUE 20): POST-path failures trip the breaker
+    # even while /readyz keeps answering (a blackholed replica accepts
+    # probes and hangs work).  While open (monotonic now <
+    # breaker_open_until) the replica is unroutable; after the
+    # cooldown ONE request is admitted as the half-open probe
+    # (breaker_probe_inflight) — its outcome closes or re-opens.
+    breaker_open_until: float = 0.0
+    breaker_probe_inflight: bool = False
+    # POST-path failure streak for the trip threshold, SEPARATE from
+    # consecutive_failures: the scrape loop zeroes that one on every
+    # successful /readyz, so a blackholed replica whose probes keep
+    # passing would never accumulate to the threshold.  Only a real
+    # upstream POST response (breaker_success) clears this.
+    breaker_failures: int = 0
     # latency histograms (ISSUE 15): the last parsed snapshot plus a
     # short history of (t, snapshot) pairs — cumulative scraped counts
     # turn into a rolling window by differencing against the oldest
@@ -485,7 +499,10 @@ class FleetRouter:
                  prefill_endpoints: Optional[List[str]] = None,
                  prefill_endpoints_file: Optional[str] = None,
                  trace: Optional[bool] = None,
-                 kv_store=None) -> None:
+                 kv_store=None,
+                 state_dir: Optional[str] = None,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 2.0) -> None:
         self.block_size = block_size
         # durable prefix store (ISSUE 17): with ROUTER_KV_STORE
         # pointing at the fleet's shared store volume, a /v1/kv/prefix
@@ -557,7 +574,49 @@ class FleetRouter:
             # a ready prefill pod, and asks that found none ready
             "prefill_jobs_forwarded": 0, "no_ready_prefill": 0,
             "upstream_errors": 0, "no_ready_replica": 0,
+            # crash-safe journal (ISSUE 20): appended exactly-once
+            # records, records restored at boot, LRU-cap compactions
+            "journal_appends": 0, "journal_replayed": 0,
+            "journal_compactions": 0,
+            # circuit breaker (ISSUE 20): trips (closed -> open),
+            # re-opens (failed half-open probe), half-open probes
+            # admitted, closes (probe succeeded)
+            "breaker_trips": 0, "breaker_reopens": 0,
+            "breaker_probes": 0, "breaker_closes": 0,
+            # streamed results recorded as already-served terminal
+            # markers (the streamed-dedupe fix, ISSUE 20 satellite)
+            "stream_results_recorded": 0,
         }
+        # circuit breaker config: threshold 0 disables (the bench's
+        # timeout-path control)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        # boot warm-up (ISSUE 20): with a live-reloaded endpoints file
+        # the directory is EMPTY until the first scrape tick reloads
+        # it — a restarted router must not answer /readyz true (and
+        # route nothing, or worse, everything least-loaded to a stale
+        # member) before that first reload.  Static constructor
+        # endpoints ARE the directory, so they warm immediately.
+        self._warmed = not (endpoints_file or prefill_endpoints_file)
+        # crash-safe journal (ISSUE 20): ROUTER_STATE_DIR persists the
+        # dedupe + migration windows so a kill -9'd router restarts
+        # into the SAME exactly-once window instead of an empty one
+        self._journal = None
+        if state_dir:
+            from paddle_operator_tpu.router.journal import RouterJournal
+
+            self._journal = RouterJournal(state_dir)
+            results, result_replica, migrations = self._journal.replay()
+            while len(results) > self._dedupe_cap:
+                k, _ = results.popitem(last=False)
+                result_replica.pop(k, None)
+            self._results = results
+            self._result_replica = result_replica
+            # re-derive base-id routes exactly as record_migration did
+            for rid, ep in migrations.items():
+                self._record_migration_locked(rid, ep)
+            self.counters["journal_replayed"] = float(
+                self._journal.replayed)
         self._stop = threading.Event()
         self._scrape_thread: Optional[threading.Thread] = None
         self._scrape_pool = None        # lazy ThreadPoolExecutor
@@ -682,6 +741,7 @@ class FleetRouter:
         if len(states) <= 1:
             for st in states:
                 probe(st)
+            self._warmed = True
             return
         # reused pool, not per-tick threads: the router scrapes every
         # second for its whole lifetime, and per-endpoint probes are
@@ -698,6 +758,9 @@ class FleetRouter:
                 f.result(timeout=10)
             except Exception:
                 pass   # probe() handles its own errors; belt+braces
+        # boot warm-up (ISSUE 20): only now — with the endpoints file
+        # reloaded and every member probed once — may /readyz go true
+        self._warmed = True
 
     def start(self) -> None:
         if self._scrape_thread is not None:
@@ -730,7 +793,50 @@ class FleetRouter:
     # -- selection ---------------------------------------------------------
 
     def _ready_endpoints(self) -> List[str]:
-        return [ep for ep, st in self.replicas.items() if st.ready]
+        now = time.monotonic()
+        return [ep for ep, st in self.replicas.items()
+                if st.ready and not self._breaker_blocked(st, now)]
+
+    # -- circuit breaker (ISSUE 20) ----------------------------------------
+
+    def _breaker_blocked(self, st: ReplicaState, now: float) -> bool:
+        """Passive breaker filter (no side effects — statusz and
+        metrics consult it too).  Open pre-cooldown: blocked.  Open
+        post-cooldown: one request may pass as the half-open probe;
+        while that probe is in flight everyone else stays blocked."""
+        if st.breaker_open_until <= 0.0 or self.breaker_threshold <= 0:
+            return False
+        if now < st.breaker_open_until:
+            return True
+        return st.breaker_probe_inflight
+
+    def breaker_admit(self, endpoint: str) -> None:
+        """Called by the proxy as a request is dispatched: if this
+        replica's breaker is half-open, this request IS the probe."""
+        st = self.replicas.get(self._norm(endpoint))
+        if st is None or st.breaker_open_until <= 0.0:
+            return
+        with self._lock:
+            if (time.monotonic() >= st.breaker_open_until
+                    and not st.breaker_probe_inflight):
+                st.breaker_probe_inflight = True
+                self.counters["breaker_probes"] += 1
+
+    def breaker_success(self, endpoint: str) -> None:
+        """An upstream POST produced a response: close the breaker (a
+        successful scrape does NOT — a blackholed replica keeps
+        answering /readyz while hanging work, so only the work path
+        can prove recovery)."""
+        st = self.replicas.get(self._norm(endpoint))
+        if st is None:
+            return
+        with self._lock:
+            st.breaker_failures = 0   # the streak is CONSECUTIVE
+            if st.breaker_open_until > 0.0:
+                st.breaker_open_until = 0.0
+                st.breaker_probe_inflight = False
+                st.consecutive_failures = 0
+                self.counters["breaker_closes"] += 1
 
     def _hot(self, st: ReplicaState) -> bool:
         """Affinity target too loaded to queue behind.  Judged only
@@ -748,8 +854,25 @@ class FleetRouter:
         waiting a whole scrape interval to shed a dead replica)."""
         st = self.replicas.get(self._norm(endpoint))
         if st is not None:
-            st.ready = False
-            st.consecutive_failures += 1
+            with self._lock:
+                st.ready = False
+                st.consecutive_failures += 1
+                if self.breaker_threshold <= 0:
+                    st.breaker_probe_inflight = False
+                    return
+                st.breaker_failures += 1
+                was_open = st.breaker_open_until > 0.0
+                if (st.breaker_failures >= self.breaker_threshold
+                        or was_open):
+                    # trip — or RE-open after a failed half-open probe
+                    # (the scrape zeroes consecutive_failures on every
+                    # passing /readyz, which proves nothing about the
+                    # POST path — the trip streak is the breaker's own)
+                    st.breaker_open_until = (time.monotonic()
+                                             + self.breaker_cooldown_s)
+                    self.counters["breaker_reopens" if was_open
+                                  else "breaker_trips"] += 1
+                st.breaker_probe_inflight = False
 
     def choose(self, tokens,
                adapter: Optional[str] = None) -> Tuple[Optional[str], str]:
@@ -819,20 +942,38 @@ class FleetRouter:
 
     def record_migration(self, request_id: str, endpoint: str) -> None:
         with self._lock:
-            self._migrations[request_id] = endpoint
-            self._migrations.move_to_end(request_id)
-            base = self._base_request_id(request_id)
-            if base != request_id:
-                # FIRST adopter wins the client-level id: a multi-row
-                # request whose rows land on different adopters must
-                # not have each row's record overwrite the base route
-                # (the retry would then miss every earlier adopter's
-                # handle and re-generate those rows while the adopted
-                # lanes decode orphaned)
-                self._migrations.setdefault(base, endpoint)
-                self._migrations.move_to_end(base)
-            while len(self._migrations) > self._migr_cap:
-                self._migrations.popitem(last=False)
+            self._record_migration_locked(request_id, endpoint)
+            if self._journal is not None:
+                self._journal.append_migration(request_id, endpoint)
+                self.counters["journal_appends"] += 1
+                self._maybe_compact_locked()
+
+    def _record_migration_locked(self, request_id: str,
+                                 endpoint: str) -> None:
+        self._migrations[request_id] = endpoint
+        self._migrations.move_to_end(request_id)
+        base = self._base_request_id(request_id)
+        if base != request_id:
+            # FIRST adopter wins the client-level id: a multi-row
+            # request whose rows land on different adopters must
+            # not have each row's record overwrite the base route
+            # (the retry would then miss every earlier adopter's
+            # handle and re-generate those rows while the adopted
+            # lanes decode orphaned)
+            self._migrations.setdefault(base, endpoint)
+            self._migrations.move_to_end(base)
+        while len(self._migrations) > self._migr_cap:
+            self._migrations.popitem(last=False)
+
+    def _maybe_compact_locked(self) -> None:
+        """Compact the journal against the live (capped) windows once
+        it outgrows them — called under the lock right after an
+        append, so the rewrite races nothing."""
+        live = len(self._results) + len(self._migrations)
+        if self._journal.should_compact(live):
+            self._journal.compact(self._results, self._result_replica,
+                                  self._migrations)
+            self.counters["journal_compactions"] += 1
 
     def migration_candidates(self, origin: str) -> List[str]:
         """Ready replicas able to adopt a lane, best first: fewest
@@ -924,23 +1065,32 @@ class FleetRouter:
         Returns ``(status, response_bytes, pod)``.  Connection
         failures and 503s (draining pod) walk to the next candidate —
         re-running a prefill is always safe; only a deterministic
-        4xx/5xx (fingerprint mismatch, bad prompt) relays as-is."""
-        for ep in self.prefill_candidates():
-            try:
-                code, raw = self._http_post(
-                    ep, "/v1/prefill", body,
-                    content_type="application/json",
-                    timeout=self.upstream_timeout)
-            except (OSError, socket.timeout):
-                st = self.prefill.get(ep)
-                if st is not None:
-                    st.ready = False
-                continue
-            if code == 503:
-                continue            # draining: next candidate
-            with self._lock:
-                self.counters["prefill_jobs_forwarded"] += 1
-            return code, raw, ep
+        4xx/5xx (fingerprint mismatch, bad prompt) relays as-is.  The
+        walk is the shared bounded-retry helper (ISSUE 20 satellite)
+        with ``honor_retry_after=False``: a candidate walk fails over
+        to the next pod immediately instead of waiting out a draining
+        pod's Retry-After hint."""
+        from paddle_operator_tpu.utils.fleetkv import http_post_retry
+
+        def conn_fail(ep: str) -> None:
+            st = self.prefill.get(ep)
+            if st is not None:
+                st.ready = False
+
+        eps = self.prefill_candidates()
+        if eps:
+            code, raw, used = http_post_retry(
+                eps, "/v1/prefill", body,
+                content_type="application/json",
+                timeout=self.upstream_timeout,
+                max_attempts=len(eps),
+                backoff_base_s=0.0, backoff_max_s=0.0,
+                honor_retry_after=False,
+                on_conn_error=conn_fail)
+            if used is not None and code not in (0, 503):
+                with self._lock:
+                    self.counters["prefill_jobs_forwarded"] += 1
+                return code, raw, used
         with self._lock:
             self.counters["no_ready_prefill"] += 1
         return 503, json.dumps(
@@ -1001,6 +1151,11 @@ class FleetRouter:
                 while len(self._results) > self._dedupe_cap:
                     k, _ = self._results.popitem(last=False)
                     self._result_replica.pop(k, None)
+                if self._journal is not None:
+                    self._journal.append_result(request_id, status,
+                                                body, replica or "")
+                    self.counters["journal_appends"] += 1
+                    self._maybe_compact_locked()
 
     def replay_replica(self, request_id: Optional[str]
                        ) -> Optional[str]:
@@ -1017,7 +1172,11 @@ class FleetRouter:
         # unlocked iteration here would crash the /readyz handler at
         # exactly the moment kubelet and the admission gate poll it
         with self._lock:
-            return not self.draining and bool(self._ready_endpoints())
+            # _warmed (ISSUE 20): a restarted router with a
+            # live-reloaded endpoints file answers ready only after
+            # its first full scrape — never on an empty directory
+            return (self._warmed and not self.draining
+                    and bool(self._ready_endpoints()))
 
     def statusz(self) -> Dict[str, Any]:
         with self._lock:
@@ -1074,6 +1233,9 @@ class FleetRouter:
                              f"{1.0 if st.ready else 0.0}")
                 lines.append(f"tpujob_router_replica_queue_depth{lbl} "
                              f"{st.queue_depth}")
+                lines.append(
+                    f"tpujob_router_replica_breaker_open{lbl} "
+                    f"{1.0 if st.breaker_open_until > 0.0 else 0.0}")
             for ep, st in sorted(self.prefill.items()):
                 lbl = f'{{replica="{ep}"}}'
                 lines.append(f"tpujob_router_prefill_ready{lbl} "
@@ -1103,6 +1265,20 @@ class FleetRouter:
                     name.replace("tpujob_serve_", "tpujob_fleet_"),
                     e))
             return "\n".join(lines) + "\n"
+
+
+def stream_served_body(request_id: Optional[str]) -> bytes:
+    """The deterministic "already-served" replay body recorded for a
+    COMPLETED streamed request (ISSUE 20 satellite).  Streams are not
+    replayable — the router never buffers their bytes — but before
+    this marker they were not dedupe-recordable at all, so a client
+    retry AFTER a stream completed re-executed the whole generation
+    (double execution).  Now the completed stream records this marker
+    and the retry gets a terminal JSON answer instead of a re-run; a
+    client that still wants output must mint a new request_id."""
+    return json.dumps({"done": True, "alreadyServed": True,
+                       "stream": True,
+                       "requestId": request_id}, sort_keys=True).encode()
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -1385,7 +1561,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
             mt = r.migrate_target(request_id)
             if mt is not None:
                 st = r.replicas.get(mt)
-                if st is not None and st.ready:
+                if (st is not None and st.ready
+                        and not r._breaker_blocked(st,
+                                                   time.monotonic())):
                     with r._lock:
                         r.counters["routed_migrated"] += 1
                     status, result = self._proxy(mt, "migrated", body,
@@ -1474,10 +1652,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # attempt's span — the cross-pod tree by construction
                 headers[TRC.TRACE_HEADER] = TRC.format_trace_header(
                     trace[0], attempt_id)
+            # circuit breaker (ISSUE 20): if this replica's breaker is
+            # half-open, this request is the probe
+            r.breaker_admit(endpoint)
             conn.request("POST", "/v1/generate", body=body,
                          headers=headers)
             resp = conn.getresponse()
             self.served_replica = endpoint
+            r.breaker_success(endpoint)
             passthrough = dict(id_hdrs or {},
                                **{"X-Router-Replica": endpoint,
                                   "X-Router-Reason": reason})
@@ -1497,31 +1679,47 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 for k, v in passthrough.items():
                     self.send_header(k, v)
                 self.end_headers()
-                try:
-                    while True:
+                # streamed-dedupe fix (ISSUE 20 satellite): the relay
+                # now distinguishes UPSTREAM death (stream incomplete —
+                # the retry must re-run) from DOWNSTREAM death (the
+                # replica finishes the generation regardless — keep
+                # draining it, and record the completed stream so the
+                # client's inevitable retry replays an already-served
+                # marker instead of re-executing)
+                upstream_done = False
+                downstream_ok = True
+                while True:
+                    try:
                         chunk = resp.read1(65536)
-                        if not chunk:
-                            break
-                        self.wfile.write(
-                            f"{len(chunk):x}\r\n".encode() + chunk
-                            + b"\r\n")
-                        self.wfile.flush()
-                except OSError:
-                    # upstream died mid-stream OR the client went away
-                    # (indistinguishable here; the scrape loop settles
-                    # which) — either way the chunked response must
-                    # still be TERMINATED below, or a waiting client
-                    # hangs on an unfinished stream until its socket
-                    # timeout (it detects truncation by the missing
-                    # done event)
-                    pass
+                    except OSError:
+                        break     # upstream died: not a result
+                    if not chunk:
+                        upstream_done = True
+                        break
+                    if downstream_ok:
+                        try:
+                            self.wfile.write(
+                                f"{len(chunk):x}\r\n".encode() + chunk
+                                + b"\r\n")
+                            self.wfile.flush()
+                        except OSError:
+                            downstream_ok = False
+                # the chunked response must still be TERMINATED, or a
+                # waiting client hangs on an unfinished stream until
+                # its socket timeout (it detects truncation by the
+                # missing done event)
                 try:
                     self.wfile.write(b"0\r\n\r\n")
                 except OSError:
                     pass          # downstream client went away
                 stitch(resp.status, None)  # attempt span only: the
                 # relay never parses the stream (docs/observability.md)
-                return resp.status, None   # streams are not replayable
+                if upstream_done:
+                    with r._lock:
+                        r.counters["stream_results_recorded"] += 1
+                    return resp.status, stream_served_body(
+                        req.get("request_id"))
+                return resp.status, None   # incomplete: retry re-runs
             payload = resp.read()
             stitch(resp.status,
                    payload if resp.status in (200, 504) else None)
@@ -1606,7 +1804,16 @@ def main() -> int:
       replica hot (0 disables; default 0);
     - ``ROUTER_SCRAPE_S``        scrape interval seconds (default 1);
     - ``ROUTER_DRAIN_BUDGET_S``  SIGTERM: seconds to let in-flight
-      proxies finish before exit (default 10).
+      proxies finish before exit (default 10);
+    - ``ROUTER_STATE_DIR``       crash-safe journal directory
+      (ISSUE 20): dedupe results + migration records are fsync'd
+      there and replayed at boot, so a ``kill -9``'d router restarts
+      into the same exactly-once window (unset = in-memory only, the
+      pre-journal behavior);
+    - ``ROUTER_BREAKER_THRESHOLD`` consecutive POST failures that trip
+      a replica's circuit breaker (0 disables; default 3);
+    - ``ROUTER_BREAKER_COOLDOWN_S`` seconds an open breaker holds
+      before admitting one half-open probe request (default 2).
 
     SIGTERM drains like a replica does (docs/fault-tolerance.md): stop
     admitting (/readyz false, 503 + Retry-After), let in-flight proxies
@@ -1656,12 +1863,21 @@ def main() -> int:
         prefill_endpoints=peps,
         prefill_endpoints_file=os.environ.get(
             "ROUTER_PREFILL_ENDPOINTS_FILE"),
-        kv_store=kv_store)
+        kv_store=kv_store,
+        state_dir=os.environ.get("ROUTER_STATE_DIR") or None,
+        breaker_threshold=int(os.environ.get(
+            "ROUTER_BREAKER_THRESHOLD", "3")),
+        breaker_cooldown_s=float(os.environ.get(
+            "ROUTER_BREAKER_COOLDOWN_S", "2")))
     srv = make_router_server("0.0.0.0", port, router)
     print(f"fleet router on :{port} fronting "
           f"{len(router.endpoints())} replica(s) "
           f"(affinity_blocks={router.affinity_blocks}, "
           f"block_size={router.block_size})", flush=True)
+    if router._journal is not None:
+        print(f"router journal: {router._journal.path} "
+              f"({router._journal.replayed} record(s) replayed)",
+              flush=True)
     budget = float(os.environ.get("ROUTER_DRAIN_BUDGET_S", "10"))
     code: List[int] = [0]
 

@@ -95,7 +95,9 @@ class SimFleet:
                  ring_extra: Optional[Dict[str, Any]] = None,
                  fleet_kv: bool = False,
                  prefill_pool: int = 0,
-                 trace: bool = False) -> None:
+                 trace: bool = False,
+                 state_dir: Optional[str] = None,
+                 router_extra: Optional[Dict[str, Any]] = None) -> None:
         self.block_size = block_size
         self.ring_kw: Dict[str, Any] = dict(
             slots=slots, max_len=max_len, chunk_tokens=chunk_tokens,
@@ -137,6 +139,11 @@ class SimFleet:
                 self._spawn_prefill()
         # router FIRST (empty decode membership): replicas constructed
         # below need its address for their remote-prefill broker
+        # state_dir (ISSUE 20): a crash-safe journal under the fleet's
+        # router, so kill/restart tests can rebuild a SECOND router on
+        # the same dedupe window; router_extra passes breaker knobs
+        # and friends straight through to FleetRouter
+        self.state_dir = state_dir
         self.router = FleetRouter(
             [],
             block_size=block_size,
@@ -144,7 +151,9 @@ class SimFleet:
             hot_queue_depth=hot_queue_depth,
             scrape_interval=scrape_interval,
             prefill_endpoints=self.prefill_endpoints(),
-            trace=trace or None)
+            trace=trace or None,
+            state_dir=state_dir,
+            **(router_extra or {}))
         self.router_srv = make_router_server("127.0.0.1", 0,
                                              self.router)
         # short poll: shutdown() blocks a full poll interval per

@@ -47,11 +47,13 @@ prefix fetches and must stay jax-free — it only ever peeks the header
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
+import time
 import zlib
 from http.client import HTTPConnection
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -440,14 +442,14 @@ def check_fingerprint(meta: Dict[str, Any],
 # ---------------------------------------------------------------------------
 
 
-def http_post(endpoint: str, path: str, body: bytes,
-              content_type: str = "application/octet-stream",
-              timeout: float = 10.0,
-              headers: Optional[Dict[str, str]] = None
-              ) -> Tuple[int, bytes]:
-    """The one jax-free POST helper the fleet-KV wire uses — shared by
-    :class:`FleetKVClient` and the router's broker so endpoint
-    parsing / timeout semantics cannot drift between them."""
+def _http_post_full(endpoint: str, path: str, body: bytes,
+                    content_type: str = "application/octet-stream",
+                    timeout: float = 10.0,
+                    headers: Optional[Dict[str, str]] = None
+                    ) -> Tuple[int, bytes, Dict[str, str]]:
+    """One POST, returning (status, body, lowercased response
+    headers) — the headers carry the server's ``Retry-After`` hint
+    the retry wrapper honors."""
     host, _, port = endpoint.rpartition(":")
     conn = HTTPConnection(host, int(port), timeout=timeout)
     try:
@@ -456,9 +458,119 @@ def http_post(endpoint: str, path: str, body: bytes,
             hdrs.update(headers)
         conn.request("POST", path, body=body, headers=hdrs)
         resp = conn.getresponse()
-        return resp.status, resp.read()
+        return (resp.status, resp.read(),
+                {k.lower(): v for k, v in resp.getheaders()})
     finally:
         conn.close()
+
+
+def http_post(endpoint: str, path: str, body: bytes,
+              content_type: str = "application/octet-stream",
+              timeout: float = 10.0,
+              headers: Optional[Dict[str, str]] = None
+              ) -> Tuple[int, bytes]:
+    """The one jax-free POST helper the fleet-KV wire uses — shared by
+    :class:`FleetKVClient` and the router's broker so endpoint
+    parsing / timeout semantics cannot drift between them."""
+    code, raw, _ = _http_post_full(endpoint, path, body,
+                                   content_type=content_type,
+                                   timeout=timeout, headers=headers)
+    return code, raw
+
+
+def backoff_delay(attempt: int, *, base_s: float = 0.25,
+                  max_s: float = 8.0,
+                  retry_after: Optional[str] = None,
+                  rng=None) -> float:
+    """The ONE jittered-backoff law every fleet retry loop shares
+    (ISSUE 20 satellite — client/client.py, RemotePrefillClient and
+    the router's prefill forwarder each used to carry their own):
+
+    - exponential ``base_s * 2^attempt`` capped at ``max_s``;
+    - a numeric ``Retry-After`` (the server's own hint) REPLACES the
+      computed backoff for this attempt; RFC 7231 HTTP-date forms
+      keep the computed value rather than crashing a retry helper;
+    - multiplicative jitter in ``[0.5, 1.5)`` — a thousand clients
+      shed by one draining pod must not re-dogpile its replacement
+      in sync.
+
+    ``rng`` is injectable for deterministic tests."""
+    delay = min(max_s, base_s * (2 ** attempt))
+    if retry_after is not None:
+        try:
+            delay = float(retry_after)
+        except (TypeError, ValueError):
+            pass
+    r = rng if rng is not None else random
+    return delay * (0.5 + r.random())
+
+
+def http_post_retry(endpoints, path: str, body: bytes, *,
+                    content_type: str = "application/octet-stream",
+                    timeout: float = 10.0,
+                    headers: Optional[Dict[str, str]] = None,
+                    max_attempts: int = 4,
+                    backoff_base_s: float = 0.25,
+                    backoff_max_s: float = 8.0,
+                    retry_statuses: Tuple[int, ...] = (503,),
+                    honor_retry_after: bool = True,
+                    rng=None, sleep: Callable[[float], None] = time.sleep,
+                    on_conn_error: Optional[Callable[[str], None]] = None,
+                    on_retry: Optional[Callable[[str, int], None]] = None,
+                    abort: Optional[Callable[[], bool]] = None
+                    ) -> Tuple[int, bytes, Optional[str]]:
+    """Bounded-retry POST over :func:`http_post` — the shared loop
+    behind every wire that may retry freely (ISSUE 20 satellite).
+
+    Walks ``endpoints`` (a str, or a list cycled round-robin) for up
+    to ``max_attempts``; connection errors and ``retry_statuses``
+    codes retry with :func:`backoff_delay` pacing (``Retry-After``
+    honored unless ``honor_retry_after=False`` — a candidate WALK
+    fails over immediately instead of waiting out a draining pod's
+    hint).  Any other status returns at once.
+
+    NOT for ambiguous-on-failure wires: lane-migration forwards must
+    stop on a dead socket (the peer may have adopted), so
+    ``FleetKVClient.migrate_out`` / ``broker_migration`` keep their
+    own one-shot discipline.
+
+    Hooks: ``on_conn_error(ep)`` (mark a directory entry unready),
+    ``on_retry(ep, attempt)`` (stats), ``abort()`` (stop early — the
+    request resolved elsewhere).  Returns ``(status, body,
+    endpoint)``; ``(0, b"", None)`` when no attempt got a response."""
+    eps = [endpoints] if isinstance(endpoints, str) else \
+        [e for e in endpoints if e]
+    if not eps:
+        return 0, b"", None
+    last: Tuple[int, bytes, Optional[str]] = (0, b"", None)
+    for attempt in range(max(1, int(max_attempts))):
+        if abort is not None and abort():
+            return last
+        ep = eps[attempt % len(eps)]
+        retry_after = None
+        try:
+            code, raw, rhdrs = _http_post_full(
+                ep, path, body, content_type=content_type,
+                timeout=timeout, headers=headers)
+        except (OSError, socket.timeout):
+            if on_conn_error is not None:
+                on_conn_error(ep)
+        else:
+            if code not in retry_statuses:
+                return code, raw, ep
+            last = (code, raw, ep)
+            if honor_retry_after:
+                retry_after = rhdrs.get("retry-after")
+        if attempt + 1 >= max_attempts:
+            break
+        if on_retry is not None:
+            on_retry(ep, attempt)
+        delay = backoff_delay(attempt, base_s=backoff_base_s,
+                              max_s=backoff_max_s,
+                              retry_after=retry_after, rng=rng)
+        if delay > 0:
+            sleep(delay)
+    return last
 
 
 class FleetKVClient:

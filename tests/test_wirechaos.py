@@ -1,0 +1,733 @@
+"""Wire-chaos plane + crash-safe router (ISSUE 20).
+
+Three surfaces under test:
+
+- ``utils/wirechaos.py``: the seeded wire-fault proxy — schedule
+  grammar, every fault kind against a real stub upstream, byte-identity
+  of the fault-free path, env-driven install;
+- ``router/journal.py`` + the router's breaker: append/replay/compact,
+  torn-tail tolerance, trip/half-open/close discipline;
+- the crash story end-to-end: a ``kill -9``'d subprocess router
+  restarted on the same port + state dir serves the same exactly-once
+  window (journal-replayed dedupe proven by byte-compare) while
+  production clients retry straight through the outage.
+
+Everything here is jax-free and fast except the real-ring leg at the
+bottom (``-m slow``).
+"""
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from random import Random
+
+import pytest
+
+from paddle_operator_tpu.router.journal import RouterJournal
+from paddle_operator_tpu.router.router import (
+    FleetRouter,
+    stream_served_body,
+)
+from paddle_operator_tpu.utils import wirechaos as WC
+from paddle_operator_tpu.utils.fleetkv import backoff_delay
+from paddle_operator_tpu.utils.wirechaos import (
+    EDGES,
+    KINDS,
+    WireChaosProxy,
+    WireEvent,
+    parse_schedule,
+)
+
+sys.path.insert(0, "client")
+import client as client_cli  # noqa: E402  (client/client.py)
+
+
+# ---------------------------------------------------------------------------
+# schedule grammar
+# ---------------------------------------------------------------------------
+
+
+class TestParseSchedule:
+    def test_grammar(self):
+        sched = parse_schedule(
+            "client-router=drop@2,burst503@5:3;"
+            "router-replica=blackhole@4:6")
+        assert set(sched) == {"client-router", "router-replica"}
+        assert sched["client-router"] == [
+            WireEvent("drop", 2, 0.0), WireEvent("burst503", 5, 3.0)]
+        assert sched["router-replica"] == [WireEvent("blackhole", 4, 6.0)]
+
+    def test_events_sorted_by_index(self):
+        sched = parse_schedule("replica-store=corrupt@9,drop@1")
+        assert [e.at for e in sched["replica-store"]] == [1, 9]
+
+    def test_unknown_edge_raises(self):
+        with pytest.raises(ValueError, match="unknown wirechaos edge"):
+            parse_schedule("client-rooter=drop@0")
+
+    def test_unknown_kind_raises(self):
+        with pytest.raises(ValueError, match="unknown wirechaos kind"):
+            parse_schedule("client-router=dorp@0")
+
+    def test_missing_edge_prefix_raises(self):
+        with pytest.raises(ValueError, match="missing 'edge='"):
+            parse_schedule("drop@0")
+
+    def test_empty(self):
+        assert parse_schedule("") == {}
+        assert parse_schedule(" ; ") == {}
+
+    def test_every_edge_and_kind_accepted(self):
+        for edge in EDGES:
+            for kind in KINDS:
+                parse_schedule(f"{edge}={kind}@0")
+
+
+# ---------------------------------------------------------------------------
+# the shared backoff law (fleetkv.backoff_delay — ISSUE 20 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestBackoffLaw:
+    def test_exponential_and_capped(self):
+        for attempt, base in ((0, 0.25), (1, 0.5), (2, 1.0)):
+            d = backoff_delay(attempt, base_s=0.25, max_s=8.0,
+                              rng=Random(0))
+            assert base * 0.5 <= d < base * 1.5
+        d = backoff_delay(20, base_s=0.25, max_s=8.0, rng=Random(0))
+        assert d < 8.0 * 1.5
+
+    def test_numeric_retry_after_replaces(self):
+        d = backoff_delay(0, base_s=0.25, max_s=8.0, retry_after="3",
+                          rng=Random(0))
+        assert 3 * 0.5 <= d < 3 * 1.5
+
+    def test_http_date_retry_after_keeps_computed(self):
+        rng_a, rng_b = Random(7), Random(7)
+        assert backoff_delay(
+            1, base_s=0.25, max_s=8.0, rng=rng_a,
+            retry_after="Wed, 21 Oct 2015 07:28:00 GMT",
+        ) == backoff_delay(1, base_s=0.25, max_s=8.0, rng=rng_b)
+
+
+# ---------------------------------------------------------------------------
+# the proxy, every fault kind, against a real stub upstream
+# ---------------------------------------------------------------------------
+
+
+class _EchoUpstream(BaseHTTPRequestHandler):
+    """Deterministic echo: same request body -> same response bytes
+    (the byte-compare tests depend on it). ``bodies`` records every
+    POST that actually reached the upstream."""
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = b'{"ok": true}'
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        cls = type(self)
+        n = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(n)
+        cls.bodies.append(raw)
+        body = json.dumps({"echo": json.loads(raw)},
+                          sort_keys=True).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture()
+def echo():
+    h = type("Echo", (_EchoUpstream,), {"bodies": []})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), h)
+    threading.Thread(
+        target=lambda: srv.serve_forever(poll_interval=0.02),
+        daemon=True).start()
+    yield f"127.0.0.1:{srv.server_address[1]}", h
+    srv.shutdown()
+    srv.server_close()
+
+
+def _proxied(events, upstream, **kw):
+    return WireChaosProxy(upstream, events, **kw).start()
+
+
+def _post(endpoint, payload, timeout=10.0):
+    req = urllib.request.Request(
+        f"http://{endpoint}/v1/generate",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+_WIRE_ERRORS = (urllib.error.URLError, ConnectionError,
+                http.client.HTTPException, socket.timeout, TimeoutError)
+
+
+class TestWireChaosProxy:
+    def test_fault_free_path_byte_identical(self, echo):
+        up, h = echo
+        proxy = _proxied([], up)
+        try:
+            payload = {"tokens": [[1, 2, 3]], "request_id": "bc-1"}
+            _, direct, _ = _post(up, payload)
+            _, via, _ = _post(proxy.endpoint, payload)
+            assert via == direct
+            assert proxy.counters["requests"] == 1
+            assert proxy.fired == []
+            # GETs relay transparently and never consume a POST index
+            with urllib.request.urlopen(
+                    f"{proxy.url}/readyz", timeout=5) as r:
+                assert r.status == 200
+            assert proxy.counters["requests"] == 1
+        finally:
+            proxy.close()
+
+    def test_drop_never_reaches_upstream(self, echo):
+        up, h = echo
+        proxy = _proxied([WireEvent("drop", 0)], up)
+        try:
+            with pytest.raises(_WIRE_ERRORS):
+                _post(proxy.endpoint, {"tokens": [[1]]})
+            assert h.bodies == []
+            assert proxy.fired == [("drop", 0)]
+        finally:
+            proxy.close()
+
+    def test_truncate_kills_mid_body(self, echo):
+        up, h = echo
+        proxy = _proxied([WireEvent("truncate", 0)], up)
+        try:
+            with pytest.raises(_WIRE_ERRORS):
+                _post(proxy.endpoint,
+                      {"tokens": [[7] * 64], "request_id": "t-1"})
+            # the upstream DID run — only the response wire died
+            assert len(h.bodies) == 1
+        finally:
+            proxy.close()
+
+    def test_corrupt_flips_exactly_one_byte(self, echo):
+        up, h = echo
+        payload = {"tokens": [[5, 6, 7, 8]], "request_id": "c-1"}
+        _, direct, _ = _post(up, payload)
+        proxy = _proxied([WireEvent("corrupt", 0)], up, seed=3)
+        try:
+            _, via, _ = _post(proxy.endpoint, payload)
+            assert len(via) == len(direct)
+            assert sum(a != b for a, b in zip(via, direct)) == 1
+        finally:
+            proxy.close()
+
+    def test_corrupt_is_seeded(self, echo):
+        up, h = echo
+        payload = {"tokens": [[5, 6, 7, 8]], "request_id": "c-2"}
+        outs = []
+        for _ in range(2):
+            proxy = _proxied([WireEvent("corrupt", 0)], up, seed=11)
+            try:
+                outs.append(_post(proxy.endpoint, payload)[1])
+            finally:
+                proxy.close()
+        assert outs[0] == outs[1]
+
+    def test_dup_delivers_twice_relays_second(self, echo):
+        up, h = echo
+        proxy = _proxied([WireEvent("dup", 0)], up)
+        try:
+            st, via, _ = _post(proxy.endpoint,
+                               {"tokens": [[9]], "request_id": "d-1"})
+            assert st == 200 and len(h.bodies) == 2
+            assert h.bodies[0] == h.bodies[1]
+        finally:
+            proxy.close()
+
+    def test_burst503_with_retry_after_then_clean(self, echo):
+        up, h = echo
+        proxy = _proxied([WireEvent("burst503", 0, 2)], up)
+        try:
+            for _ in range(2):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(proxy.endpoint, {"tokens": [[1]]})
+                assert ei.value.code == 503
+                assert ei.value.headers.get("Retry-After") == "1"
+            st, _, _ = _post(proxy.endpoint, {"tokens": [[1]]})
+            assert st == 200
+            # the whole burst reached the proxy, none reached upstream
+            assert len(h.bodies) == 1
+            assert proxy.counters["faults"]["burst503"] == 2
+        finally:
+            proxy.close()
+
+    def test_blackhole_accepts_then_hangs(self, echo):
+        up, h = echo
+        proxy = _proxied([WireEvent("blackhole", 0, 0.3)], up)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(_WIRE_ERRORS):
+                _post(proxy.endpoint, {"tokens": [[1]]}, timeout=5)
+            assert time.monotonic() - t0 >= 0.25
+            assert h.bodies == []
+            # scrapes survive a blackholed work stream — exactly the
+            # lie the router's breaker exists to see through
+            with urllib.request.urlopen(
+                    f"{proxy.url}/readyz", timeout=5) as r:
+                assert r.status == 200
+        finally:
+            proxy.close()
+
+    def test_trickle_is_slow_but_byte_identical(self, echo):
+        up, h = echo
+        payload = {"tokens": [[3] * 32], "request_id": "tr-1"}
+        _, direct, _ = _post(up, payload)
+        proxy = _proxied([WireEvent("trickle", 0, 0.3)], up)
+        try:
+            t0 = time.monotonic()
+            _, via, _ = _post(proxy.endpoint, payload)
+            assert time.monotonic() - t0 >= 0.25
+            assert via == direct
+        finally:
+            proxy.close()
+
+    def test_metrics_text_names_every_kind(self, echo):
+        up, h = echo
+        proxy = _proxied([WireEvent("burst503", 0)], up,
+                         edge="replica-broker")
+        try:
+            with pytest.raises(urllib.error.HTTPError):
+                _post(proxy.endpoint, {"tokens": [[1]]})
+            text = proxy.metrics_text()
+            assert ('tpujob_wirechaos_requests_total'
+                    '{edge="replica-broker"} 1.0') in text
+            for kind in KINDS:
+                assert f'kind="{kind}"' in text
+            assert 'tpujob_wirechaos_upstream_errors_total' in text
+        finally:
+            proxy.close()
+
+
+class TestEnvInstall:
+    def test_scheduled_edge_gets_proxy(self, echo):
+        up, h = echo
+        env = {WC.WIRE_CHAOS_ENV: "replica-broker=burst503@0",
+               WC.WIRE_CHAOS_SEED_ENV: "5"}
+        try:
+            assert WC.maybe_proxy_from_env(
+                "client-router", up, env=env) is None
+            ep = WC.wire_endpoint_from_env("replica-broker", up, env=env)
+            assert ep != up
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(ep, {"tokens": [[1]]})
+            assert ei.value.code == 503
+        finally:
+            WC.close_env_proxies()
+
+    def test_unset_env_is_identity(self, echo):
+        up, h = echo
+        assert WC.wire_endpoint_from_env("replica-broker", up,
+                                         env={}) == up
+        assert WC.wire_endpoint_from_env("replica-broker", "",
+                                         env={}) == ""
+
+    def test_malformed_env_schedule_raises(self, echo):
+        up, h = echo
+        env = {WC.WIRE_CHAOS_ENV: "replica-broker=dorp@0"}
+        with pytest.raises(ValueError):
+            WC.maybe_proxy_from_env("replica-broker", up, env=env)
+
+
+# ---------------------------------------------------------------------------
+# the journal: append / replay / compact / torn tail
+# ---------------------------------------------------------------------------
+
+
+class TestRouterJournal:
+    def test_roundtrip(self, tmp_path):
+        j = RouterJournal(str(tmp_path))
+        j.append_result("r1", 200, b'{"tokens": [[1]]}', "ep-a")
+        j.append_result("r2", 504, b'{"partial": true}', "")
+        j.append_migration("m1/row0", "ep-b")
+        j.close()
+        results, replica, migrations = RouterJournal(
+            str(tmp_path)).replay()
+        assert results["r1"] == (200, b'{"tokens": [[1]]}')
+        assert results["r2"] == (504, b'{"partial": true}')
+        assert replica == {"r1": "ep-a"}
+        assert migrations == {"m1/row0": "ep-b"}
+
+    def test_last_write_wins(self, tmp_path):
+        j = RouterJournal(str(tmp_path))
+        j.append_result("r1", 200, b"old", "a")
+        j.append_result("r1", 200, b"new", "b")
+        j.close()
+        results, replica, _ = RouterJournal(str(tmp_path)).replay()
+        assert results["r1"] == (200, b"new")
+        assert replica["r1"] == "b"
+
+    def test_torn_tail_skipped(self, tmp_path):
+        j = RouterJournal(str(tmp_path))
+        j.append_result("r1", 200, b"ok", "a")
+        j.close()
+        with open(j.path, "ab") as f:
+            f.write(b'{"k": "res", "id": "torn"')   # crash mid-append
+        results, _, _ = RouterJournal(str(tmp_path)).replay()
+        assert list(results) == ["r1"]
+
+    def test_compaction_shrinks_and_survives(self, tmp_path):
+        from collections import OrderedDict
+
+        j = RouterJournal(str(tmp_path), compact_slack=2)
+        for i in range(10):
+            j.append_result("hot", 200, f"v{i}".encode(), "a")
+        assert j.should_compact(live=1)
+        live = OrderedDict([("hot", (200, b"v9"))])
+        j.compact(live, {"hot": "a"}, OrderedDict())
+        assert j.records == 1
+        # the append handle survives compaction
+        j.append_result("r2", 200, b"x", "")
+        j.close()
+        results, _, _ = RouterJournal(str(tmp_path)).replay()
+        assert results == OrderedDict(
+            [("hot", (200, b"v9")), ("r2", (200, b"x"))])
+
+
+# ---------------------------------------------------------------------------
+# breaker discipline (in-process router, no HTTP)
+# ---------------------------------------------------------------------------
+
+
+def _breaker_router(**kw):
+    kw.setdefault("breaker_threshold", 2)
+    kw.setdefault("breaker_cooldown_s", 0.2)
+    r = FleetRouter(["127.0.0.1:9001", "127.0.0.1:9002"],
+                    scrape_interval=999.0, **kw)
+    for st in r.replicas.values():
+        st.ready = True
+    return r
+
+
+class TestCircuitBreaker:
+    def test_trips_after_threshold(self):
+        r = _breaker_router()
+        ep = "127.0.0.1:9001"
+        r.mark_unready(ep)
+        r.replicas[ep].ready = True
+        assert ep in r._ready_endpoints()        # 1 failure: no trip
+        r.mark_unready(ep)
+        r.replicas[ep].ready = True
+        assert ep not in r._ready_endpoints()    # 2nd failure: open
+        assert r.counters["breaker_trips"] == 1
+        assert ('tpujob_router_replica_breaker_open'
+                '{replica="127.0.0.1:9001"} 1.0') in r.metrics_text()
+
+    def test_half_open_single_probe_then_close(self):
+        r = _breaker_router()
+        ep = "127.0.0.1:9001"
+        for _ in range(2):
+            r.mark_unready(ep)
+            r.replicas[ep].ready = True
+        time.sleep(0.25)                         # cooldown expires
+        assert ep in r._ready_endpoints()        # half-open: eligible
+        r.breaker_admit(ep)                      # ONE probe claims it
+        assert r.counters["breaker_probes"] == 1
+        assert ep not in r._ready_endpoints()    # others blocked
+        r.breaker_success(ep)
+        assert r.counters["breaker_closes"] == 1
+        assert ep in r._ready_endpoints()
+        assert r.replicas[ep].breaker_open_until == 0.0
+
+    def test_failed_probe_reopens(self):
+        r = _breaker_router()
+        ep = "127.0.0.1:9001"
+        for _ in range(2):
+            r.mark_unready(ep)
+            r.replicas[ep].ready = True
+        time.sleep(0.25)
+        r.breaker_admit(ep)
+        # scrape zeroed consecutive_failures meanwhile — the reopen
+        # path must not depend on the counter reaching threshold again
+        r.replicas[ep].consecutive_failures = 0
+        r.mark_unready(ep)
+        r.replicas[ep].ready = True
+        assert r.counters["breaker_reopens"] == 1
+        assert ep not in r._ready_endpoints()
+
+    def test_threshold_zero_disables(self):
+        r = _breaker_router(breaker_threshold=0)
+        ep = "127.0.0.1:9001"
+        for _ in range(5):
+            r.mark_unready(ep)
+            r.replicas[ep].ready = True
+        assert ep in r._ready_endpoints()
+        assert r.counters["breaker_trips"] == 0
+
+
+# ---------------------------------------------------------------------------
+# streamed-request dedupe (ISSUE 20 satellite: the replay marker)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamServedBody:
+    def test_deterministic_and_self_describing(self):
+        a = stream_served_body("rid-1")
+        assert a == stream_served_body("rid-1")
+        obj = json.loads(a)
+        assert obj == {"alreadyServed": True, "done": True,
+                       "requestId": "rid-1", "stream": True}
+
+
+# ---------------------------------------------------------------------------
+# crash-safe window, in-process: a SECOND router on the same state dir
+# ---------------------------------------------------------------------------
+
+
+class TestCrashSafeWindow:
+    def test_second_router_replays_dedupe_and_migrations(self, tmp_path):
+        r1 = FleetRouter(["127.0.0.1:9001"], scrape_interval=999.0,
+                         state_dir=str(tmp_path))
+        r1.dedupe_end("done-1", 200, b'{"tokens": [[1, 9001]]}',
+                      "127.0.0.1:9001")
+        r1.record_migration("mig-1/row0", "127.0.0.1:9002")
+        r1.close()
+
+        r2 = FleetRouter(["127.0.0.1:9001"], scrape_interval=999.0,
+                         state_dir=str(tmp_path))
+        kind, rec = r2.dedupe_begin("done-1")
+        assert kind == "replay"
+        assert rec == (200, b'{"tokens": [[1, 9001]]}')
+        assert r2.replay_replica("done-1") == "127.0.0.1:9001"
+        # base-id adoption re-derived at replay, not just raw records
+        assert r2.migrate_target("mig-1/row0") == "127.0.0.1:9002"
+        assert r2.migrate_target("mig-1") == "127.0.0.1:9002"
+        assert r2.counters["journal_replayed"] >= 2
+        r2.close()
+
+    def test_warmup_gates_file_directory_router(self, tmp_path):
+        eps = tmp_path / "eps.txt"
+        eps.write_text("127.0.0.1:9001\n")
+        r = FleetRouter(endpoints_file=str(eps), scrape_interval=999.0)
+        r._reload_endpoints_file()
+        r.replicas["127.0.0.1:9001"].ready = True
+        # a restarted router must not say ready before its first
+        # scrape re-reads the directory and probes every member
+        assert not r.ready()
+        r._warmed = True
+        assert r.ready()
+        r.close()
+
+    def test_static_endpoints_router_is_born_warm(self):
+        r = FleetRouter(["127.0.0.1:9001"], scrape_interval=999.0)
+        r.replicas["127.0.0.1:9001"].ready = True
+        assert r.ready()
+        r.close()
+
+
+# ---------------------------------------------------------------------------
+# the full crash story: subprocess router, kill -9, same-port restart
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_router(port, eps, state_dir):
+    env = dict(os.environ,
+               ROUTER_PORT=str(port),
+               TPUJOB_SERVE_REPLICAS=",".join(eps),
+               ROUTER_STATE_DIR=str(state_dir),
+               ROUTER_SCRAPE_S="0.1",
+               ROUTER_BREAKER_COOLDOWN_S="0.2",
+               JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "paddle_operator_tpu.router"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def _wait_ready(url, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(f"{url}/readyz",
+                                        timeout=1) as r:
+                if r.status == 200:
+                    return
+        except OSError:
+            pass
+        time.sleep(0.05)
+    raise TimeoutError(f"router at {url} never went ready")
+
+
+class TestRouterKillRestart:
+    def test_kill9_restart_same_window_under_load(self, echo, tmp_path):
+        up, h = echo
+        port = _free_port()
+        url = f"http://127.0.0.1:{port}"
+        proc = _spawn_router(port, [up], tmp_path)
+        proc2 = None
+        try:
+            _wait_ready(url)
+
+            # phase A: complete requests through router #1, keeping the
+            # exact bytes for the replay byte-compare
+            recorded = {}
+            for i in range(4):
+                rid = f"pre-{i}"
+                st, body, _ = _post(
+                    f"127.0.0.1:{port}",
+                    {"tokens": [[10 + i, 11 + i]], "request_id": rid})
+                assert st == 200
+                recorded[rid] = body
+            executed_before = len(h.bodies)
+
+            # phase B: concurrent retrying clients, kill -9 mid-load
+            results, errors = {}, []
+
+            def drive(k):
+                try:
+                    for i in range(3):
+                        rid = f"live-{k}-{i}"
+                        st, out = client_cli.post_generate(
+                            url, {"tokens": [[40 + k, i]],
+                                  "request_id": rid},
+                            max_retries=30, backoff_base_s=0.1,
+                            backoff_max_s=0.5)
+                        results[rid] = (st, out)
+                except Exception as e:         # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=drive, args=(k,))
+                       for k in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.15)
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+
+            # restart on the SAME port with the SAME state dir while
+            # the clients are still retrying
+            proc2 = _spawn_router(port, [up], tmp_path)
+            _wait_ready(url)
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+            assert errors == []
+            assert len(results) == 12          # zero lost
+            for st, out in results.values():
+                assert st == 200 and "echo" in out
+
+            # exactly-once across the crash: every phase-A result
+            # replays from the journal byte-for-byte, with NO
+            # re-execution on the replica
+            for rid, body in recorded.items():
+                st, again, hdrs = _post(
+                    f"127.0.0.1:{port}",
+                    {"tokens": [[99]], "request_id": rid})
+                assert hdrs.get("X-Router-Dedupe") == "replay"
+                assert again == body
+            pre_rids = {f"pre-{i}" for i in range(4)}
+            executed = [json.loads(b).get("request_id")
+                        for b in h.bodies[executed_before:]]
+            assert not pre_rids & set(executed)
+        finally:
+            for p in (proc, proc2):
+                if p is not None and p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# doc drift: the router/wirechaos metric catalog is load-bearing
+# ---------------------------------------------------------------------------
+
+
+class TestDocDrift:
+    def test_router_and_wirechaos_catalog_both_directions(self):
+        """docs/observability.md § Router and wire-chaos metrics is the
+        catalog of record (same discipline as the tpujob_serve_*
+        guard in tests/test_tracing.py): every rendered
+        tpujob_router_* / tpujob_wirechaos_* name appears there, and
+        every name there is rendered."""
+        import pathlib
+        import re
+
+        doc = (pathlib.Path(__file__).resolve().parents[1]
+               / "docs" / "observability.md").read_text()
+        doc_router = set(re.findall(r"tpujob_router_[a-z0-9_]+", doc))
+        doc_wc = set(re.findall(r"tpujob_wirechaos_[a-z0-9_]+", doc))
+
+        r = FleetRouter(["127.0.0.1:1"],
+                        prefill_endpoints=["127.0.0.1:2"],
+                        scrape_interval=999.0)
+        try:
+            rendered = set(re.findall(r"tpujob_router_[a-z0-9_]+",
+                                      r.metrics_text()))
+        finally:
+            r.close()
+        p = WireChaosProxy("127.0.0.1:1", [],
+                           edge="client-router").start()
+        try:
+            rendered_wc = set(re.findall(
+                r"tpujob_wirechaos_[a-z0-9_]+", p.metrics_text()))
+        finally:
+            p.close()
+
+        assert rendered - doc_router == set(), \
+            f"rendered but undocumented: {sorted(rendered - doc_router)}"
+        assert doc_router - rendered == set(), \
+            f"documented but never rendered: {sorted(doc_router - rendered)}"
+        assert rendered_wc - doc_wc == set(), \
+            f"rendered but undocumented: {sorted(rendered_wc - doc_wc)}"
+        assert doc_wc - rendered_wc == set(), \
+            f"documented but never rendered: {sorted(doc_wc - rendered_wc)}"
+
+
+# ---------------------------------------------------------------------------
+# real rings (slow): the journal window survives across router builds
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestCrashSafeRealRing:
+    def test_journal_window_on_real_fleet(self, tmp_path):
+        from paddle_operator_tpu.router.simfleet import SimFleet
+
+        fleet = SimFleet(1, state_dir=str(tmp_path))
+        try:
+            st, out = fleet.post({"tokens": [[1, 2, 3, 4]],
+                                  "max_new": 4,
+                                  "request_id": "ring-rid"})
+            assert st == 200
+            eps = fleet.router.endpoints()
+        finally:
+            fleet.close()
+        r2 = FleetRouter(eps, scrape_interval=999.0,
+                         state_dir=str(tmp_path))
+        kind, rec = r2.dedupe_begin("ring-rid")
+        assert kind == "replay"
+        assert json.loads(rec[1])["tokens"] == out["tokens"]
+        r2.close()
